@@ -1,0 +1,44 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's API surface.
+
+A from-scratch rebuild of Apache MXNet (incubating, NNVM era — reference at
+taurusleo/incubator-mxnet) designed for TPU hardware:
+
+  * compute lowers to JAX/XLA (MXU matmuls/convs, fused elementwise)
+  * the dependency engine's overlap/ordering job is done by XLA async
+    dispatch + buffer immutability (no worker threads to manage)
+  * data parallelism = ``jax.lax.psum`` over an ICI mesh (kvstore('tpu')),
+    replacing NCCL and the ps-lite parameter server
+  * Symbol/Module and Gluon keep their training-loop semantics but bind to
+    jit-compiled XLA programs instead of nnvm graph executors
+
+Import as a drop-in for the scripts in the reference's example/ tree:
+
+    import mxnet_tpu as mx
+    ctx = mx.tpu()
+"""
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .ndarray import NDArray
+
+rnd = random
+
+__all__ = [
+    "nd",
+    "ndarray",
+    "autograd",
+    "random",
+    "Context",
+    "cpu",
+    "gpu",
+    "tpu",
+    "current_context",
+    "NDArray",
+    "MXNetError",
+]

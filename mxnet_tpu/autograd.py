@@ -1,0 +1,316 @@
+"""Imperative autograd — tape over ``jax.vjp``.
+
+TPU rebuild of the reference's imperative autograd
+(ref: src/imperative/imperative.cc:86,182,357; python/mxnet/autograd.py):
+
+  * ``record()/pause()``            → thread-local recording flag
+            (ref: imperative.cc:25-29 thread-local ``is_recording_``)
+  * ``Imperative::RecordOp``        → an ``_OpNode`` holding the ``jax.vjp``
+            pullback of the op's own compute body — the nnvm FGradient
+            registry collapses into JAX's AD.
+  * ``MarkVariables``               → ``mark_variables``/``attach_grad``
+            (ref: imperative.cc:112)
+  * ``Imperative::Backward``        → reverse topo walk accumulating
+            cotangents (ref: imperative.cc:357, RunGraph :268)
+
+The tape is per-thread, like the reference; graphs are built dynamically
+per call so there is no retain_graph distinction (pullbacks are pure and
+reusable).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "get_symbol",
+    "Function",
+]
+
+_STATE = threading.local()
+
+
+def _st():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+    return _STATE
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(flag: bool) -> bool:
+    st = _st()
+    prev, st.recording = st.recording, bool(flag)
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    st = _st()
+    prev, st.training = st.training, bool(flag)
+    return prev
+
+
+class _RecordingScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._recording = recording
+        self._training = training
+
+    def __enter__(self):
+        if self._recording is not None:
+            self._prev_rec = set_recording(self._recording)
+        if self._training is not None:
+            self._prev_train = set_training(self._training)
+        return self
+
+    def __exit__(self, *exc):
+        if self._recording is not None:
+            set_recording(self._prev_rec)
+        if self._training is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True) -> _RecordingScope:
+    """``with autograd.record():`` — ref: python/mxnet/autograd.py:48."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _RecordingScope:
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode() -> _RecordingScope:
+    return _RecordingScope(None, True)
+
+
+def predict_mode() -> _RecordingScope:
+    return _RecordingScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape structure.
+#
+# Cotangents are keyed by *value version tokens*, not cell identity: an
+# NDArray cell can be mutated in place (+=, out=) after being recorded, so a
+# cell may hold many successive values, each its own tape vertex.  This is
+# the rebuild of the reference's versioned-variable protocol
+# (ref: src/engine/threaded_engine.h:115-217 ThreadedVar version queues) —
+# there it serialized concurrent reads/writes; here it keeps reverse-mode
+# accumulation sound across mutation.
+# ---------------------------------------------------------------------------
+class _OpNode:
+    """One recorded op application (ref: nnvm node on the tape,
+    imperative.cc:182 RecordOp)."""
+
+    __slots__ = ("name", "vjp_fn", "inputs", "in_tokens", "in_producers",
+                 "out_shapes_dtypes", "out_tokens", "n_outputs")
+
+    def __init__(self, name, vjp_fn, inputs, outputs):
+        self.name = name
+        self.vjp_fn = vjp_fn          # pullback: cotangents(out) -> cotangents(in)
+        self.inputs = list(inputs)    # NDArray cells (for leaf-grad writing)
+        self.in_tokens = [a._vt for a in inputs]
+        self.in_producers = [a._fresh_grad_node for a in inputs]
+        self.out_shapes_dtypes = [(o.shape, o.dtype) for o in outputs]
+        self.out_tokens = [o._vt for o in outputs]
+        self.n_outputs = len(outputs)
+
+
+def _record_op(name, vjp_fn, inputs, outputs) -> None:
+    node = _OpNode(name, vjp_fn, list(inputs), list(outputs))
+    for i, o in enumerate(outputs):
+        o._fresh_grad_node = (node, i)
+
+
+def mark_variables(variables, gradients, grad_reqs="write") -> None:
+    """Attach gradient buffers (ref: imperative.cc:112 MarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._fresh_grad_node = None
+        v._is_ag_variable = True
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True) -> None:
+    """Run reverse-mode from ``heads`` (ref: imperative.cc:357 Backward)."""
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # cotangent store keyed by value-version token
+    cotangents: Dict[int, Any] = {}
+
+    def _add_cot(token, value):
+        key = id(token)
+        if key in cotangents:
+            cotangents[key] = cotangents[key] + value
+        else:
+            cotangents[key] = value
+
+    # Topologically order nodes reachable from heads (reverse post-order DFS,
+    # following producers captured at record time — the live cell may have
+    # been mutated since).
+    topo: List[_OpNode] = []
+    seen = set()
+
+    def _dfs(node):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for prod in node.in_producers:
+            if prod is not None:
+                _dfs(prod[0])
+        topo.append(node)
+
+    for h in heads:
+        prod = h._fresh_grad_node
+        if prod is None and h._grad is None:
+            raise ValueError(
+                "cannot differentiate a head that is neither recorded nor a marked variable"
+            )
+        if prod is not None:
+            _dfs(prod[0])
+
+    for h, hg in zip(heads, head_grads):
+        init = jnp.ones_like(h._data) if hg is None else hg._data
+        _add_cot(h._vt, init)
+
+    # Reverse sweep.
+    for node in reversed(topo):
+        outs_cot = []
+        any_cot = False
+        for (shape, dtype), token in zip(node.out_shapes_dtypes, node.out_tokens):
+            c = cotangents.get(id(token))
+            if c is None:
+                c = jnp.zeros(shape, dtype)
+            else:
+                any_cot = True
+            outs_cot.append(c)
+        if not any_cot:
+            continue
+        arg = tuple(outs_cot) if node.n_outputs > 1 else outs_cot[0]
+        in_cots = node.vjp_fn(arg)
+        for token, c in zip(node.in_tokens, in_cots):
+            if c is not None:
+                _add_cot(token, c)
+
+    # Write accumulated cotangents into attached grad buffers.  A leaf's
+    # gradient is the cotangent of the version that was read at record time.
+    visited_versions = set()
+    for node in topo:
+        for inp, token in zip(node.inputs, node.in_tokens):
+            _write_leaf(inp, token, cotangents, visited_versions)
+    for h in heads:
+        _write_leaf(h, h._vt, cotangents, visited_versions)
+
+
+def _write_leaf(arr, token, cotangents, visited) -> None:
+    if id(token) in visited:
+        return
+    visited.add(id(token))
+    grad_buf = getattr(arr, "_grad", None)
+    if grad_buf is None:
+        return
+    cot = cotangents.get(id(token))
+    if cot is None:
+        return
+    req = getattr(arr, "_grad_req", "write")
+    if req == "add":
+        grad_buf._data = grad_buf._data + cot.astype(grad_buf._data.dtype)
+    elif req != "null":
+        grad_buf._data = cot.astype(grad_buf._data.dtype)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return grads of ``heads`` w.r.t. ``variables`` without touching
+    attached buffers (ref: python/mxnet/autograd.py:360)."""
+    from .ndarray.ndarray import NDArray
+
+    import jax.numpy as jnp
+
+    single = isinstance(variables, NDArray)
+    vars_list = [variables] if single else list(variables)
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "write")) for v in vars_list]
+
+    tmp = []
+    for v in vars_list:
+        g = NDArray.from_raw(jnp.zeros_like(v._data), v.ctx)
+        v._grad = g
+        v._grad_req = "write"
+        tmp.append(g)
+    try:
+        backward(heads, head_grads, retain_graph or False, train_mode)
+    finally:
+        for v, (g, req) in zip(vars_list, saved):
+            v._grad, v._grad_req = g, req
+    return tmp[0] if single else tmp
+
+
+def get_symbol(x):
+    raise NotImplementedError(
+        "autograd.get_symbol: the TPU build records jax pullbacks, not nnvm "
+        "graphs; export via gluon HybridBlock tracing instead"
+    )
+
+
+class Function:
+    """Custom differentiable function (ref: python/mxnet/autograd.py:364).
+
+    Subclass and implement ``forward`` and ``backward`` over NDArrays.
+    """
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            self_ref = self
+
+            def vjp_fn(out_cots):
+                cots = out_cots if isinstance(out_cots, tuple) else (out_cots,)
+                with pause():
+                    in_grads = self_ref.backward(
+                        *[NDArray.from_raw(c, inputs[0].ctx) for c in cots]
+                    )
+                if isinstance(in_grads, NDArray):
+                    in_grads = (in_grads,)
+                return tuple(g._data for g in in_grads)
+
+            _record_op(type(self).__name__, vjp_fn, list(inputs), outs)
+        return outputs if single else outs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

@@ -1,0 +1,147 @@
+"""Base utilities: dtypes, shapes, env-var config, errors.
+
+TPU-native rebuild of the dmlc-era foundations MXNet leans on:
+  * dtype registry        (ref: include/mxnet/base.h, mshadow type switch)
+  * env-var knobs         (ref: dmlc::GetEnv call sites, SURVEY.md §5 config tiers)
+  * MXNetError            (ref: include/mxnet/base.h:70)
+
+Nothing here touches a device; it is pure Python so it can be imported
+before JAX backend selection happens.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "NotSupportedForTPU",
+    "string_types",
+    "numeric_types",
+    "default_dtype",
+    "np_dtype",
+    "dtype_name",
+    "getenv",
+    "env_int",
+    "env_bool",
+    "check_call",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (ref: include/mxnet/base.h:70)."""
+
+
+class NotSupportedForTPU(MXNetError):
+    """A reference feature that has no TPU analogue (documented divergence)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+
+# ---------------------------------------------------------------------------
+# dtypes — mirror of mshadow's type enum used across the C ABI
+# (ref: include/mxnet/base.h + MSHADOW_TYPE_SWITCH usage in src/operator/).
+# TPU additions: bfloat16 is first-class (MXU native).
+# ---------------------------------------------------------------------------
+try:  # ml_dtypes ships with jax
+    from ml_dtypes import bfloat16 as _bf16
+
+    _BF16 = _np.dtype(_bf16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+_DTYPE_TO_ID = {}
+_ID_TO_DTYPE = {}
+_NAME_TO_DTYPE = {}
+
+
+def _reg_dtype(name: str, dt, type_id: int) -> None:
+    dt = _np.dtype(dt)
+    _DTYPE_TO_ID[dt] = type_id
+    _ID_TO_DTYPE.setdefault(type_id, dt)
+    _NAME_TO_DTYPE[name] = dt
+
+
+# ids follow mshadow's enum so saved .params files stay interoperable
+_reg_dtype("float32", _np.float32, 0)
+_reg_dtype("float64", _np.float64, 1)
+_reg_dtype("float16", _np.float16, 2)
+_reg_dtype("uint8", _np.uint8, 3)
+_reg_dtype("int32", _np.int32, 4)
+_reg_dtype("int8", _np.int8, 5)
+_reg_dtype("int64", _np.int64, 6)
+if _BF16 is not None:
+    _reg_dtype("bfloat16", _BF16, 12)  # id chosen past the reference enum
+_reg_dtype("bool", _np.bool_, 7)
+_reg_dtype("uint32", _np.uint32, 8)
+_reg_dtype("uint64", _np.uint64, 9)
+
+
+def default_dtype() -> _np.dtype:
+    return _np.dtype(_np.float32)
+
+
+def np_dtype(dtype: Any) -> _np.dtype:
+    """Normalise any dtype spec (str/np.dtype/type/int id) to np.dtype."""
+    if dtype is None:
+        return default_dtype()
+    if isinstance(dtype, int):
+        return _ID_TO_DTYPE[dtype]
+    if isinstance(dtype, str) and dtype in _NAME_TO_DTYPE:
+        return _NAME_TO_DTYPE[dtype]
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype: Any) -> str:
+    dt = np_dtype(dtype)
+    if _BF16 is not None and dt == _BF16:
+        return "bfloat16"
+    return dt.name
+
+
+def dtype_id(dtype: Any) -> int:
+    return _DTYPE_TO_ID[np_dtype(dtype)]
+
+
+def dtype_from_id(type_id: int) -> _np.dtype:
+    return _ID_TO_DTYPE[type_id]
+
+
+# ---------------------------------------------------------------------------
+# Env-var config (ref: SURVEY.md §5 — ~40 MXNET_* knobs via dmlc::GetEnv)
+# ---------------------------------------------------------------------------
+def getenv(name: str, default: Optional[str] = None) -> Optional[str]:
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    try:
+        return int(v) if v is not None else default
+    except ValueError:
+        return default
+
+
+def env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() not in ("0", "false", "off", "")
+
+
+def check_call(ret: int) -> None:
+    """C-ABI compatibility shim: nonzero return → raise (ref: c_api_error.cc)."""
+    if ret != 0:
+        raise MXNetError("API call failed with code %d" % ret)
+
+
+def as_shape(shape: Any) -> Tuple[int, ...]:
+    """Normalise int / sequence to a shape tuple (ref: TShape in mshadow)."""
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, _np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)

@@ -1,0 +1,140 @@
+"""Device contexts.
+
+MXNet's ``Context`` (ref: include/mxnet/base.h:129-135, python/mxnet/context.py)
+names a device as ``(device_type, device_id)`` and every NDArray / executor is
+pinned to one.  The TPU rebuild maps contexts onto JAX devices:
+
+  * ``mx.tpu(i)``   → i-th accelerator device (``jax.devices()[i]``)
+  * ``mx.cpu(i)``   → i-th host-platform device (falls back to the default
+                      backend when JAX was initialised TPU-only)
+  * ``mx.gpu(i)``   → alias of ``tpu(i)`` so reference scripts written for
+                      ``mx.gpu()`` run unmodified (BASELINE.json north star:
+                      "scripts run unmodified with ctx=mx.tpu()").
+
+Unlike the reference there is no per-context worker thread pool
+(src/engine/threaded_engine_perdevice.cc:45): ordering + overlap come from
+XLA's async dispatch, so a Context is purely a placement tag.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context", "num_gpus", "num_tpus"]
+
+_DEVICE_TYPES = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5, "tpu": 6}
+_ID_TO_TYPE = {v: k for k, v in _DEVICE_TYPES.items()}
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class Context:
+    """A device placement tag (ref: python/mxnet/context.py Context)."""
+
+    _default_ctx = threading.local()
+    devtype2str = _ID_TO_TYPE
+    devstr2type = _DEVICE_TYPES
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        if device_type not in _DEVICE_TYPES:
+            raise ValueError("unknown device type %r" % (device_type,))
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def device_typeid(self) -> int:
+        return _DEVICE_TYPES[self.device_type]
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, Context)
+            and self._canonical_type() == other._canonical_type()
+            and self.device_id == other.device_id
+        )
+
+    def _canonical_type(self) -> str:
+        # gpu is an alias for tpu in this build (scripts-run-unmodified goal)
+        return "tpu" if self.device_type == "gpu" else self.device_type
+
+    def __hash__(self) -> int:
+        return hash((self._canonical_type(), self.device_id))
+
+    def __repr__(self) -> str:
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __str__(self) -> str:
+        return repr(self)
+
+    # -- jax mapping -------------------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        jax = _jax()
+        ctype = self._canonical_type()
+        if ctype in ("cpu", "cpu_pinned", "cpu_shared"):
+            try:
+                devs = jax.devices("cpu")
+            except RuntimeError:
+                devs = jax.devices()  # TPU-only runtime: place on accelerator
+        else:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    # -- scope protocol: ``with mx.tpu(0):`` -------------------------------
+    def __enter__(self) -> "Context":
+        if not hasattr(Context._default_ctx, "stack"):
+            Context._default_ctx.stack = []
+        Context._default_ctx.stack.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        Context._default_ctx.stack.pop()
+
+    @classmethod
+    def default_ctx(cls) -> "Context":
+        stack = getattr(cls._default_ctx, "stack", None)
+        if stack:
+            return stack[-1]
+        return _DEFAULT
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias of :func:`tpu` — lets reference scripts run unmodified."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+_DEFAULT = Context("cpu", 0)
+
+
+def current_context() -> Context:
+    return Context.default_ctx()
+
+
+def num_gpus() -> int:
+    return num_tpus()
+
+
+def num_tpus() -> int:
+    jax = _jax()
+    try:
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    except RuntimeError:
+        return 0

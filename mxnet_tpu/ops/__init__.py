@@ -1,0 +1,19 @@
+"""Operator library (ref: src/operator/ — 86k LoC of CUDA/C++ in the
+reference collapses into pure-JAX bodies; XLA supplies the per-backend
+kernels, fusion, and layout assignment that mshadow/cuDNN hand-rolled).
+
+Importing this package registers every operator.
+"""
+from . import registry
+from .registry import Op, get, list_ops, register
+
+# registration side-effect imports — order matters only for alias clashes
+from . import elemwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import init_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import sequence  # noqa: F401
+from . import contrib  # noqa: F401

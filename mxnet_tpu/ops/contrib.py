@@ -1,0 +1,151 @@
+"""Contrib / detection ops (ref: src/operator/contrib/).
+
+Static-shape reformulations of the reference's dynamic CUDA kernels:
+TPU/XLA has no dynamic output shapes, so NMS-style ops return fixed-size
+outputs with ``-1`` padding exactly like the reference's convention
+(ref: src/operator/contrib/bounding_box.cc box_nms out format).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _box_iou_corner(a, b):
+    # a: (..., 4), b: (..., 4) xmin,ymin,xmax,ymax
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:4], b[..., 2:4])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_box_iou", aliases=("box_iou",), nondiff=True)
+def _box_iou(lhs, rhs, format="corner", **_):
+    if format == "center":
+        def to_corner(x):
+            cx, cy, w, h = x[..., 0], x[..., 1], x[..., 2], x[..., 3]
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+        lhs, rhs = to_corner(lhs), to_corner(rhs)
+    a = lhs.reshape(lhs.shape[:-1] + (1,) * (rhs.ndim - 1) + (4,))
+    return _box_iou_corner(a, rhs.reshape((1,) * (lhs.ndim - 1) + rhs.shape))
+
+
+@register("_contrib_box_nms", aliases=("box_nms",), nondiff=True)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+             score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+             in_format="corner", out_format="corner", **_):
+    """Greedy NMS over (B, N, k) or (N, k) box tensors.
+
+    Static-shape greedy loop via lax.fori_loop over the score-sorted list —
+    the TPU answer to the reference's sort+suppress CUDA kernel
+    (ref: src/operator/contrib/bounding_box.cu).  Suppressed entries are
+    written as -1, same as the reference.
+    """
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    B, N, K = data.shape
+
+    def per_batch(batch):
+        scores = batch[:, score_index]
+        boxes = batch[:, coord_start : coord_start + 4]
+        if in_format == "center":
+            cx, cy, w, h = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+            boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+        valid = scores > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        sboxes = boxes[order]
+        svalid = valid[order]
+        if id_index >= 0:
+            sids = batch[:, id_index][order]
+        else:
+            sids = jnp.zeros(N, dtype=data.dtype)
+        if topk > 0:
+            svalid = svalid & (jnp.arange(N) < topk)
+
+        iou = _box_iou_corner(sboxes[:, None, :], sboxes[None, :, :])
+        same_class = (sids[:, None] == sids[None, :]) | force_suppress
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & same_class[i] & (jnp.arange(N) > i)
+            return jnp.where(keep[i] & svalid[i], keep & ~sup, keep)
+
+        keep = jax.lax.fori_loop(0, N, body, jnp.ones(N, dtype=bool)) & svalid
+        out = jnp.where(keep[:, None], batch[order], -jnp.ones((N, K), data.dtype))
+        return out
+
+    out = jax.vmap(per_batch)(data)
+    return out[0] if squeeze else out
+
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",), nondiff=True)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                    offsets=(0.5, 0.5), **_):
+    # ref: src/operator/contrib/multibox_prior.cc — anchors per feature-map cell
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (h, w, 2)
+
+    whs = []
+    for s in sizes:
+        whs.append((s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        whs.append((sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r)))
+    whs = jnp.asarray(whs)  # (A, 2) — (w, h)
+
+    A = whs.shape[0]
+    centers = jnp.broadcast_to(cyx[:, :, None, :], (h, w, A, 2))
+    half_w = whs[None, None, :, 0] / 2
+    half_h = whs[None, None, :, 1] / 2
+    xmin = centers[..., 1] - half_w
+    ymin = centers[..., 0] - half_h
+    xmax = centers[..., 1] + half_w
+    ymax = centers[..., 0] + half_h
+    anchors = jnp.stack([xmin, ymin, xmax, ymax], axis=-1).reshape(1, -1, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors.astype(data.dtype)
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",), nondiff=True)
+def _count_sketch(data, h, s, out_dim=0, **_):
+    # ref: contrib/count_sketch.cc
+    n, d = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)[:d]
+    ss = s.reshape(-1)[:d]
+    signed = data * ss[None, :]
+    out = jnp.zeros((n, int(out_dim)), data.dtype)
+    return out.at[:, hh].add(signed)
+
+
+@register("_contrib_quantize", aliases=("quantize",), nondiff=True)
+def _quantize(data, min_range, max_range, out_type="uint8", **_):
+    # ref: contrib/quantize.cc — affine int8/uint8 quantisation experiments
+    if out_type == "uint8":
+        qmin, qmax, dt = 0.0, 255.0, jnp.uint8
+    else:
+        qmin, qmax, dt = -127.0, 127.0, jnp.int8
+    scale = (qmax - qmin) / jnp.maximum(max_range - min_range, 1e-12)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(dt), min_range, max_range
+
+
+@register("_contrib_dequantize", aliases=("dequantize",), nondiff=True)
+def _dequantize(data, min_range, max_range, out_type="float32", **_):
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = (max_range - min_range) / (qmax - qmin)
+    return (data.astype(jnp.float32) - qmin) * scale + min_range

@@ -1,0 +1,39 @@
+"""Creation ops — zero-input operators (ref: src/operator/tensor/init_op.cc).
+
+These take no array inputs; shape/dtype are static params.  The NDArray and
+Symbol layers pass ``ctx`` separately for placement.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .registry import register
+
+
+@register("_zeros", nondiff=True)
+def _zeros(shape=(), dtype="float32", **_):
+    return jnp.zeros(shape, dtype=np_dtype(dtype))
+
+
+@register("_ones", nondiff=True)
+def _ones(shape=(), dtype="float32", **_):
+    return jnp.ones(shape, dtype=np_dtype(dtype))
+
+
+@register("_full", nondiff=True)
+def _full(shape=(), value=0.0, dtype="float32", **_):
+    return jnp.full(shape, value, dtype=np_dtype(dtype))
+
+
+@register("_arange", nondiff=True)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", **_):
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye", nondiff=True)
+def _eye(N=0, M=0, k=0, dtype="float32", **_):
+    return jnp.eye(int(N), int(M) or None, k=int(k), dtype=np_dtype(dtype))

@@ -1,0 +1,338 @@
+"""Shape manipulation, indexing, ordering, and joining ops.
+
+Ref: src/operator/tensor/matrix_op.cc (Reshape/transpose/slice/clip/repeat/
+tile/stack/reverse/expand_dims/flatten/swapaxes), indexing_op.cc (take/
+Embedding/one_hot/gather_nd/scatter_nd/pick), ordering_op.cc (topk/sort/
+argsort), concat.cc, slice_channel.cc.
+
+All shapes here are static params — XLA requires static shapes, and the
+reference's special reshape codes (0, -1, -2, -3, -4) are resolved in Python
+before tracing, exactly as nnvm's InferShape did ahead of memory planning.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register
+
+
+def infer_reshape(src_shape: Tuple[int, ...], target: Sequence[int], reverse: bool = False):
+    """Resolve MXNet reshape special codes (ref: matrix_op-inl.h ReshapeParam).
+
+    0  → copy this dim from source
+    -1 → infer from remaining elements
+    -2 → copy all remaining source dims
+    -3 → merge two consecutive source dims
+    -4 → split one source dim into the next two targets
+    """
+    src = list(src_shape)
+    if reverse:
+        src = src[::-1]
+        target = list(target)[::-1]
+    out = []
+    src_i = 0
+    i = 0
+    target = list(target)
+    while i < len(target):
+        t = target[i]
+        if t == 0:
+            out.append(src[src_i]); src_i += 1
+        elif t == -1:
+            out.append(-1); src_i += 1
+        elif t == -2:
+            out.extend(src[src_i:]); src_i = len(src)
+        elif t == -3:
+            out.append(src[src_i] * src[src_i + 1]); src_i += 2
+        elif t == -4:
+            d1, d2 = target[i + 1], target[i + 2]
+            whole = src[src_i]
+            if d1 == -1:
+                d1 = whole // d2
+            if d2 == -1:
+                d2 = whole // d1
+            out.extend([d1, d2]); src_i += 1; i += 2
+        else:
+            out.append(int(t)); src_i += 1
+        i += 1
+    if -1 in out:
+        total = 1
+        for s in src_shape:
+            total *= s
+        known = 1
+        for s in out:
+            if s != -1:
+                known *= s
+        out[out.index(-1)] = total // max(known, 1)
+    if reverse:
+        out = out[::-1]
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",))
+def _reshape(data, shape=(), reverse=False, **_):
+    return jnp.reshape(data, infer_reshape(data.shape, shape, reverse))
+
+
+@register("reshape_like")
+def _reshape_like(lhs, rhs, **_):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(data, **_):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(data, axes=(), **_):
+    if not axes:
+        axes = tuple(range(data.ndim))[::-1]
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def _expand_dims(data, axis=0, **_):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def _squeeze(data, axis=None, **_):
+    return jnp.squeeze(data, axis)
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def _swapaxes(data, dim1=0, dim2=0, **_):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("slice")
+def _slice(data, begin=(), end=(), step=(), **_):
+    sl = []
+    step = step or (None,) * len(begin)
+    for i, (b, e) in enumerate(zip(begin, end)):
+        s = step[i] if i < len(step) else None
+        sl.append(builtins_slice(b, e, s))
+    return data[tuple(sl)]
+
+
+def builtins_slice(b, e, s):
+    return slice(
+        None if b is None else int(b),
+        None if e is None else int(e),
+        None if s is None else int(s),
+    )
+
+
+@register("slice_axis")
+def _slice_axis(data, axis=0, begin=0, end=None, **_):
+    axis = axis % data.ndim
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(data, shape_like, axes=(), **_):
+    axes_ = axes or tuple(range(min(data.ndim, shape_like.ndim)))
+    idx = [slice(None)] * data.ndim
+    for a in axes_:
+        idx[a % data.ndim] = slice(0, shape_like.shape[a % shape_like.ndim])
+    return data[tuple(idx)]
+
+
+@register("repeat")
+def _repeat(data, repeats=1, axis=None, **_):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("tile")
+def _tile(data, reps=(), **_):
+    return jnp.tile(data, reps)
+
+
+@register("reverse", aliases=("flip",))
+def _reverse(data, axis=(), **_):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=axes)
+
+
+@register("Concat", aliases=("concat",))
+def _concat(*args, dim=1, **_):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def _stack(*args, axis=0, **_):
+    return jnp.stack(args, axis=axis)
+
+
+@register(
+    "SliceChannel",
+    aliases=("split",),
+    num_outputs=1,  # actual count depends on params; resolved dynamically
+)
+def _slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False, **_):
+    # ref: src/operator/slice_channel.cc
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("space_to_depth")
+def _space_to_depth(data, block_size=1, **_):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("depth_to_space")
+def _depth_to_space(data, block_size=1, **_):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+# ---------------------------------------------------------------------------
+# indexing (ref: src/operator/tensor/indexing_op.cc)
+# ---------------------------------------------------------------------------
+@register("take")
+def _take(a, indices, axis=0, mode="clip", **_):
+    idx = indices.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("Embedding")
+def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32", sparse_grad=False, **_):
+    # ref: indexing_op.cc Embedding — gather rows; MXU-friendly one_hot
+    # formulation is left to XLA (it lowers gather efficiently on TPU).
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot", nondiff=True)
+def _one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32", **_):
+    from ..base import np_dtype
+
+    return jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=np_dtype(dtype)) * (
+        on_value - off_value
+    ) + off_value
+
+
+@register("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip", **_):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        picked = jnp.squeeze(picked, axis=axis)
+    return picked
+
+
+@register("gather_nd")
+def _gather_nd(data, indices, **_):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=(), **_):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=(), **_):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register("batch_take")
+def _batch_take(a, indices, **_):
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# ordering (ref: src/operator/tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+@register("sort")
+def _sort(data, axis=-1, is_ascend=True, **_):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", nondiff=True)
+def _argsort(data, axis=-1, is_ascend=True, dtype="float32", **_):
+    from ..base import np_dtype
+
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(np_dtype(dtype))
+
+
+@register("topk", nondiff=True, num_outputs=1)
+def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **_):
+    # ref: ordering_op.cc TopK — ret_typ in {value, indices, mask, both}
+    from ..base import np_dtype
+
+    axis = axis % data.ndim if axis is not None else data.ndim - 1
+    moved = jnp.moveaxis(data, axis, -1)
+    sel = -moved if is_ascend else moved
+    vals, idxs = jax.lax.top_k(sel, k)
+    if is_ascend:
+        vals = -vals
+    if ret_typ == "mask":
+        # one-hot over the reduced axis, summed across the k picks
+        mask_moved = jax.nn.one_hot(idxs, moved.shape[-1], dtype=data.dtype).sum(-2)
+        return jnp.moveaxis(mask_moved, -1, axis)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idxs.astype(np_dtype(dtype))
+    return (vals, idxs.astype(np_dtype(dtype)))
+
+
+# ---------------------------------------------------------------------------
+# dot products (ref: src/operator/tensor/dot.cc) — straight onto the MXU.
+# ---------------------------------------------------------------------------
+@register("dot")
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False, **_):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **_):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def _khatri_rao(*args, **_):
+    # ref: contrib/krprod.cc — column-wise Kronecker product
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("ir,jr->ijr", out, m).reshape(-1, out.shape[1])
+    return out

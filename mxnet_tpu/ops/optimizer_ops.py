@@ -1,0 +1,170 @@
+"""Fused optimizer update ops (ref: src/operator/optimizer_op.cc).
+
+The reference fuses each optimizer step into one kernel so the engine can
+schedule updates as single ops; here each body is one jitted XLA program —
+same effect, and XLA fuses the elementwise chain into one HBM pass.
+
+All ops return the updated weight (plus updated state tensors via
+``mutate_aux`` positions, matching the reference's in-place state mutation).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _apply_wd_and_clip(grad, weight, wd, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update", nondiff=True)
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", nondiff=True, mutate_aux=(2,))
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("nag_mom_update", nondiff=True, mutate_aux=(2,))
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("mp_sgd_update", nondiff=True)
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, **_):
+    # multi-precision: master fp32 weights, bf16/fp16 working copy
+    g = _apply_wd_and_clip(grad.astype(jnp.float32), weight32, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype)
+
+
+@register("adam_update", nondiff=True, mutate_aux=(2, 3))
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
+    new_w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_w, new_mean, new_var
+
+
+@register("rmsprop_update", nondiff=True, mutate_aux=(2,))
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", nondiff=True, mutate_aux=(2, 3, 4))
+def _rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1.0 - gamma1) * g + gamma1 * g_state
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", nondiff=True, mutate_aux=(2, 3))
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0, **_):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd),
+    )
+    return new_w, new_z, new_n
+
+
+@register("signsgd_update", nondiff=True)
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    return weight - lr * jnp.sign(g)
+
+
+@register("signum_update", nondiff=True, mutate_aux=(2,))
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    new_mom = momentum * mom - (1.0 - momentum) * g
+    new_w = (1.0 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom
+
+
+@register("adagrad_update", nondiff=True, mutate_aux=(2,))
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    new_hist = history + jnp.square(g)
+    return weight - lr * g / jnp.sqrt(new_hist + epsilon), new_hist
+
+
+@register("adadelta_update", nondiff=True, mutate_aux=(2, 3))
+def _adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_gradient if clip_gradient > 0 else None)
+    new_acc_g = rho * acc_g + (1.0 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1.0 - rho) * jnp.square(delta)
+    return weight - delta, new_acc_g, new_acc_delta
+
+
+@register("ftml_update", nondiff=True, mutate_aux=(2, 3, 4))
+def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0, t=1, **_):
+    g = _apply_wd_and_clip(grad, weight, wd, rescale_grad,
+                           clip_grad if clip_grad > 0 else None)
+    new_v = beta2 * v + (1.0 - beta2) * jnp.square(g)
+    t = max(int(t), 1)
+    d_t = (1.0 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1.0 - beta2 ** t)) + epsilon
+    )
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1.0 - beta1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z

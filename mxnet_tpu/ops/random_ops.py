@@ -1,0 +1,107 @@
+"""Random samplers (ref: src/operator/random/sample_op.cc, multisample_op.cc).
+
+Each op consumes a PRNG key as its first array argument (``rng=True`` in the
+registry) — the imperative layer injects a fresh fold_in subkey per call,
+traced layers thread an explicit key.  This replaces the reference's
+per-device RNG resource (ref: src/resource.cc kRandom).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .registry import register
+
+
+@register("_random_uniform", aliases=("uniform", "random_uniform"), rng=True, nondiff=True)
+def _uniform(key, low=0.0, high=1.0, shape=(), dtype="float32", **_):
+    return jax.random.uniform(key, shape, np_dtype(dtype), low, high)
+
+
+@register("_random_normal", aliases=("normal", "random_normal"), rng=True, nondiff=True)
+def _normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32", **_):
+    return loc + scale * jax.random.normal(key, shape, np_dtype(dtype))
+
+
+@register("_random_gamma", aliases=("random_gamma",), rng=True, nondiff=True)
+def _gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32", **_):
+    return jax.random.gamma(key, alpha, shape, np_dtype(dtype)) * beta
+
+
+@register("_random_exponential", aliases=("random_exponential",), rng=True, nondiff=True)
+def _exponential(key, lam=1.0, shape=(), dtype="float32", **_):
+    return jax.random.exponential(key, shape, np_dtype(dtype)) / lam
+
+
+@register("_random_poisson", aliases=("random_poisson",), rng=True, nondiff=True)
+def _poisson(key, lam=1.0, shape=(), dtype="float32", **_):
+    return jax.random.poisson(key, lam, shape).astype(np_dtype(dtype))
+
+
+@register("_random_negative_binomial", aliases=("random_negative_binomial",), rng=True,
+          nondiff=True)
+def _neg_binomial(key, k=1, p=1.0, shape=(), dtype="float32", **_):
+    # NB(k,p) = Poisson(Gamma(k, (1-p)/p))
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, float(k), shape) * ((1.0 - p) / p)
+    return jax.random.poisson(kp, lam, shape).astype(np_dtype(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=("random_generalized_negative_binomial",), rng=True, nondiff=True)
+def _gen_neg_binomial(key, mu=1.0, alpha=1.0, shape=(), dtype="float32", **_):
+    kg, kp = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(kg, r, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(kp, lam, shape).astype(np_dtype(dtype))
+
+
+@register("_random_randint", aliases=("random_randint",), rng=True, nondiff=True)
+def _randint(key, low=0, high=1, shape=(), dtype="int32", **_):
+    return jax.random.randint(key, shape, int(low), int(high), np_dtype(dtype))
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",), rng=True, nondiff=True)
+def _multinomial(key, data, shape=(), get_prob=False, dtype="int32", **_):
+    # data: (..., k) probabilities (ref: sample_multinomial_op.cc)
+    n = shape if isinstance(shape, int) else (shape[0] if shape else 1)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    sampled = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(n,) + data.shape[:-1])
+    sampled = jnp.moveaxis(sampled, 0, -1).astype(np_dtype(dtype))
+    if not shape or (isinstance(shape, tuple) and len(shape) == 0):
+        sampled = sampled[..., 0]
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.log(jnp.maximum(data, 1e-37)),
+            jnp.atleast_1d(sampled).astype(jnp.int32).reshape(data.shape[:-1] + (-1,)),
+            axis=-1,
+        )
+        return sampled, lp.reshape(sampled.shape)
+    return sampled
+
+
+# per-row parameterised "multisample" ops (ref: multisample_op.cc)
+@register("_sample_uniform", rng=True, nondiff=True)
+def _sample_uniform(key, low, high, shape=(), dtype="float32", **_):
+    tail = (shape,) if isinstance(shape, int) else tuple(shape)
+    u = jax.random.uniform(key, low.shape + tail, np_dtype(dtype))
+    return low.reshape(low.shape + (1,) * len(tail)) + u * (high - low).reshape(
+        low.shape + (1,) * len(tail)
+    )
+
+
+@register("_sample_normal", rng=True, nondiff=True)
+def _sample_normal(key, mu, sigma, shape=(), dtype="float32", **_):
+    tail = (shape,) if isinstance(shape, int) else tuple(shape)
+    z = jax.random.normal(key, mu.shape + tail, np_dtype(dtype))
+    return mu.reshape(mu.shape + (1,) * len(tail)) + z * sigma.reshape(
+        sigma.shape + (1,) * len(tail)
+    )
+
+
+@register("_shuffle", aliases=("shuffle",), rng=True, nondiff=True)
+def _shuffle(key, data, **_):
+    return jax.random.permutation(key, data, axis=0)

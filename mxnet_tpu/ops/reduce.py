@@ -1,0 +1,116 @@
+"""Reductions and broadcasting ops.
+
+Ref: src/operator/tensor/broadcast_reduce_op_value.cc (sum/mean/prod/max/min/
+norm/argmax/argmin, broadcast_to/broadcast_axis).  Axis semantics follow the
+reference: ``axis=None`` reduces all; ``keepdims`` preserved; ``exclude``
+reduces every axis *except* the listed ones (ref: ReduceAxesParam).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _norm_axes(axis, ndim: int, exclude: bool = False) -> Optional[Tuple[int, ...]]:
+    if axis is None or axis == ():
+        axes = None
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if exclude:
+        keep = set(axes or ())
+        axes = tuple(a for a in range(ndim) if a not in keep)
+    return axes
+
+
+def _make_reduce(fn, nondiff=False):
+    def body(data, axis=None, keepdims=False, exclude=False, **_):
+        axes = _norm_axes(axis, data.ndim, exclude)
+        return fn(data, axis=axes, keepdims=bool(keepdims))
+
+    return body
+
+
+register("sum", aliases=("sum_axis",))(_make_reduce(jnp.sum))
+register("mean")(_make_reduce(jnp.mean))
+register("prod")(_make_reduce(jnp.prod))
+register("nansum")(_make_reduce(jnp.nansum))
+register("nanprod")(_make_reduce(jnp.nanprod))
+register("max", aliases=("max_axis",))(_make_reduce(jnp.max))
+register("min", aliases=("min_axis",))(_make_reduce(jnp.min))
+
+
+@register("norm")
+def _norm(data, ord=2, axis=None, keepdims=False, **_):
+    axes = _norm_axes(axis, data.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axes, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=bool(keepdims)))
+
+
+@register("argmax", nondiff=True)
+def _argmax(data, axis=None, keepdims=False, **_):
+    out = jnp.argmax(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)  # reference returns real dtype indices
+
+
+@register("argmin", nondiff=True)
+def _argmin(data, axis=None, keepdims=False, **_):
+    out = jnp.argmin(data, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", nondiff=True)
+def _argmax_channel(data, **_):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("broadcast_to")
+def _broadcast_to(data, shape=(), **_):
+    # reference semantics: 0 in target shape keeps the source dim
+    tgt = tuple(int(s) if int(s) != 0 else int(d) for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(data, axis=(), size=(), **_):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = int(s)
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("broadcast_like")
+def _broadcast_like(lhs, rhs, **_):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("L2Normalization")
+def _l2_normalization(data, eps=1e-10, mode="instance", **_):
+    # ref: src/operator/l2_normalization.cc
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise ValueError("unknown L2Normalization mode %r" % mode)
+    denom = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / denom
+
+
+@register("square_sum")
+def _square_sum(data, axis=None, keepdims=False, **_):
+    axes = _norm_axes(axis, data.ndim)
+    return jnp.sum(jnp.square(data), axis=axes, keepdims=bool(keepdims))

@@ -1,0 +1,178 @@
+"""Operator registry — the NNVM ``Op`` analogue, TPU-first.
+
+The reference registers ~375 operators with NNVM attributes
+(``FCompute``/``FInferShape``/``FGradient`` …, ref:
+include/mxnet/op_attr_types.h:183-258).  On TPU the compute body is a pure
+JAX function, so one registration carries everything NNVM split across
+attribute maps:
+
+  * shape/dtype inference  → ``jax.eval_shape`` over the same function
+  * FCompute<cpu>/<gpu>    → one function; XLA targets any backend
+  * FGradient              → ``jax.vjp`` of the same function (custom
+                             gradients via ``jax.custom_vjp`` inside the body)
+  * kAddTo / kWriteInplace (OpReqType, include/mxnet/op_attr_types.h:45)
+                           → handled by the NDArray cell layer: outputs are
+                             fresh buffers that replace/accumulate into cells.
+
+An op body has signature ``fn(*arrays, **params) -> array | tuple``.
+``params`` must be hashable Python scalars/tuples (they become static
+arguments of the per-op jit cache).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Op", "register", "get", "list_ops", "alias"]
+
+_REGISTRY: Dict[str, "Op"] = {}
+
+
+class Op:
+    """A registered operator.
+
+    Attributes
+    ----------
+    name : canonical registered name (may be CamelCase, like the reference's
+        ``FullyConnected`` — ref: src/operator/fully_connected.cc).
+    fn : pure function over jax arrays.
+    num_outputs : static output count (or a callable(params)->int).
+    mutate_aux : indices of inputs that the op *updates* (returned as extra
+        outputs after the visible ones) — e.g. BatchNorm moving stats
+        (ref: src/operator/batch_norm.cc aux states).  The NDArray layer
+        writes these back into the input cells.
+    rng : whether the op consumes a PRNG key (Dropout, random samplers).
+        Such ops take ``key`` as their first array argument.
+    """
+
+    __slots__ = (
+        "name",
+        "fn",
+        "num_outputs",
+        "num_visible_outputs",
+        "mutate_aux",
+        "rng",
+        "nondiff",
+        "doc",
+        "aliases",
+        "input_names",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        num_outputs: int = 1,
+        num_visible_outputs: Optional[int] = None,
+        mutate_aux: Sequence[int] = (),
+        rng: bool = False,
+        nondiff: bool = False,
+        doc: str = "",
+        input_names: Optional[Sequence[str]] = None,
+    ):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.num_visible_outputs = (
+            num_visible_outputs if num_visible_outputs is not None else num_outputs
+        )
+        self.mutate_aux = tuple(mutate_aux)
+        self.rng = rng
+        self.nondiff = nondiff
+        self.doc = doc or (fn.__doc__ or "")
+        self.aliases: List[str] = []
+        if input_names is None:
+            # derive from the body's leading positional params (skip the rng
+            # key); ops with *varargs inputs must declare input_names
+            import inspect
+
+            try:
+                spec = inspect.getfullargspec(fn)
+                names = [a for a in spec.args if not a.startswith("_")]
+                if rng and names and names[0] == "key":
+                    names = names[1:]
+                input_names = names
+            except TypeError:
+                input_names = []
+        self.input_names = tuple(input_names)
+
+    def __repr__(self) -> str:
+        return "<Op %s>" % self.name
+
+    # ------------------------------------------------------------------
+    # jit cache: one compiled executable per (params, input avals).  This is
+    # the eager-mode analogue of the engine's cached ThreadedOpr
+    # (ref: src/executor/graph_executor.cc:1221 InitCachedOps) — XLA caches
+    # by input shape/dtype automatically once we pin the static params.
+    # ------------------------------------------------------------------
+    def bound(self, **params) -> Callable:
+        return _bind_cached(self, _freeze(params))
+
+    def __call__(self, *arrays, **params):
+        return self.fn(*arrays, **params)
+
+
+def _freeze(params: Dict[str, Any]) -> Tuple[Tuple[str, Any], ...]:
+    out = []
+    for k in sorted(params):
+        v = params[k]
+        if isinstance(v, list):
+            v = tuple(v)
+        out.append((k, v))
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=4096)
+def _bind_cached(op: Op, frozen_params: Tuple[Tuple[str, Any], ...]) -> Callable:
+    import jax
+
+    params = dict(frozen_params)
+    fn = functools.partial(op.fn, **params)
+    return jax.jit(fn)
+
+
+def register(
+    name: str,
+    aliases: Sequence[str] = (),
+    **kwargs,
+) -> Callable[[Callable], Callable]:
+    """Decorator: register ``fn`` as operator ``name``.
+
+    ``aliases`` adds alternate lookup names; the reference exposes both the
+    registered name and hidden ``_``-prefixed internals.
+    """
+
+    def deco(fn: Callable) -> Callable:
+        op = Op(name, fn, **kwargs)
+        if name in _REGISTRY:
+            raise ValueError("duplicate op registration: %s" % name)
+        _REGISTRY[name] = op
+        for a in aliases:
+            if a in _REGISTRY:
+                raise ValueError("duplicate op alias: %s" % a)
+            _REGISTRY[a] = op
+            op.aliases.append(a)
+        return fn
+
+    return deco
+
+
+def get(name: str) -> Op:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            "operator %r is not registered (have %d ops)" % (name, len(set(_REGISTRY.values())))
+        ) from None
+
+
+def exists(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def list_ops() -> List[str]:
+    return sorted({op.name for op in _REGISTRY.values()})
+
+
+def alias(name: str, new_name: str) -> None:
+    _REGISTRY[new_name] = _REGISTRY[name]

@@ -1,0 +1,63 @@
+"""Sequence ops (ref: src/operator/sequence_last.cc, sequence_mask.cc,
+sequence_reverse.cc) — the reference's "long context" primitives.
+
+Layout follows the reference: time-major (T, N, ...) by default unless
+``axis`` says otherwise (SequenceMask supports axis 0/1).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("SequenceMask", input_names=("data", "sequence_length"))
+def _sequence_mask(data, *maybe_len, use_sequence_length=False, value=0.0,
+                   axis=0, **_):
+    if not use_sequence_length or not maybe_len:
+        return data
+    seq_len = maybe_len[0]
+    T = data.shape[axis]
+    steps = jnp.arange(T)
+    if axis == 0:
+        mask = steps[:, None] < seq_len[None, :].astype(steps.dtype)  # (T, N)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = steps[None, :] < seq_len[:, None].astype(steps.dtype)  # (N, T)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast", input_names=("data", "sequence_length"))
+def _sequence_last(data, *maybe_len, use_sequence_length=False, axis=0, **_):
+    if not use_sequence_length or not maybe_len:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    seq_len = maybe_len[0].astype(jnp.int32)
+    idx = jnp.clip(seq_len - 1, 0, data.shape[axis] - 1)
+    if axis == 0:
+        # data (T, N, ...), idx (N,)
+        moved = jnp.moveaxis(data, 0, 1)  # (N, T, ...)
+    else:
+        moved = data
+    gathered = jnp.take_along_axis(
+        moved, idx.reshape(-1, 1, *(1,) * (moved.ndim - 2)), axis=1
+    )
+    return jnp.squeeze(gathered, axis=1)
+
+
+@register("SequenceReverse", input_names=("data", "sequence_length"))
+def _sequence_reverse(data, *maybe_len, use_sequence_length=False, axis=0, **_):
+    T = data.shape[0]
+    if not use_sequence_length or not maybe_len:
+        return jnp.flip(data, axis=0)
+    seq_len = maybe_len[0].astype(jnp.int32)  # (N,)
+    steps = jnp.arange(T)
+    # index i maps to (len-1-i) when i < len else i
+    idx = jnp.where(
+        steps[:, None] < seq_len[None, :],
+        seq_len[None, :] - 1 - steps[:, None],
+        steps[:, None],
+    )  # (T, N)
+    idx = idx.reshape(idx.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(idx, data.shape), axis=0)

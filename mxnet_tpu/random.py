@@ -1,0 +1,91 @@
+"""Global PRNG state.
+
+The reference seeds per-device mshadow RNGs plus a parallel Philox-style
+per-thread generator (ref: src/common/random_generator.h:218,
+src/resource.cc kRandom/kParallelRandom).  JAX's counter-based PRNG is
+already Philox-family and splittable, so the rebuild keeps ONE root key and
+derives a fresh subkey per imperative call via ``fold_in`` on a monotonically
+increasing counter — deterministic under ``mx.random.seed(n)`` and safe to
+call from any thread (counter under a lock).
+
+Traced code (CachedOp / Executor / jitted train steps) must NOT call
+``_next_key`` at trace time more than once per trace; those layers thread an
+explicit key argument instead (see executor.py), mirroring how the reference
+hands ops a Resource rather than global state.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["seed", "uniform", "normal", "randint"]
+
+_lock = threading.Lock()
+_root_key = None
+_counter = 0
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def seed(seed_state: int, ctx=None) -> None:
+    """ref: python/mxnet/random.py seed → MXRandomSeed."""
+    global _root_key, _counter
+    with _lock:
+        _root_key = _jax().random.PRNGKey(int(seed_state))
+        _counter = 0
+
+
+def _next_key():
+    global _root_key, _counter
+    jax = _jax()
+    with _lock:
+        if _root_key is None:
+            _root_key = jax.random.PRNGKey(0)
+        _counter += 1
+        c = _counter
+    return jax.random.fold_in(_root_key, c)
+
+
+# thin imperative wrappers — full sampler op set lives in ops/random_ops.py;
+# these are re-exported through mx.nd.random / mx.random
+def uniform(low=0.0, high=1.0, shape=(), dtype=None, ctx=None, out=None):
+    from .ndarray import ndarray as _nd
+
+    return _nd.invoke("_random_uniform", [],
+                      {"low": float(low), "high": float(high),
+                       "shape": _shape(shape), "dtype": _dt(dtype)},
+                      out=out, ctx=ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=(), dtype=None, ctx=None, out=None):
+    from .ndarray import ndarray as _nd
+
+    return _nd.invoke("_random_normal", [],
+                      {"loc": float(loc), "scale": float(scale),
+                       "shape": _shape(shape), "dtype": _dt(dtype)},
+                      out=out, ctx=ctx)
+
+
+def randint(low, high, shape=(), dtype="int32", ctx=None, out=None):
+    from .ndarray import ndarray as _nd
+
+    return _nd.invoke("_random_randint", [],
+                      {"low": int(low), "high": int(high),
+                       "shape": _shape(shape), "dtype": _dt(dtype)},
+                      out=out, ctx=ctx)
+
+
+def _shape(shape):
+    from .base import as_shape
+
+    return as_shape(shape)
+
+
+def _dt(dtype):
+    from .base import dtype_name
+
+    return dtype_name(dtype)

@@ -1,0 +1,125 @@
+"""Testing utilities (ref: python/mxnet/test_utils.py).
+
+Carries over the reference's three pillars (SURVEY.md §4):
+  * ``assert_almost_equal`` with dtype-scaled tolerances (ref: test_utils.py:472)
+  * ``check_numeric_gradient`` finite differences     (ref: test_utils.py:794)
+  * ``check_consistency`` cross-backend agreement      (ref: test_utils.py:1208)
+    — here cpu↔tpu instead of cpu↔gpu.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import autograd, nd
+from .context import Context, cpu, current_context
+from .ndarray import NDArray
+
+_DEFAULT_RTOL = {
+    np.dtype(np.float16): 1e-2,
+    np.dtype(np.float32): 1e-4,
+    np.dtype(np.float64): 1e-6,
+}
+_DEFAULT_ATOL = {
+    np.dtype(np.float16): 1e-2,
+    np.dtype(np.float32): 1e-5,
+    np.dtype(np.float64): 1e-8,
+}
+
+
+def default_rtol(dtype) -> float:
+    return _DEFAULT_RTOL.get(np.dtype(dtype), 1e-4)
+
+
+def default_atol(dtype) -> float:
+    return _DEFAULT_ATOL.get(np.dtype(dtype), 1e-5)
+
+
+def _as_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")) -> None:
+    a, b = _as_np(a), _as_np(b)
+    rtol = rtol if rtol is not None else max(default_rtol(a.dtype), default_rtol(b.dtype))
+    atol = atol if atol is not None else max(default_atol(a.dtype), default_atol(b.dtype))
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg="%s vs %s" % names)
+
+
+def rand_ndarray(shape, dtype=np.float32, ctx=None, scale=1.0) -> NDArray:
+    return nd.array(np.random.uniform(-scale, scale, size=shape).astype(dtype), ctx=ctx)
+
+
+def check_numeric_gradient(
+    fn: Callable[..., NDArray],
+    inputs: Sequence[NDArray],
+    eps: float = 1e-4,
+    rtol: float = 1e-2,
+    atol: float = 1e-3,
+    grad_nodes: Optional[Sequence[int]] = None,
+) -> None:
+    """Finite-difference check of autograd gradients
+    (ref: test_utils.py:794 check_numeric_gradient).
+
+    ``fn`` maps NDArrays to a single NDArray output; its sum is the scalar
+    objective.  Inputs should be float64 for a stable check.
+    """
+    grad_nodes = list(grad_nodes) if grad_nodes is not None else list(range(len(inputs)))
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        loss = out.sum()
+    loss.backward()
+    analytic = [inputs[i].grad.asnumpy().copy() for i in grad_nodes]
+
+    for gi, i in enumerate(grad_nodes):
+        x = inputs[i]
+        base = x.asnumpy().astype(np.float64)
+        numeric = np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            x._data = x._data.at[...].set(base.reshape(base.shape).astype(x.dtype))
+            plus = float(fn(*inputs).sum().asscalar())
+            flat[j] = orig - eps
+            x._data = x._data.at[...].set(base.reshape(base.shape).astype(x.dtype))
+            minus = float(fn(*inputs).sum().asscalar())
+            flat[j] = orig
+            x._data = x._data.at[...].set(base.reshape(base.shape).astype(x.dtype))
+            num_flat[j] = (plus - minus) / (2 * eps)
+        np.testing.assert_allclose(
+            analytic[gi], numeric, rtol=rtol, atol=atol,
+            err_msg="gradient mismatch for input %d" % i,
+        )
+
+
+def check_consistency(
+    fn: Callable[..., NDArray],
+    inputs_np: Sequence[np.ndarray],
+    ctx_list: Optional[Sequence[Context]] = None,
+    rtol: float = 1e-4,
+    atol: float = 1e-5,
+) -> None:
+    """Run the same computation on every context and cross-check
+    (ref: test_utils.py:1208 check_consistency — cpu↔gpu there, cpu↔tpu here)."""
+    from .context import tpu, num_tpus
+
+    if ctx_list is None:
+        ctx_list = [cpu()]
+        if num_tpus() > 0:
+            ctx_list.append(tpu())
+    results = []
+    for ctx in ctx_list:
+        args = [nd.array(a, ctx=ctx) for a in inputs_np]
+        results.append(fn(*args).asnumpy())
+    for r in results[1:]:
+        np.testing.assert_allclose(results[0], r, rtol=rtol, atol=atol)
+
+
+def same(a, b) -> bool:
+    return np.array_equal(_as_np(a), _as_np(b))

@@ -1,0 +1,37 @@
+"""Test fixture: force an 8-device virtual CPU mesh BEFORE jax initialises.
+
+Mirrors the reference's testing stance (SURVEY.md §4): unit tests run
+CPU-only; multi-device semantics (kvstore, model parallel) are exercised on
+one host — the reference used multi-context CPU tests
+(tests/python/unittest/test_model_parallel.py) and spawned-process clusters;
+we use XLA's virtual host devices.
+"""
+import os
+
+# disable the axon TPU tunnel for tests and present 8 virtual CPU devices
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# the axon sitecustomize may have pinned jax_platforms=axon before we got
+# here; the config API wins as long as no backend has been initialised yet
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+assert jax.devices()[0].platform == "cpu", "tests must run on the virtual CPU mesh"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import mxnet_tpu as mx
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,208 @@
+"""Autograd tests (modelled on tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2.0
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_grad():
+    x = nd.array([0.5, 1.0, 1.5])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(2.0 * x)
+        z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.exp(2 * x.asnumpy()), rtol=1e-5)
+
+
+def test_multi_input_grad():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy() + 1)
+    np.testing.assert_allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_reuse_variable():
+    # diamond dependency: gradient accumulation inside the tape
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 3.0
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2 * 2.0 + 3.0])
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 2 * x
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [20.0, 200.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    grad = nd.zeros((1,))
+    autograd.mark_variables([x], [grad], "add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(grad.asnumpy(), [6.0])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # dz/dx through detach path only: z = const * x
+    np.testing.assert_allclose(x.grad.asnumpy(), [9.0])
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [9.0])
+
+
+def test_is_recording_is_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    assert not autograd.is_recording()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_matmul_grad():
+    a_np = np.random.rand(3, 4).astype("float32")
+    b_np = np.random.rand(4, 2).astype("float32")
+    a, b = nd.array(a_np), nd.array(b_np)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = nd.dot(a, b).sum()
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), np.ones((3, 2)) @ b_np.T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(), a_np.T @ np.ones((3, 2)), rtol=1e-5)
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad([y], [x])
+    np.testing.assert_allclose(g.asnumpy(), [12.0])
+    # attached buffer untouched by grad()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            self.y = nd.sigmoid(x)
+            return self.y
+
+        def backward(self, dy):
+            y = self.y
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_getitem_grad():
+    x = nd.array([1.0, 2.0, 3.0, 4.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x[1:3] * 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0, 2, 2, 0])
+
+
+def test_softmax_output_grad():
+    # the classic (p - onehot) backward, ref: softmax_output-inl.h
+    data = nd.array([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]])
+    label = nd.array([2.0, 0.0])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = np.exp(data.asnumpy()) / np.exp(data.asnumpy()).sum(1, keepdims=True)
+    expect = p.copy()
+    expect[0, 2] -= 1
+    expect[1, 0] -= 1
+    np.testing.assert_allclose(data.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_inplace_op_keeps_tape():
+    # += under record must not sever the tape (version-token keying)
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        y += 1
+        z = y * 3
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 6.0])
+
+
+def test_leaf_mutated_after_read():
+    # gradient flows to the version read at record time, even if the leaf
+    # cell was mutated afterwards
+    w = nd.array([5.0])
+    w.attach_grad()
+    with autograd.record():
+        a = w * 3
+    w += 10
+    a.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), [3.0])
+
+
+def test_keyword_style_op_calls():
+    out = nd.relu(data=nd.array([-1.0, 2.0]))
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 2.0])
+    o = nd.FullyConnected(data=nd.ones((1, 3)), weight=nd.ones((2, 3)),
+                          bias=nd.zeros(2), num_hidden=2)
+    np.testing.assert_allclose(o.asnumpy(), [[3.0, 3.0]])
+
+
+def test_fancy_index_grad():
+    w = nd.array(np.eye(3, dtype="float32"))
+    w.attach_grad()
+    with autograd.record():
+        out = w[nd.array([0, 2])].sum()
+    out.backward()
+    np.testing.assert_allclose(w.grad.asnumpy().sum(1), [3.0, 0.0, 3.0])

@@ -1,0 +1,191 @@
+"""NDArray semantics tests (modelled on tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation_and_basic_props():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    assert a.size == 6
+    assert a.ndim == 2
+    b = nd.ones((2, 3), dtype="float64")
+    assert b.dtype == np.float64
+    c = nd.array([[1, 2], [3, 4]])
+    np.testing.assert_array_equal(c.asnumpy(), [[1, 2], [3, 4]])
+    d = nd.full((2, 2), 7.5)
+    np.testing.assert_allclose(d.asnumpy(), 7.5)
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_array_equal(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[10, 40], [90, 160]])
+    np.testing.assert_allclose((b / a).asnumpy(), [[10, 10], [10, 10]])
+    np.testing.assert_allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((1 + a).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((2 - a).asnumpy(), [[1, 0], [-1, -2]])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    np.testing.assert_allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+
+
+def test_inplace_mutation():
+    a = nd.ones((2, 2))
+    orig = a
+    a += 5
+    assert a is orig  # cell identity preserved — the ThreadedVar contract
+    np.testing.assert_allclose(a.asnumpy(), 6.0)
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), 12.0)
+
+
+def test_setitem_getitem():
+    a = nd.zeros((3, 4))
+    a[1] = 1.0
+    np.testing.assert_allclose(a.asnumpy()[1], 1.0)
+    a[0, 2] = 5.0
+    assert a.asnumpy()[0, 2] == 5.0
+    a[2, 1:3] = nd.array([7.0, 8.0])
+    np.testing.assert_allclose(a.asnumpy()[2, 1:3], [7, 8])
+    sub = a[1]
+    assert sub.shape == (4,)
+    sub2 = a[0:2]
+    assert sub2.shape == (2, 4)
+
+
+def test_reshape_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+    assert a.reshape(6, 4).shape == (6, 4)
+
+
+def test_reductions():
+    a = nd.array(np.arange(24).reshape(2, 3, 4).astype("float32"))
+    np.testing.assert_allclose(a.sum().asnumpy(), np.arange(24).sum())
+    np.testing.assert_allclose(
+        a.sum(axis=1).asnumpy(), np.arange(24).reshape(2, 3, 4).sum(axis=1)
+    )
+    np.testing.assert_allclose(a.mean().asnumpy(), np.arange(24).mean())
+    np.testing.assert_allclose(a.max(axis=(0, 2)).asnumpy(),
+                               np.arange(24).reshape(2, 3, 4).max(axis=(0, 2)))
+    np.testing.assert_allclose(
+        nd.sum(a, axis=1, keepdims=True).asnumpy(),
+        np.arange(24).reshape(2, 3, 4).sum(axis=1, keepdims=True),
+    )
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype("float32"))
+    b = nd.array(np.random.rand(4, 5).astype("float32"))
+    np.testing.assert_allclose(
+        nd.dot(a, b).asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        nd.dot(a, b, transpose_a=False, transpose_b=False).asnumpy(),
+        a.asnumpy() @ b.asnumpy(),
+        rtol=1e-5,
+    )
+    c = nd.array(np.random.rand(2, 3, 4).astype("float32"))
+    d = nd.array(np.random.rand(2, 4, 5).astype("float32"))
+    np.testing.assert_allclose(
+        nd.batch_dot(c, d).asnumpy(), c.asnumpy() @ d.asnumpy(), rtol=1e-5
+    )
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2
+    np.testing.assert_allclose(parts[0].asnumpy(), 1.0)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_cast_astype():
+    a = nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = a.astype(np.int32)
+    assert c.dtype == np.int32
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs.params")
+    d = {"w": nd.array([[1.0, 2.0]]), "b": nd.array([3.0])}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    np.testing.assert_allclose(loaded["w"].asnumpy(), [[1, 2]])
+    np.testing.assert_allclose(loaded["b"].asnumpy(), [3])
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def test_copyto_context():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    b = a.copyto(mx.cpu(0))
+    np.testing.assert_allclose(b.asnumpy(), 1.0)
+    c = a.as_in_context(mx.cpu(0))
+    assert c is a
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    idx = nd.topk(a, k=2)
+    np.testing.assert_array_equal(idx.asnumpy(), [[0, 2], [1, 2]])
+    vals = nd.topk(a, k=2, ret_typ="value")
+    np.testing.assert_allclose(vals.asnumpy(), [[3, 2], [5, 4]])
+    s = nd.sort(a, axis=-1)
+    np.testing.assert_allclose(s.asnumpy(), [[1, 2, 3], [0, 4, 5]])
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, shape=(100,))
+    assert a.shape == (100,)
+    assert 0 <= float(a.min().asscalar()) and float(a.max().asscalar()) <= 1
+    b1 = nd.random.normal(0, 1, shape=(50,))
+    mx.random.seed(42)
+    a2 = nd.random.uniform(0, 1, shape=(100,))
+    np.testing.assert_allclose(a.asnumpy(), a2.asnumpy())  # determinism
+
+
+def test_broadcast_ops():
+    a = nd.array([[1.0], [2.0]])
+    b = nd.array([[10.0, 20.0]])
+    np.testing.assert_allclose(nd.broadcast_add(a, b).asnumpy(), [[11, 21], [12, 22]])
+    c = nd.broadcast_to(nd.array([[1.0, 2.0]]), shape=(3, 2))
+    assert c.shape == (3, 2)
+
+
+def test_embedding_take_onehot():
+    w = nd.array(np.arange(12).reshape(4, 3).astype("float32"))
+    idx = nd.array([0, 2])
+    out = nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    np.testing.assert_allclose(out.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    t = nd.take(w, idx, axis=0)
+    np.testing.assert_allclose(t.asnumpy(), out.asnumpy())
+    oh = nd.one_hot(nd.array([1, 3]), depth=4)
+    np.testing.assert_allclose(oh.asnumpy(), [[0, 1, 0, 0], [0, 0, 0, 1]])
+
+
+def test_wait_and_scalar():
+    a = nd.ones((1,))
+    a.wait_to_read()
+    assert a.asscalar() == 1.0
+    nd.waitall()

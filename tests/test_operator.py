@@ -1,0 +1,288 @@
+"""Operator tests (modelled on tests/python/unittest/test_operator.py —
+forward numerics against numpy + finite-difference gradients)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_fully_connected_forward():
+    x = np.random.rand(4, 10).astype("float32")
+    w = np.random.rand(3, 10).astype("float32")
+    b = np.random.rand(3).astype("float32")
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-5, atol=1e-5)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), num_hidden=3, no_bias=True)
+    assert_almost_equal(out2, x @ w.T, rtol=1e-5, atol=1e-5)
+
+
+def test_fully_connected_grad():
+    x = nd.array(np.random.rand(3, 4).astype("float64"))
+    w = nd.array(np.random.rand(2, 4).astype("float64"))
+    b = nd.array(np.random.rand(2).astype("float64"))
+    check_numeric_gradient(
+        lambda a, ww, bb: nd.FullyConnected(a, ww, bb, num_hidden=2), [x, w, b]
+    )
+
+
+def test_convolution_forward_shape():
+    x = nd.array(np.random.rand(2, 3, 8, 8).astype("float32"))
+    w = nd.array(np.random.rand(4, 3, 3, 3).astype("float32"))
+    b = nd.array(np.zeros(4, dtype="float32"))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    assert out.shape == (2, 4, 6, 6)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, pad=(1, 1))
+    assert out.shape == (2, 4, 8, 8)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, stride=(2, 2), pad=(1, 1))
+    assert out.shape == (2, 4, 4, 4)
+
+
+def test_convolution_vs_numpy():
+    # 1x1 conv == per-pixel matmul
+    x = np.random.rand(2, 3, 5, 5).astype("float32")
+    w = np.random.rand(4, 3, 1, 1).astype("float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(1, 1), num_filter=4,
+                         no_bias=True)
+    expect = np.einsum("nchw,fc->nfhw", x, w[:, :, 0, 0])
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_grad():
+    x = nd.array(np.random.rand(1, 2, 5, 5).astype("float64"))
+    w = nd.array(np.random.rand(2, 2, 3, 3).astype("float64"))
+    check_numeric_gradient(
+        lambda a, ww: nd.Convolution(a, ww, kernel=(3, 3), num_filter=2, no_bias=True),
+        [x, w],
+        eps=1e-5,
+    )
+
+
+def test_grouped_convolution():
+    x = np.random.rand(1, 4, 6, 6).astype("float32")
+    w = np.random.rand(4, 2, 3, 3).astype("float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3), num_filter=4,
+                         num_group=2, no_bias=True)
+    assert out.shape == (1, 4, 4, 4)
+
+
+def test_pooling():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert_almost_equal(out, [[[[5, 7], [13, 15]]]])
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert_almost_equal(out, [[[[2.5, 4.5], [10.5, 12.5]]]])
+    out = nd.Pooling(nd.array(x), global_pool=True, pool_type="max", kernel=(1, 1))
+    assert_almost_equal(out, [[[[15]]]])
+    # full convention rounds up output size (ref: pooling_convention="full")
+    x2 = nd.array(np.random.rand(1, 1, 5, 5).astype("float32"))
+    out_valid = nd.Pooling(x2, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    out_full = nd.Pooling(x2, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                          pooling_convention="full")
+    assert out_valid.shape == (1, 1, 2, 2)
+    assert out_full.shape == (1, 1, 3, 3)
+
+
+def test_activation():
+    x = np.array([-2.0, -0.5, 0.0, 1.0], dtype="float32")
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="relu"),
+                        np.maximum(x, 0))
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="sigmoid"),
+                        1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="tanh"),
+                        np.tanh(x), rtol=1e-5)
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="softrelu"),
+                        np.log1p(np.exp(x)), rtol=1e-5)
+
+
+def test_leaky_relu():
+    x = np.array([-2.0, 1.0], dtype="float32")
+    assert_almost_equal(nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1),
+                        [-0.2, 1.0], rtol=1e-5)
+    assert_almost_equal(
+        nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0),
+        [np.exp(-2) - 1, 1.0],
+        rtol=1e-5,
+    )
+
+
+def test_batchnorm_train_and_inference():
+    np.random.seed(0)
+    x = np.random.rand(4, 3, 2, 2).astype("float32") * 5
+    gamma = np.ones(3, dtype="float32")
+    beta = np.zeros(3, dtype="float32")
+    mm = nd.zeros(3)
+    mv = nd.ones(3)
+    with autograd.record():  # training mode
+        out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta), mm, mv,
+                           fix_gamma=False, momentum=0.9)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expect = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-3)
+    assert_almost_equal(out, expect, rtol=1e-3, atol=1e-4)
+    # moving stats were updated in place (aux mutation contract)
+    assert_almost_equal(mm, 0.1 * mean, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mv, 0.9 + 0.1 * var, rtol=1e-4, atol=1e-5)
+    # inference mode uses moving stats
+    out_inf = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta), mm, mv,
+                           fix_gamma=False)
+    expect_inf = (x - mm.asnumpy()[None, :, None, None]) / np.sqrt(
+        mv.asnumpy()[None, :, None, None] + 1e-3
+    )
+    assert_almost_equal(out_inf, expect_inf, rtol=1e-3, atol=1e-4)
+
+
+def test_batchnorm_grad():
+    x = nd.array(np.random.rand(3, 2, 2, 2).astype("float64"))
+    gamma = nd.array(np.random.rand(2).astype("float64") + 0.5)
+    beta = nd.array(np.random.rand(2).astype("float64"))
+    mm = nd.zeros(2, dtype="float64")
+    mv = nd.ones(2, dtype="float64")
+
+    def f(a, g, b):
+        return nd.BatchNorm(a, g, b, mm, mv, fix_gamma=False, _training=True)
+
+    check_numeric_gradient(f, [x, gamma, beta], eps=1e-5, rtol=2e-2, atol=2e-3)
+
+
+def test_softmax():
+    x = np.random.rand(3, 4).astype("float32")
+    out = nd.softmax(nd.array(x))
+    e = np.exp(x - x.max(1, keepdims=True))
+    assert_almost_equal(out, e / e.sum(1, keepdims=True), rtol=1e-5)
+    lo = nd.log_softmax(nd.array(x))
+    assert_almost_equal(lo, np.log(e / e.sum(1, keepdims=True)), rtol=1e-5)
+
+
+def test_dropout():
+    x = nd.ones((100, 100))
+    # inference: identity
+    out = nd.Dropout(x, p=0.5)
+    assert_almost_equal(out, 1.0)
+    # training: roughly half zeroed, scaled by 2
+    with autograd.record():
+        out = nd.Dropout(x, p=0.5)
+    arr = out.asnumpy()
+    frac = (arr == 0).mean()
+    assert 0.4 < frac < 0.6
+    nz = arr[arr != 0]
+    assert_almost_equal(nz, 2.0)
+    # mode=always drops at inference too
+    out = nd.Dropout(x, p=0.5, mode="always")
+    assert (out.asnumpy() == 0).mean() > 0.3
+
+
+def test_transpose_swapaxes_etc():
+    x = np.random.rand(2, 3, 4).astype("float32")
+    assert nd.transpose(nd.array(x)).shape == (4, 3, 2)
+    assert nd.transpose(nd.array(x), axes=(0, 2, 1)).shape == (2, 4, 3)
+    assert nd.SwapAxis(nd.array(x), dim1=0, dim2=2).shape == (4, 3, 2)
+    assert nd.expand_dims(nd.array(x), axis=1).shape == (2, 1, 3, 4)
+    assert_almost_equal(nd.reverse(nd.array(x), axis=0), x[::-1])
+
+
+def test_slice_ops():
+    x = np.arange(24, dtype="float32").reshape(4, 6)
+    out = nd.slice(nd.array(x), begin=(1, 2), end=(3, 5))
+    assert_almost_equal(out, x[1:3, 2:5])
+    out = nd.slice_axis(nd.array(x), axis=1, begin=1, end=4)
+    assert_almost_equal(out, x[:, 1:4])
+
+
+def test_elemwise_math():
+    x = np.random.rand(5).astype("float32") + 0.5
+    for name, ref in [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+        ("square", np.square), ("abs", np.abs), ("sin", np.sin),
+        ("cos", np.cos), ("tanh", np.tanh), ("floor", np.floor),
+        ("ceil", np.ceil), ("sign", np.sign), ("log1p", np.log1p),
+        ("expm1", np.expm1), ("rsqrt", lambda v: 1 / np.sqrt(v)),
+    ]:
+        out = getattr(nd, name)(nd.array(x))
+        assert_almost_equal(out, ref(x), rtol=1e-4, atol=1e-5)
+
+
+def test_where():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([10.0, 20.0, 30.0])
+    assert_almost_equal(nd.where(cond, x, y), [1, 20, 3])
+
+
+def test_sequence_ops():
+    data = np.arange(24, dtype="float32").reshape(3, 2, 4)  # (T, N, C)
+    seq_len = nd.array([2.0, 3.0])
+    out = nd.SequenceMask(nd.array(data), seq_len, use_sequence_length=True, value=-1.0)
+    arr = out.asnumpy()
+    assert (arr[2, 0] == -1).all()
+    assert (arr[2, 1] == data[2, 1]).all()
+    last = nd.SequenceLast(nd.array(data), seq_len, use_sequence_length=True)
+    assert_almost_equal(last, np.stack([data[1, 0], data[2, 1]]))
+    rev = nd.SequenceReverse(nd.array(data), seq_len, use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], data[1, 0])
+    assert_almost_equal(rev.asnumpy()[0, 1], data[2, 1])
+
+
+def test_optimizer_ops():
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.2])
+    out = nd.sgd_update(w, g, lr=0.1)
+    assert_almost_equal(out, [0.99, 1.98], rtol=1e-5)
+    mom = nd.zeros(2)
+    out = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    assert_almost_equal(out, [0.99, 1.98], rtol=1e-5)
+    assert_almost_equal(mom, [-0.01, -0.02], rtol=1e-5)  # state mutated in place
+    mean, var = nd.zeros(2), nd.zeros(2)
+    out = nd.adam_update(w, g, mean, var, lr=0.01)
+    assert out.shape == (2,)
+    assert float(mean.asnumpy()[0]) != 0.0
+
+
+def test_norm_ops():
+    x = np.random.rand(2, 3, 4).astype("float32")
+    mean = x.mean(-1, keepdims=True)
+    std = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    # LayerNorm normalises over last axis; gamma indexed along that axis
+    expect = (x - mean) / std * np.ones(4) + 0.0
+    out2 = nd.LayerNorm(nd.array(x), nd.array(np.ones(4, "float32")),
+                        nd.array(np.zeros(4, "float32")), axis=-1)
+    assert_almost_equal(out2, expect, rtol=1e-4, atol=1e-5)
+    out3 = nd.L2Normalization(nd.array(x))
+    flat = x.reshape(2, -1)
+    expect3 = (flat / np.sqrt((flat ** 2).sum(1, keepdims=True) + 1e-10)).reshape(x.shape)
+    assert_almost_equal(out3, expect3, rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution_shape():
+    x = nd.array(np.random.rand(1, 3, 4, 4).astype("float32"))
+    w = nd.array(np.random.rand(3, 2, 3, 3).astype("float32"))
+    out = nd.Deconvolution(x, w, kernel=(3, 3), stride=(2, 2), num_filter=2)
+    # (i-1)*s - 2p + k = 3*2 + 3 = 9
+    assert out.shape == (1, 2, 9, 9)
+    out = nd.Deconvolution(x, w, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           adj=(1, 1), num_filter=2)
+    assert out.shape == (1, 2, 8, 8)
+
+
+def test_regression_outputs():
+    data = nd.array([[1.0, 2.0]])
+    label = nd.array([[0.5, 1.0]])
+    out = nd.LinearRegressionOutput(data, label)
+    assert_almost_equal(out, data.asnumpy())
+    data.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(data, label)
+    out.backward()
+    assert_almost_equal(data.grad, (data.asnumpy() - label.asnumpy()) / 2, rtol=1e-5)
+
+
+def test_upsampling_pad():
+    x = np.arange(4, dtype="float32").reshape(1, 1, 2, 2)
+    out = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest")
+    assert out.shape == (1, 1, 4, 4)
+    assert_almost_equal(out.asnumpy()[0, 0, :2, :2], [[0, 0], [0, 0]])
+    out = nd.Pad(nd.array(x), mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                 constant_value=9.0)
+    assert out.shape == (1, 1, 4, 4)
+    assert out.asnumpy()[0, 0, 0, 0] == 9.0
