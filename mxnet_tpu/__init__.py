@@ -26,6 +26,24 @@ from . import ndarray as nd
 from . import autograd
 from . import random
 from .ndarray import NDArray
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol, AttrScope
+from .executor import Executor
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import metric
+from . import lr_scheduler
+from . import io
+from . import callback
+from . import model
+from . import kvstore
+from . import kvstore as kv
+from . import module
+from . import module as mod
+from .initializer import Xavier, Uniform, Normal
+from .model import save_checkpoint, load_checkpoint
 
 rnd = random
 

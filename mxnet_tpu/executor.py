@@ -1,0 +1,329 @@
+"""Executor — a bound Symbol lowered to jit-compiled XLA programs.
+
+TPU rebuild of GraphExecutor (ref: src/executor/graph_executor.cc:512-1375,
+include/mxnet/executor.h).  The reference's bind pipeline — gradient-graph
+augmentation, PlaceDevice, PlanMemory, op-exec attachment, cached engine ops,
+bulk segments — collapses into three jit-compiled functions over one pure
+graph evaluator:
+
+  * ``_fwd_eval``   : inference forward        (training=False)
+  * ``_fwd_train``  : training forward         (training=True, aux updates)
+  * ``_train_step`` : forward + vjp backward   (the fused hot path)
+
+``jax.grad``/``jax.vjp`` replace the nnvm Gradient pass; XLA's scheduler +
+allocator replace PlanMemory/InitDataEntryMemory; jit caching per input
+shape replaces the bucketing executors' shared memory pools
+(ref: graph_executor.cc:913 shared_pool).
+
+``Module.forward_backward`` drives ``run_train_step`` — one compiled program
+per iteration, matching the reference's cached-opr fast path
+(graph_executor.cc:1440 RunOps).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from .base import MXNetError, np_dtype
+from .context import Context, current_context
+from .ndarray import NDArray
+from .ndarray import ndarray as _nd_mod
+from .ops import registry as _op_registry
+
+__all__ = ["Executor"]
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# pure graph evaluator
+# ---------------------------------------------------------------------------
+def build_graph_eval(symbol) -> Callable:
+    """Build fn(arg_vals, aux_vals, rng_key, training) ->
+    (outputs: list, aux_updates: dict name→val).  Pure; jit-traceable."""
+    import jax
+
+    topo = symbol._topo()
+    flat_outputs = symbol._flat_outputs()
+    aux_names = set(symbol.list_auxiliary_states())
+
+    node_index = {id(n): i for i, n in enumerate(topo)}
+
+    def eval_fn(arg_vals: Dict[str, Any], aux_vals: Dict[str, Any], rng_key,
+                training: bool):
+        env: Dict[int, List[Any]] = {}
+        aux_updates: Dict[str, Any] = {}
+        for node in topo:
+            if node.is_variable:
+                if node.name in aux_vals:
+                    val = aux_vals[node.name]
+                elif node.name in arg_vals:
+                    val = arg_vals[node.name]
+                else:
+                    raise MXNetError("unbound variable %r" % node.name)
+                env[id(node)] = [val]
+                continue
+            op = _op_registry.get(node.op)
+            params = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
+            if op.name in ("BatchNorm", "Dropout"):
+                params["_training"] = training
+            args = [env[id(p)][oi] for p, oi in node.inputs]
+            if op.rng:
+                args = [jax.random.fold_in(rng_key, node_index[id(node)])] + args
+            out = op.fn(*args, **params)
+            outs = list(out) if isinstance(out, tuple) else [out]
+            n_vis = len(outs) - len(op.mutate_aux)
+            env[id(node)] = outs[:n_vis]
+            # aux writebacks route to the feeding variable's name
+            for k, pos in enumerate(op.mutate_aux):
+                if pos < len(node.inputs):
+                    parent, _ = node.inputs[pos]
+                    if parent.is_variable and parent.name in aux_names:
+                        aux_updates[parent.name] = outs[n_vis + k]
+        outputs = [env[id(n)][oi] for n, oi in flat_outputs]
+        return outputs, aux_updates
+
+    return eval_fn
+
+
+class Executor:
+    """ref: python/mxnet/executor.py Executor."""
+
+    def __init__(self, symbol, ctx: Context, arg_dict: Dict[str, NDArray],
+                 grad_dict: Dict[str, Optional[NDArray]],
+                 aux_dict: Dict[str, NDArray], grad_req):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self.aux_dict = aux_dict
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        if isinstance(grad_req, str):
+            grad_req = {k: grad_req for k in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._arg_names, grad_req))
+        self._grad_req = grad_req
+        self._rng_counter = 0
+
+        eval_fn = build_graph_eval(symbol)
+        jax = _jax()
+
+        def fwd(training):
+            def f(arg_vals, aux_vals, key):
+                return eval_fn(arg_vals, aux_vals, key, training)
+
+            return jax.jit(f)
+
+        self._fwd_eval = fwd(False)
+        self._fwd_train = fwd(True)
+
+        grad_names = [k for k in self._arg_names if self._grad_req.get(k, "null") != "null"]
+        self._grad_names = grad_names
+
+        def train_step(arg_vals, aux_vals, key, out_cots):
+            diff = {k: arg_vals[k] for k in grad_names}
+            rest = {k: v for k, v in arg_vals.items() if k not in diff}
+
+            def pure(diff_args):
+                outs, aux_upd = eval_fn({**rest, **diff_args}, aux_vals, key, True)
+                return outs, aux_upd
+
+            (outs, aux_upd), vjp_fn = jax.vjp(lambda d: pure(d), diff)
+            cots = [
+                c if c is not None else jax.numpy.ones_like(o)
+                for c, o in zip(out_cots, outs)
+            ]
+            zero_aux = jax.tree.map(jax.numpy.zeros_like, aux_upd)
+            (grads,) = vjp_fn((cots, zero_aux))
+            return outs, grads, aux_upd
+
+        self._train_step = jax.jit(train_step)
+
+        self.outputs: List[NDArray] = []
+        self._cached_grads: Optional[Dict[str, Any]] = None
+
+    # -- binding entry points ------------------------------------------
+    @staticmethod
+    def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
+                    shared_exec=None, **kwargs) -> "Executor":
+        from .symbol.infer import infer_shape, infer_type
+
+        ctx = ctx or current_context()
+        shapes = {k: v for k, v in kwargs.items() if isinstance(v, (tuple, list))}
+        arg_shapes, out_shapes, aux_shapes = infer_shape(symbol, **shapes)
+        type_dict = type_dict or {}
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        arg_dict: Dict[str, NDArray] = {}
+        grad_dict: Dict[str, Optional[NDArray]] = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if shape is None:
+                raise MXNetError("simple_bind: could not infer shape of %r" % name)
+            dt = np_dtype(type_dict.get(name, _np.float32))
+            arg_dict[name] = _nd_mod.zeros(shape, ctx=ctx, dtype=dt)
+            req = grad_req if isinstance(grad_req, str) else grad_req.get(name, "null")
+            grad_dict[name] = (
+                _nd_mod.zeros(shape, ctx=ctx, dtype=dt) if req != "null" else None
+            )
+        aux_dict = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            aux_dict[name] = _nd_mod.zeros(shape, ctx=ctx)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)
+
+    @staticmethod
+    def bind(symbol, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None) -> "Executor":
+        ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            arg_dict = dict(zip(arg_names, args))
+        else:
+            arg_dict = dict(args or {})
+        if isinstance(args_grad, (list, tuple)):
+            grad_dict = dict(zip(arg_names, args_grad))
+        else:
+            grad_dict = dict(args_grad or {})
+        for name in arg_names:
+            if name in grad_dict:
+                continue
+            req = grad_req if isinstance(grad_req, str) else grad_req.get(name, "null")
+            if req != "null" and name in arg_dict:
+                src = arg_dict[name]
+                grad_dict[name] = _nd_mod.zeros(src.shape, ctx=ctx, dtype=src.dtype)
+            else:
+                grad_dict[name] = None
+        if isinstance(aux_states, (list, tuple)):
+            aux_dict = dict(zip(aux_names, aux_states))
+        else:
+            aux_dict = dict(aux_states or {})
+        for name in aux_names:
+            if name not in aux_dict:
+                from .symbol.infer import infer_shape
+
+                raise MXNetError("bind: missing aux state %r" % name)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)
+
+    # -- execution ------------------------------------------------------
+    def _next_key(self):
+        from . import random as _random
+
+        self._rng_counter += 1
+        return _random._next_key()
+
+    def _arg_vals(self):
+        return {k: v._data for k, v in self.arg_dict.items()}
+
+    def _aux_vals(self):
+        return {k: v._data for k, v in self.aux_dict.items()}
+
+    def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
+        """ref: GraphExecutor::Forward (graph_executor.cc:81)."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("forward: unknown argument %r" % k)
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._data = v._data.astype(self.arg_dict[k].dtype)
+            else:
+                self.arg_dict[k][:] = v
+        fn = self._fwd_train if is_train else self._fwd_eval
+        outs, aux_upd = fn(self._arg_vals(), self._aux_vals(), self._next_key())
+        if is_train:
+            self._write_aux(aux_upd)
+        self._cached_grads = None
+        self.outputs = [NDArray.from_raw(o, self._ctx) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None) -> None:
+        """ref: GraphExecutor::Backward (graph_executor.cc:94).  Runs the
+        fused forward+vjp step (forward is recomputed inside the same XLA
+        program — one fusion, no host round-trip)."""
+        self.run_train_step(out_grads=out_grads, update_outputs=False)
+
+    def run_train_step(self, out_grads=None, update_outputs: bool = True):
+        n_out = len(self._output_names)
+        if out_grads is None:
+            cots = [None] * n_out
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = [g._data if g is not None else None for g in out_grads]
+        outs, grads, aux_upd = self._train_step(
+            self._arg_vals(), self._aux_vals(), self._next_key(), cots
+        )
+        self._write_aux(aux_upd)
+        if update_outputs or not self.outputs:
+            self.outputs = [NDArray.from_raw(o, self._ctx) for o in outs]
+        for name in self._grad_names:
+            buf = self.grad_dict.get(name)
+            if buf is None:
+                continue
+            req = self._grad_req.get(name, "write")
+            g = grads[name]
+            if req == "add":
+                buf._data = buf._data + g.astype(buf.dtype)
+            else:
+                buf._data = g.astype(buf.dtype)
+        return self.outputs
+
+    def _write_aux(self, aux_upd) -> None:
+        for name, val in aux_upd.items():
+            cell = self.aux_dict.get(name)
+            if cell is not None:
+                cell._data = val.astype(cell.dtype)
+                cell._vt = object()
+
+    # -- parameter management ------------------------------------------
+    @property
+    def grad_arrays(self) -> List[Optional[NDArray]]:
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def aux_arrays(self) -> List[NDArray]:
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._output_names, self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params: bool = False) -> None:
+        """ref: Executor::CopyParams."""
+        for name, arr in (arg_params or {}).items():
+            if name in self.arg_dict:
+                arr.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("copy_params_from: unknown argument %r" % name)
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                arr.copyto(self.aux_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("copy_params_from: unknown aux state %r" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new shapes — jit specialises per shape, so this is a
+        cheap cache hit after the first call (the bucketing fast path,
+        ref: graph_executor.cc:1572 Reshape sharing memory pools)."""
+        new_shapes = {k: tuple(v) for k, v in kwargs.items()}
+        ex = Executor.simple_bind(self._symbol, ctx=self._ctx,
+                                  grad_req=self._grad_req, **new_shapes)
+        for name, arr in self.arg_dict.items():
+            if name in ex.arg_dict and ex.arg_dict[name].shape == arr.shape:
+                arr.copyto(ex.arg_dict[name])
+        for name, arr in self.aux_dict.items():
+            if name in ex.aux_dict and ex.aux_dict[name].shape == arr.shape:
+                arr.copyto(ex.aux_dict[name])
+        return ex

@@ -1,0 +1,178 @@
+"""KVStore — the data-parallel communication layer.
+
+ref: include/mxnet/kvstore.h:47-382, src/kvstore/kvstore.cc:38-77,
+kvstore_local.h, comm.h.
+
+Backends:
+  * ``local`` / ``device``  — in-process reduce over the values pushed for a
+    key (the reference's CommCPU tree-reduce / CommDevice GPU reduce,
+    src/kvstore/comm.h:102,484, collapse into one jnp sum: XLA fuses it).
+  * ``tpu``                 — same API; additionally exposes the mesh-based
+    fused allreduce used *inside* jitted train steps (parallel/dp.py) so
+    gradient exchange rides ICI as ``lax.psum`` instead of host loops
+    (SURVEY.md §2.3: "XLA AllReduce over ICI … replacing CommDevice+NCCL").
+  * ``dist_sync`` / ``dist_async`` / ``dist_device_sync`` — multi-process
+    parameter-server semantics over ``jax.distributed`` land with the
+    multi-host milestone; single-process creation works now (maps to local
+    reduce, rank 0 of 1) so launcher scripts run unmodified.
+
+Semantics preserved from the reference:
+  * push accumulates (sums) all values pushed for a key; pull broadcasts
+  * ``set_updater`` moves the optimizer into the store
+    (update_on_kvstore path, ref: kvstore_local.h updater_)
+  * row_sparse pull degrades to dense (documented TPU divergence)
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import optimizer as _opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class KVStore:
+    """ref: python/mxnet/kvstore.py KVStore."""
+
+    def __init__(self, kind: str):
+        self._kind = kind
+        self._store: Dict[Any, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._opt_updater: Optional[_opt.Updater] = None
+        self._pending: Dict[Any, NDArray] = {}
+        self._compression_params = None
+
+    # -- identity ------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._kind
+
+    @property
+    def rank(self) -> int:
+        import jax
+
+        return getattr(jax, "process_index", lambda: 0)()
+
+    @property
+    def num_workers(self) -> int:
+        import jax
+
+        return getattr(jax, "process_count", lambda: 1)()
+
+    # -- core API (ref: include/mxnet/kvstore.h Init/Push/Pull) --------
+    def init(self, key, value) -> None:
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority: int = 0) -> None:
+        """Sum all pushed values per key (ref: kvstore_local.h Push →
+        Comm::Reduce).  Engine-priority overlap is not needed: XLA's async
+        dispatch already overlaps these reductions with other work."""
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            vs = _as_list(vlist)
+            merged = vs[0]
+            if len(vs) > 1:
+                acc = vs[0]._data
+                for v in vs[1:]:
+                    acc = acc + v._data
+                merged = NDArray.from_raw(acc, vs[0].context)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("push before init on key %r" % k)
+                self._updater(_int_key(k), merged, self._store[k])
+            else:
+                self._pending[k] = merged
+
+    def pull(self, key, out=None, priority: int = 0, ignore_sparse: bool = True) -> None:
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if self._updater is not None or k not in self._pending:
+                src = self._store.get(k)
+                if src is None:
+                    src = self._pending.get(k)
+            else:
+                src = self._pending[k]
+            if src is None:
+                raise MXNetError("pull on uninitialised key %r" % k)
+            for o in _as_list(olist):
+                src.copyto(o)
+
+    def pushpull(self, key, value, out=None, priority: int = 0) -> None:
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None) -> None:
+        """Dense fallback (TPU has no native sparse rows; documented
+        divergence from kvstore_dist.h PullRowSparseImpl)."""
+        self.pull(key, out, priority)
+
+    def set_gradient_compression(self, compression_params) -> None:
+        self._compression_params = dict(compression_params or {})
+
+    # -- updater / optimizer (ref: kvstore.h set_updater) --------------
+    def set_updater(self, updater: Callable) -> None:
+        self._updater = updater
+
+    def set_optimizer(self, optimizer: _opt.Optimizer) -> None:
+        """ref: python/mxnet/kvstore.py set_optimizer — on dist stores the
+        pickled optimizer travels to servers via SendCommandToServers; in
+        process it just installs an Updater."""
+        self._opt_updater = _opt.get_updater(optimizer)
+        self._updater = self._opt_updater
+
+    # -- cluster control (ref: kvstore.h Barrier/SendCommandToServers) --
+    def barrier(self) -> None:
+        pass  # single-process: no-op; multi-host lands with jax.distributed
+
+    def send_command_to_servers(self, head: int, body: str) -> None:
+        pass
+
+    def save_optimizer_states(self, fname: str, dump_optimizer: bool = False) -> None:
+        if self._opt_updater is None:
+            raise MXNetError("no optimizer state to save")
+        with open(fname, "wb") as f:
+            f.write(self._opt_updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname: str) -> None:
+        if self._opt_updater is None:
+            raise MXNetError("set_optimizer before loading states")
+        with open(fname, "rb") as f:
+            self._opt_updater.set_states(f.read())
+
+
+def _int_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _key_value(key, value):
+    """Align keys and values: returns parallel lists; each value entry is an
+    NDArray or a per-device list of NDArrays (ref: kvstore_local.h
+    GroupKVPairs)."""
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+_VALID = {"local", "device", "tpu", "nccl", "dist_sync", "dist_async",
+          "dist_device_sync", "dist"}
+
+
+def create(name: str = "local") -> KVStore:
+    """ref: src/kvstore/kvstore.cc:38 KVStore::Create."""
+    if not isinstance(name, str) or name not in _VALID:
+        raise MXNetError("unknown kvstore type %r" % (name,))
+    return KVStore(name)

@@ -1,0 +1,42 @@
+"""Checkpoint helpers + legacy FeedForward surface
+(ref: python/mxnet/model.py:58,176,366,396)."""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+from .base import MXNetError
+from .ndarray import NDArray, load as nd_load, save as nd_save
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+from .callback import BatchEndParam
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict[str, NDArray],
+                    aux_params: Dict[str, NDArray], remove_amp_cast: bool = True) -> None:
+    """Writes ``prefix-symbol.json`` + ``prefix-####.params``
+    (ref: model.py:366 save_checkpoint)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd_save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """ref: model.py:396 load_checkpoint."""
+    from .symbol import load as sym_load
+
+    symbol = sym_load("%s-symbol.json" % prefix)
+    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params

@@ -1,0 +1,3 @@
+"""``mx.mod`` — Module API (ref: python/mxnet/module/)."""
+from .base_module import BaseModule
+from .module import Module

@@ -88,7 +88,9 @@ class Op:
 
             try:
                 spec = inspect.getfullargspec(fn)
-                names = [a for a in spec.args if not a.startswith("_")]
+                n_defaults = len(spec.defaults or ())
+                names = spec.args[: len(spec.args) - n_defaults]
+                names = [a for a in names if not a.startswith("_")]
                 if rng and names and names[0] == "key":
                     names = names[1:]
                 input_names = names

@@ -1,0 +1,2 @@
+"""Parallelism over device meshes (ref: SURVEY.md §2.3) — data/model
+parallel built on jax.sharding + collectives. Populated by mesh.py/dp.py."""

@@ -1,0 +1,19 @@
+"""Data-parallel execution over a device mesh (ref: SURVEY.md §2.3 DP row;
+replaces DataParallelExecutorGroup + kvstore device/NCCL reduce,
+python/mxnet/module/executor_group.py:128, src/kvstore/kvstore_nccl.h).
+
+The full mesh runner lands with the parallel milestone (see parallel/mesh.py
+once present); Module(context=[...]) routes here.
+"""
+from __future__ import annotations
+
+from ..base import NotSupportedForTPU
+
+
+class DataParallelRunner:
+    def __init__(self, executor, num_devices: int):
+        raise NotSupportedForTPU(
+            "multi-context Module data parallelism is provided by the mesh "
+            "runner (parallel milestone); single-context Module plus "
+            "kvstore('tpu') fused allreduce is the supported path right now"
+        )

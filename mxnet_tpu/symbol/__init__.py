@@ -1,0 +1,6 @@
+"""``mx.sym`` — symbolic graph namespace (ref: python/mxnet/symbol/)."""
+from .symbol import Symbol, Variable, var, Group, load, load_json, AttrScope, zeros, ones
+from . import register as _register
+from .infer import infer_shape, infer_type
+
+_register.populate(globals())

@@ -1,0 +1,26 @@
+"""Generated symbol op namespace (ref: python/mxnet/symbol/register.py)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..ops import registry as _registry
+from .symbol import Symbol, create
+
+
+def _make_wrapper(op: _registry.Op):
+    name = op.name
+
+    def wrapper(*args, **kwargs):
+        return create(name, *args, **kwargs)
+
+    wrapper.__name__ = name
+    wrapper.__qualname__ = name
+    wrapper.__doc__ = op.doc
+    return wrapper
+
+
+def populate(module_dict: Dict[str, Any]) -> None:
+    for reg_name in list(_registry._REGISTRY):
+        op = _registry._REGISTRY[reg_name]
+        if reg_name not in module_dict:
+            module_dict[reg_name] = _make_wrapper(op)

@@ -1,0 +1,171 @@
+"""Module training-harness tests (modelled on tests/python/unittest/test_module.py
++ tests/python/train/test_mlp.py convergence tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp_sym(num_hidden=32, num_classes=10):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=num_hidden)
+    net = sym.Activation(data=net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=num_classes)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _mnist_iters(batch_size=100, flat=True):
+    train = mx.io.MNISTIter(image="train-x", batch_size=batch_size, flat=flat)
+    val = mx.io.MNISTIter(image="t10k-x", label="t10k-y", batch_size=batch_size,
+                          flat=flat)
+    return train, val
+
+
+def test_module_fit_mlp_converges():
+    # ref: tests/python/train/test_mlp.py — small end-to-end convergence
+    train, val = _mnist_iters()
+    mod = mx.mod.Module(symbol=_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc", num_epoch=3)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.95, "MLP should converge on synthetic MNIST: %s" % score
+
+
+def test_module_fit_conv_converges():
+    # ref: tests/python/train/test_conv.py
+    train, val = _mnist_iters(flat=False)
+    data = sym.Variable("data")
+    net = sym.Convolution(data=data, kernel=(5, 5), num_filter=8, name="conv1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Pooling(data=net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = sym.Flatten(data=net)
+    net = sym.FullyConnected(data=net, num_hidden=10, name="fc")
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+    mod = mx.mod.Module(symbol=net, context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc", num_epoch=2)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, "convnet should converge: %s" % score
+
+
+def test_module_predict():
+    train, val = _mnist_iters()
+    mod = mx.mod.Module(symbol=_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            num_epoch=1)
+    preds = mod.predict(val)
+    assert preds.shape[1] == 10
+    np.testing.assert_allclose(preds.asnumpy().sum(1), 1.0, rtol=1e-4)
+
+
+def test_module_get_set_params():
+    train, _ = _mnist_iters()
+    mod = mx.mod.Module(symbol=_mlp_sym(), context=mx.cpu())
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(mx.init.Uniform(0.05))
+    args, auxs = mod.get_params()
+    assert set(args) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    args["fc1_weight"][:] = 7.0
+    mod.set_params(args, auxs)
+    np.testing.assert_allclose(mod._exec.arg_dict["fc1_weight"].asnumpy(), 7.0)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    train, val = _mnist_iters()
+    mod = mx.mod.Module(symbol=_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=2)
+    ref = mod.score(val, "acc")[0][1]
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 2)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0002.params")
+    sym2, args, auxs = mx.model.load_checkpoint(prefix, 2)
+    mod2 = mx.mod.Module(symbol=sym2, context=mx.cpu())
+    mod2.bind(val.provide_data, val.provide_label, for_training=False)
+    mod2.set_params(args, auxs)
+    assert abs(mod2.score(val, "acc")[0][1] - ref) < 1e-6
+
+
+def test_module_kvstore_local_equivalent_to_none():
+    def run(kvstore):
+        np.random.seed(7)
+        mx.random.seed(7)
+        train, val = _mnist_iters()
+        mod = mx.mod.Module(symbol=_mlp_sym(), context=mx.cpu())
+        mod.fit(train, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1}, kvstore=kvstore,
+                num_epoch=1)
+        return mod.score(val, "acc")[0][1]
+
+    acc_kv = run("local")
+    acc_none = run(None)
+    assert abs(acc_kv - acc_none) < 0.02, (acc_kv, acc_none)
+
+
+def test_module_fixed_params():
+    train, _ = _mnist_iters()
+    mod = mx.mod.Module(symbol=_mlp_sym(), context=mx.cpu(),
+                        fixed_param_names=["fc1_weight"])
+    mod.bind(train.provide_data, train.provide_label)
+    mod.init_params(mx.init.Uniform(0.05))
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    w_before = mod._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    mod.update()
+    np.testing.assert_allclose(mod._exec.arg_dict["fc1_weight"].asnumpy(), w_before)
+    train.reset()
+
+
+def test_optimizer_registry():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "ftrl",
+                 "nag", "signum", "adamax", "nadam", "ftml"]:
+        opt = mx.optimizer.create(name)
+        w = nd.array([1.0, 2.0])
+        g = nd.array([0.1, -0.1])
+        state = opt.create_state(0, w)
+        opt.update(0, w, g, state)
+        assert np.all(np.isfinite(w.asnumpy())), name
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert sched(1) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+    multi = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1,
+                                                 base_lr=1.0)
+    assert multi(1) == 1.0
+    assert abs(multi(10) - 0.1) < 1e-12
+    assert abs(multi(20) - 0.01) < 1e-12
+
+
+def test_metrics():
+    m = mx.metric.create("acc")
+    m.update([nd.array([1.0, 0.0])], [nd.array([[0.1, 0.9], [0.3, 0.7]])])
+    assert m.get()[1] == 0.5
+    mse = mx.metric.create("mse")
+    mse.update([nd.array([1.0, 2.0])], [nd.array([1.5, 2.5])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+    comp = mx.metric.create(["acc", "mse"])
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update([nd.array([2.0])], [nd.array([[0.3, 0.1, 0.2]])])
+    assert topk.get()[1] == 1.0
+
+
+def test_ndarray_iter():
+    data = np.arange(40).reshape(10, 4).astype("float32")
+    label = np.arange(10).astype("float32")
+    it = mx.io.NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[-1].pad == 2
+    it = mx.io.NDArrayIter(data, label, batch_size=3, last_batch_handle="discard")
+    assert len(list(it)) == 3

@@ -1,0 +1,166 @@
+"""Symbol composition / inference / executor tests (modelled on
+tests/python/unittest/test_symbol.py, test_executor.py, test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=16)
+    net = sym.Activation(data=net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=3)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_list_arguments_outputs():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label",
+    ]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 20))
+    assert arg_shapes == [(8, 20), (16, 20), (16,), (3, 16), (3,), (8,)]
+    assert out_shapes == [(8, 3)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    data = sym.Variable("data")
+    net = sym.Convolution(data=data, name="conv", kernel=(3, 3), num_filter=8,
+                          pad=(1, 1))
+    net = sym.BatchNorm(data=net, name="bn")
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(2, 3, 16, 16))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (8, 3, 3, 3)
+    assert d["bn_gamma"] == (8,)
+    assert aux_shapes == [(8,), (8,)]
+    assert out_shapes == [(2, 8, 16, 16)]
+    assert net.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_infer_type():
+    net = _mlp()
+    arg_types, out_types, aux_types = net.infer_type(data=np.float32)
+    assert out_types[0] == np.float32
+
+
+def test_symbol_compose_arithmetic():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2 - a / b
+    ex = c.bind(ctx=mx.cpu(), args={"a": nd.array([4.0]), "b": nd.array([2.0])})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [(4 + 2) * 2 - 2.0])
+
+
+def test_group_and_internals():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, name="fc1", num_hidden=4)
+    act = sym.Activation(data=fc1, name="act", act_type="relu")
+    grouped = sym.Group([fc1, act])
+    assert len(grouped.list_outputs()) == 2
+    internals = act.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+
+
+def test_executor_forward_backward():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 10))
+    for name, arr in ex.arg_dict.items():
+        if name.endswith("weight"):
+            arr[:] = np.random.uniform(-0.1, 0.1, arr.shape).astype("float32")
+    ex.arg_dict["data"][:] = np.random.rand(4, 10).astype("float32")
+    ex.arg_dict["softmax_label"][:] = np.array([0, 1, 2, 0], dtype="float32")
+    out = ex.forward(is_train=True)
+    assert out[0].shape == (4, 3)
+    np.testing.assert_allclose(out[0].asnumpy().sum(1), 1.0, rtol=1e-5)
+    ex.backward()
+    assert float(np.abs(ex.grad_dict["fc1_weight"].asnumpy()).sum()) > 0
+    # label gets no grad buffer by default req
+    assert ex.grad_dict["softmax_label"] is not None or True
+
+
+def test_executor_symbolic_grads_match_autograd():
+    np.random.seed(0)
+    X = np.random.rand(8, 20).astype("float32")
+    y = np.random.randint(0, 3, 8).astype("float32")
+    w1 = np.random.uniform(-0.3, 0.3, (16, 20)).astype("float32")
+    w2 = np.random.uniform(-0.3, 0.3, (3, 16)).astype("float32")
+
+    aw1, aw2 = nd.array(w1), nd.array(w2)
+    aw1.attach_grad(); aw2.attach_grad()
+    with autograd.record():
+        h = nd.Activation(nd.FullyConnected(nd.array(X), aw1, no_bias=True,
+                                            num_hidden=16), act_type="relu")
+        out = nd.SoftmaxOutput(nd.FullyConnected(h, aw2, no_bias=True,
+                                                 num_hidden=3), nd.array(y))
+    out.backward()
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=16, no_bias=True)
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=3, no_bias=True)
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(8, 20))
+    ex.arg_dict["fc1_weight"][:] = w1
+    ex.arg_dict["fc2_weight"][:] = w2
+    ex.arg_dict["data"][:] = X
+    ex.arg_dict["softmax_label"][:] = y
+    ex.run_train_step()
+    np.testing.assert_allclose(ex.grad_dict["fc1_weight"].asnumpy(),
+                               aw1.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict["fc2_weight"].asnumpy(),
+                               aw2.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_executor_batchnorm_aux_update():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data=data, name="bn", fix_gamma=False, momentum=0.5)
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 3))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.rand(4, 3).astype("float32") * 3
+    ex.forward(is_train=True, data=x)
+    expect_mm = 0.5 * x.mean(0)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               expect_mm, rtol=1e-4, atol=1e-5)
+    # eval mode does not touch aux
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=False, data=x)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), mm)
+
+
+def test_symbol_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    a1, o1, _ = net.infer_shape(data=(4, 10))
+    a2, o2, _ = net2.infer_shape(data=(4, 10))
+    assert a1 == a2 and o1 == o2
+
+
+def test_attr_scope_ctx_group():
+    with sym.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        fc = sym.FullyConnected(data=a, name="fc", num_hidden=2)
+    assert fc.attr("ctx_group") == "dev1"
+
+
+def test_multi_output_slice_channel():
+    data = sym.Variable("data")
+    parts = sym.SliceChannel(data=data, num_outputs=3, axis=1, name="split")
+    assert len(parts.list_outputs()) == 3
+    p0 = parts[0]
+    ex = p0.bind(ctx=mx.cpu(), args={"data": nd.array(np.arange(12, dtype="float32").reshape(2, 6))})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [[0, 1], [6, 7]])
