@@ -1,0 +1,9 @@
+"""Gluon — imperative NN API (ref: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import data
+from . import utils
+from .utils import split_and_load

@@ -1,0 +1,105 @@
+"""DataLoader (ref: python/mxnet/gluon/data/dataloader.py:72-113).
+
+The reference forks worker processes and rebuilds NDArrays over POSIX shm
+(cpu_shared_storage_manager.h).  Host-side batching here is numpy; with
+``num_workers > 0`` batches are assembled by a thread pool (threads, not
+forks: the JAX runtime is not fork-safe, and batch assembly is
+numpy-bound which releases the GIL).  The device transfer happens once per
+batch at the end — the same pattern as the reference's pinned-memory copy.
+"""
+from __future__ import annotations
+
+import threading
+import queue as _queue
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as _np
+
+from ...ndarray import NDArray, array as nd_array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """ref: dataloader.py default_batchify_fn."""
+    if isinstance(data[0], NDArray):
+        return nd_array(_np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    arr = _np.asarray(data)
+    return nd_array(arr)
+
+
+class DataLoader:
+    """ref: dataloader.py DataLoader."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size is required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with a custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError("batch_sampler is mutually exclusive with "
+                             "batch_size/shuffle/sampler/last_batch")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, int(num_workers))
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        batches = list(self._batch_sampler)
+        out_q: List[Optional[Any]] = [None] * len(batches)
+        events = [threading.Event() for _ in batches]
+        lock = threading.Lock()
+        next_job = [0]
+
+        def worker():
+            while True:
+                with lock:
+                    j = next_job[0]
+                    if j >= len(batches):
+                        return
+                    next_job[0] = j + 1
+                try:
+                    out_q[j] = ("ok", self._make_batch(batches[j]))
+                except BaseException as e:  # surfaced to the consumer
+                    out_q[j] = ("err", e)
+                events[j].set()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        for j in range(len(batches)):
+            events[j].wait()
+            status, payload = out_q[j]
+            out_q[j] = None
+            if status == "err":
+                raise payload
+            yield payload

@@ -1,0 +1,111 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ....ndarray import NDArray, array as nd_array
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
+           "Resize", "RandomFlipLeftRight", "CenterCrop"]
+
+
+class Compose(Sequential):
+    """ref: transforms.py Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (ref: transforms.py ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        out = F.Cast(x, dtype="float32") * (1.0 / 255.0)
+        return F.transpose(out, axes=(2, 0, 1)) if out.ndim == 3 else \
+            F.transpose(out, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = _np.asarray(self._mean, dtype="float32").reshape(-1, 1, 1)
+        std = _np.asarray(self._std, dtype="float32").reshape(-1, 1, 1)
+        return (x - nd_array(mean)) / nd_array(std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax
+
+        arr = x._data.astype("float32")
+        hwc = arr.ndim == 3
+        if hwc:
+            out = jax.image.resize(arr, self._size + (arr.shape[2],), "bilinear")
+        else:
+            out = jax.image.resize(arr, (arr.shape[0],) + self._size + (arr.shape[3],),
+                                   "bilinear")
+        return NDArray.from_raw(out.astype(x._data.dtype), x.ctx)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        h, w = x.shape[0], x.shape[1]
+        th, tw = self._size
+        oy, ox = max(0, (h - th) // 2), max(0, (w - tw) // 2)
+        return x[oy : oy + th, ox : ox + tw]
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return x.flip(axis=1 if x.ndim == 3 else 2)
+        return x
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        h, w = x.shape[0], x.shape[1]
+        area = h * w
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            aspect = _np.random.uniform(*self._ratio)
+            new_w = int(round((target_area * aspect) ** 0.5))
+            new_h = int(round((target_area / aspect) ** 0.5))
+            if new_w <= w and new_h <= h:
+                ox = _np.random.randint(0, w - new_w + 1)
+                oy = _np.random.randint(0, h - new_h + 1)
+                crop = x[oy : oy + new_h, ox : ox + new_w]
+                return Resize(self._size)(crop)
+        return Resize(self._size)(CenterCrop(min(h, w))(x))

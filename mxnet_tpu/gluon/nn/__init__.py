@@ -1,0 +1,7 @@
+"""``gluon.nn`` layers (ref: python/mxnet/gluon/nn/)."""
+from .basic_layers import *
+from .conv_layers import *
+from .basic_layers import __all__ as _basic_all
+from .conv_layers import __all__ as _conv_all
+
+__all__ = list(_basic_all) + list(_conv_all)

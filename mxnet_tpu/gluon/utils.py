@@ -1,0 +1,90 @@
+"""Gluon utilities (ref: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Union
+
+import numpy as _np
+
+from ..context import Context
+from ..ndarray import NDArray, array as nd_array
+from ..ndarray import ndarray as _nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    """ref: utils.py split_data."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d" % (data.shape, num_slice, batch_axis)
+        )
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list: Sequence[Context], batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    """ref: utils.py split_and_load."""
+    if not isinstance(data, NDArray):
+        data = nd_array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: Sequence[NDArray], max_norm: float,
+                     check_isfinite: bool = True) -> float:
+    """ref: utils.py clip_global_norm."""
+    assert len(arrays) > 0
+    norms = []
+    for arr in arrays:
+        n2 = _nd.invoke("sum", [_nd.invoke("square", [arr])])
+        norms.append(n2)
+    total_sq = norms[0]
+    for n in norms[1:]:
+        total_sq = total_sq + n
+    total_norm = float(total_sq.asnumpy() ** 0.5)
+    if check_isfinite and not math.isfinite(total_norm):
+        import warnings
+
+        warnings.warn("nan or inf found in gradients; clip_global_norm skipped")
+        return total_norm
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._assign(arr * scale)
+    return total_norm
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Zero-egress environment: downloads are unavailable; datasets fall
+    back to deterministic synthetic data (see gluon/data/vision)."""
+    raise RuntimeError(
+        "download() unavailable in this environment (no network egress); "
+        "use the synthetic dataset fallbacks"
+    )

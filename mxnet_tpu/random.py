@@ -23,6 +23,7 @@ __all__ = ["seed", "uniform", "normal", "randint"]
 _lock = threading.Lock()
 _root_key = None
 _counter = 0
+_TRACE = threading.local()
 
 
 def _jax():
@@ -39,9 +40,34 @@ def seed(seed_state: int, ctx=None) -> None:
         _counter = 0
 
 
+class trace_key_scope:
+    """While active, ``_next_key`` derives subkeys from ``key`` instead of
+    the global root.  Used by traced code (CachedOp, executors): the key is
+    a traced *input*, so randomness stays fresh across calls of one compiled
+    program instead of being constant-folded at trace time."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        stack = getattr(_TRACE, "stack", None)
+        if stack is None:
+            stack = _TRACE.stack = []
+        stack.append([self._key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _TRACE.stack.pop()
+
+
 def _next_key():
-    global _root_key, _counter
     jax = _jax()
+    stack = getattr(_TRACE, "stack", None)
+    if stack:
+        entry = stack[-1]
+        entry[1] += 1
+        return jax.random.fold_in(entry[0], entry[1])
+    global _root_key, _counter
     with _lock:
         if _root_key is None:
             _root_key = jax.random.PRNGKey(0)

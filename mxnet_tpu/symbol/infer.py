@@ -159,22 +159,30 @@ def _infer_walk(symbol, known_shapes: Dict[str, Tuple[int, ...]],
         params = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
         in_names = op.input_names or tuple("arg%d" % i for i in range(len(node.inputs)))
 
-        # map known input shapes by name; run the param rule for unknowns
+        # map known input shapes by name; run the param rule for unknown or
+        # partially-known (0-dim, the deferred-init marker) shapes
+        def _incomplete(sh):
+            return sh is None or any(d == 0 for d in sh)
+
         named_shapes = {}
         for (parent, oi), iname in zip(node.inputs, in_names):
             sh, _dt = node_out[id(parent)][oi]
             named_shapes[iname] = sh
         rule = PARAM_SHAPE_RULES.get(op.name)
-        if rule and any(v is None for v in named_shapes.values()):
+        if rule and any(_incomplete(v) for v in named_shapes.values()):
             derived = rule(params, named_shapes)
             for (parent, oi), iname in zip(node.inputs, in_names):
-                if named_shapes.get(iname) is None and iname in derived:
-                    shape = tuple(int(x) for x in derived[iname])
+                cur = named_shapes.get(iname)
+                if _incomplete(cur) and iname in derived:
+                    new = tuple(int(x) for x in derived[iname])
+                    if cur is not None and len(cur) == len(new):
+                        # keep user-pinned dims, fill only the 0 markers
+                        new = tuple(c if c > 0 else n for c, n in zip(cur, new))
                     old = node_out[id(parent)][oi]
-                    node_out[id(parent)][oi] = (shape, old[1])
+                    node_out[id(parent)][oi] = (new, old[1])
                     if parent.is_variable:
                         var_info[parent.name] = node_out[id(parent)][oi]
-                    named_shapes[iname] = shape
+                    named_shapes[iname] = new
 
         in_specs = []
         missing = []

@@ -1,0 +1,270 @@
+"""Gluon tests (modelled on tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.init.Uniform(0.1))
+    assert p.data().shape == (3, 4)
+    assert p.grad().shape == (3, 4)
+    p.set_data(nd.ones((3, 4)))
+    np.testing.assert_allclose(p.data().asnumpy(), 1.0)
+    p.zero_grad()
+    np.testing.assert_allclose(p.grad().asnumpy(), 0.0)
+
+
+def test_parameter_deferred_init():
+    d = nn.Dense(8)
+    d.initialize()
+    # shape unknown until forward
+    with pytest.raises(gluon.DeferredInitializationError):
+        d.weight.data()
+    out = d(nd.ones((2, 5)))
+    assert d.weight.shape == (8, 5)
+    assert out.shape == (2, 8)
+
+
+def test_block_naming_and_collect():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4))
+        net.add(nn.Dense(2))
+    names = sorted(net.collect_params().keys())
+    assert names == ["model_dense0_bias", "model_dense0_weight",
+                     "model_dense1_bias", "model_dense1_weight"]
+    sel = net.collect_params(".*weight")
+    assert sorted(sel.keys()) == ["model_dense0_weight", "model_dense1_weight"]
+
+
+def test_dense_forward_values():
+    d = nn.Dense(3, use_bias=True, in_units=4)
+    d.initialize(mx.init.One())
+    out = d(nd.ones((2, 4)))
+    # bias_initializer='zero' default wins over the global initializer
+    # (reference Parameter.init precedence)
+    np.testing.assert_allclose(out.asnumpy(), 4.0)
+    d2 = nn.Dense(3, use_bias=True, in_units=4, bias_initializer="one")
+    d2.initialize(mx.init.One())
+    np.testing.assert_allclose(d2(nd.ones((2, 4))).asnumpy(), 5.0)
+
+
+def test_conv2d_pool():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, kernel_size=3, padding=1, in_channels=2))
+    net.add(nn.MaxPool2D(2))
+    net.initialize()
+    out = net(nd.ones((1, 2, 8, 8)))
+    assert out.shape == (1, 4, 4, 4)
+
+
+def test_conv_transpose():
+    c = nn.Conv2DTranspose(3, kernel_size=3, strides=2, in_channels=2)
+    c.initialize()
+    out = c(nd.ones((1, 2, 4, 4)))
+    assert out.shape == (1, 3, 9, 9)
+
+
+def test_hybridize_matches_eager():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(3, 7).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grads_match_eager():
+    np.random.seed(1)
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh"))
+            net.add(nn.Dense(2))
+        return net
+
+    x = nd.array(np.random.rand(4, 5).astype("float32"))
+    y = nd.array(np.array([0, 1, 0, 1], dtype="float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    grads = []
+    for hybrid in (False, True):
+        np.random.seed(2)
+        net = build()
+        net.initialize(mx.init.Xavier())
+        if hybrid:
+            net.hybridize()
+        with autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        grads.append({k: p.grad().asnumpy() for k, p in net.collect_params().items()
+                      if p.grad_req != "null"})
+    for k in grads[0]:
+        k2 = k.replace("hybridsequential", "")  # prefixes differ by counter
+    vals0 = sorted(grads[0].items())
+    vals1 = sorted(grads[1].items())
+    for (_, g0), (_, g1) in zip(vals0, vals1):
+        np.testing.assert_allclose(g0, g1, rtol=1e-4, atol=1e-6)
+
+
+def test_batchnorm_running_stats_hybrid():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.BatchNorm(in_channels=3))
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.rand(4, 3, 2, 2).astype("float32") * 5)
+    with autograd.record():
+        net(x)
+    rm = net[0].running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0, "running mean must update through CachedOp"
+    # eval forward does not change stats
+    before = rm.copy()
+    net(x)
+    np.testing.assert_allclose(net[0].running_mean.data().asnumpy(), before)
+
+
+def test_trainer_step():
+    net = nn.Dense(2, in_units=3)
+    net.initialize(mx.init.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 1.0})
+    x = nd.ones((4, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+    # grad of sum wrt weight = sum over batch of x = 4 per element; /4 → 1
+    np.testing.assert_allclose(net.weight.data().asnumpy(), 0.0, atol=1e-6)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.ones((2, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer.load_states(fname)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize(mx.init.Uniform(0.5))
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3))
+    net2.load_parameters(fname)
+    np.testing.assert_allclose(net[0].weight.data().asnumpy(),
+                               net2[0].weight.data().asnumpy())
+
+
+def test_losses():
+    pred = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = nd.array([[1.5, 2.5], [2.5, 3.5]])
+    l2 = gluon.loss.L2Loss()(pred, label)
+    np.testing.assert_allclose(l2.asnumpy(), 0.125 * np.ones(2), rtol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, label)
+    np.testing.assert_allclose(l1.asnumpy(), 0.5 * np.ones(2), rtol=1e-5)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    out = sce(nd.array([[10.0, 0.0]]), nd.array([0.0]))
+    assert float(out.asnumpy()[0]) < 0.01
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    out = bce(nd.array([[10.0]]), nd.array([[1.0]]))
+    assert float(out.asnumpy()[0]) < 0.01
+    huber = gluon.loss.HuberLoss()(pred, label)
+    assert huber.shape == (2,)
+    kl = gluon.loss.KLDivLoss()(nd.log_softmax(pred), nd.softmax(label))
+    assert kl.shape == (2,)
+
+
+def test_dataset_dataloader():
+    X = np.random.rand(10, 3).astype("float32")
+    y = np.arange(10).astype("float32")
+    ds = gluon.data.ArrayDataset(X, y)
+    assert len(ds) == 10
+    x0, y0 = ds[0]
+    loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 3)
+    assert batches[2][0].shape == (2, 3)
+    loader = gluon.data.DataLoader(ds, batch_size=4, last_batch="discard")
+    assert len(list(loader)) == 2
+    # threaded workers produce identical batches in order
+    loader = gluon.data.DataLoader(ds, batch_size=4, num_workers=2)
+    b2 = list(loader)
+    np.testing.assert_allclose(b2[0][0].asnumpy(), batches[0][0].asnumpy())
+
+
+def test_vision_mnist_dataset():
+    ds = gluon.data.vision.MNIST(train=False)
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert img.dtype == np.uint8
+    assert 0 <= label <= 9
+
+
+def test_split_and_load():
+    data = nd.array(np.arange(12).reshape(6, 2).astype("float32"))
+    parts = gluon.utils.split_data(data, 3)
+    assert [p.shape for p in parts] == [(2, 2)] * 3
+    loaded = gluon.utils.split_and_load(data, [mx.cpu(), mx.cpu()])
+    assert len(loaded) == 2
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_embedding_block():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([1, 3, 5]))
+    assert out.shape == (3, 4)
+
+
+def test_dropout_hybrid_fresh_masks():
+    net = nn.Dropout(0.5)
+    net.hybridize()
+    x = nd.ones((100,))
+    with autograd.record():
+        a = net(x).asnumpy()
+        b = net(x).asnumpy()
+    # different rng keys per call through the traced program
+    assert not np.allclose(a, b), "dropout masks must differ across calls"
+
+
+def test_symbol_block_import(tmp_path):
+    # export a hybrid net, re-import as SymbolBlock
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize(mx.init.Uniform(0.3))
+    path = str(tmp_path / "exported")
+    net.export(path)
+    block = gluon.SymbolBlock.imports(path + "-symbol.json", "data",
+                                      path + "-0000.params")
+    x = nd.ones((2, 3))
+    np.testing.assert_allclose(block(x).asnumpy(), net(x).asnumpy(),
+                               rtol=1e-5)
