@@ -1,0 +1,64 @@
+"""Benchmark: ResNet-50 v1 training throughput, single chip.
+
+Baseline: 109 images/sec — the reference's published ResNet-50 training
+speed on 1x K80, batch 32, fp32
+(ref: /root/reference/example/image-classification/README.md:149-156,
+reproduced in BASELINE.md).
+
+Measures the fused train step (forward + loss + backward + SGD momentum
+update in one XLA program) at batch 32 fp32 to match the baseline's
+training configuration.  Prints ONE JSON line.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.dp import FusedTrainStep
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    import jax
+
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    batch = 32
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    mesh = make_mesh((1,), ("dp",), jax.devices()[:1])
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, learning_rate=0.05, momentum=0.9)
+
+    X = nd.random.uniform(shape=(batch, 3, 224, 224))
+    y = nd.array(np.random.randint(0, 1000, batch).astype("float32"))
+
+    # warmup / compile
+    for _ in range(3):
+        loss, _ = step(X, y)
+    loss.wait_to_read()
+
+    iters = 20
+    t0 = time.time()
+    for _ in range(iters):
+        loss, _ = step(X, y)
+    loss.wait_to_read()
+    dt = time.time() - t0
+
+    images_per_sec = iters * batch / dt
+    baseline = 109.0  # K80 fp32 batch 32 (BASELINE.md)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,4 +6,5 @@ from . import nn
 from . import loss
 from . import data
 from . import utils
+from . import model_zoo
 from .utils import split_and_load

@@ -78,12 +78,17 @@ class DataLoader:
         events = [threading.Event() for _ in batches]
         lock = threading.Lock()
         next_job = [0]
+        # backpressure: workers stay at most `prefetch` batches ahead of the
+        # consumer (ref: iter_prefetcher.h bounded double buffering)
+        budget = threading.Semaphore(max(self._prefetch, self._num_workers))
 
         def worker():
             while True:
+                budget.acquire()
                 with lock:
                     j = next_job[0]
                     if j >= len(batches):
+                        budget.release()
                         return
                     next_job[0] = j + 1
                 try:
@@ -100,6 +105,7 @@ class DataLoader:
             events[j].wait()
             status, payload = out_q[j]
             out_q[j] = None
+            budget.release()
             if status == "err":
                 raise payload
             yield payload

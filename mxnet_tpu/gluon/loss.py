@@ -196,7 +196,7 @@ class CosineEmbeddingLoss(Loss):
         self._margin = margin
 
     def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
-        input1 = input1.reshape((0, -1)) if hasattr(input1, "reshape") else input1
+        input1 = _reshape_like(F, input1, input2)
         cos = F.sum(input1 * input2, axis=-1) / (
             F.norm(input1, axis=-1) * F.norm(input2, axis=-1) + 1e-12
         )

@@ -178,25 +178,31 @@ class BatchNorm(HybridBlock):
         self._kwargs = {"axis": axis, "eps": epsilon, "momentum": momentum,
                         "fix_gamma": not scale,
                         "use_global_stats": use_global_stats}
+
+        def _resolve(init, default):
+            if init is None:
+                return default
+            return _init.create(init) if isinstance(init, str) else init
+
         with self.name_scope():
-            self.gamma = self.params.get("gamma",
-                                         grad_req="write" if scale else "null",
-                                         shape=(in_channels,), init=_init.One(),
-                                         allow_deferred_init=True)
-            self.beta = self.params.get("beta",
-                                        grad_req="write" if center else "null",
-                                        shape=(in_channels,), init=_init.Zero(),
-                                        allow_deferred_init=True)
-            self.running_mean = self.params.get("running_mean", grad_req="null",
-                                                shape=(in_channels,),
-                                                init=_init.Zero(),
-                                                allow_deferred_init=True,
-                                                differentiable=False)
-            self.running_var = self.params.get("running_var", grad_req="null",
-                                               shape=(in_channels,),
-                                               init=_init.One(),
-                                               allow_deferred_init=True,
-                                               differentiable=False)
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,),
+                init=_resolve(gamma_initializer, _init.One()),
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,),
+                init=_resolve(beta_initializer, _init.Zero()),
+                allow_deferred_init=True)
+            self.running_mean = self.params.get(
+                "running_mean", grad_req="null", shape=(in_channels,),
+                init=_resolve(running_mean_initializer, _init.Zero()),
+                allow_deferred_init=True, differentiable=False)
+            self.running_var = self.params.get(
+                "running_var", grad_req="null", shape=(in_channels,),
+                init=_resolve(running_variance_initializer, _init.One()),
+                allow_deferred_init=True, differentiable=False)
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         return F.BatchNorm(x, gamma, beta, running_mean, running_var,
@@ -230,14 +236,14 @@ class LayerNorm(HybridBlock):
         self._axis = axis
         self._epsilon = epsilon
         with self.name_scope():
-            self.gamma = self.params.get("gamma",
-                                         grad_req="write" if scale else "null",
-                                         shape=(in_channels,), init=_init.One(),
-                                         allow_deferred_init=True)
-            self.beta = self.params.get("beta",
-                                        grad_req="write" if center else "null",
-                                        shape=(in_channels,), init=_init.Zero(),
-                                        allow_deferred_init=True)
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=_init.create(gamma_initializer),
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=_init.create(beta_initializer),
+                allow_deferred_init=True)
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._epsilon)
@@ -245,18 +251,19 @@ class LayerNorm(HybridBlock):
 
 class InstanceNorm(HybridBlock):
     def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zero", gamma_initializer="one",
                  in_channels=0, **kwargs):
         super().__init__(**kwargs)
         self._epsilon = epsilon
         with self.name_scope():
-            self.gamma = self.params.get("gamma",
-                                         grad_req="write" if scale else "null",
-                                         shape=(in_channels,), init=_init.One(),
-                                         allow_deferred_init=True)
-            self.beta = self.params.get("beta",
-                                        grad_req="write" if center else "null",
-                                        shape=(in_channels,), init=_init.Zero(),
-                                        allow_deferred_init=True)
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=_init.create(gamma_initializer),
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=_init.create(beta_initializer),
+                allow_deferred_init=True)
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)

@@ -44,10 +44,10 @@ class Trainer:
             idx2name = {i: p.name for i, p in enumerate(self._params)}
             self._optimizer = _opt.create(optimizer, param_idx2name=idx2name,
                                           **optimizer_params)
-        self._optimizer.set_lr_mult({i: self._params[i].lr_mult
-                                     for i in range(len(self._params))})
-        self._optimizer.set_wd_mult({i: self._params[i].wd_mult
-                                     for i in range(len(self._params))})
+        # name-keyed so per-param settings override set_wd_mult's seeded
+        # bias/gamma/beta zero defaults (optimizer._get_wd resolves by name)
+        self._optimizer.set_lr_mult({p.name: p.lr_mult for p in self._params})
+        self._optimizer.set_wd_mult({p.name: p.wd_mult for p in self._params})
         self._updater = _opt.get_updater(self._optimizer)
 
         self._kvstore: Optional[KVStore] = None

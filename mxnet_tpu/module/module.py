@@ -148,6 +148,7 @@ class Module(BaseModule):
             from ..parallel.dp import DataParallelRunner
 
             self._dp = DataParallelRunner(self._exec, self._num_device)
+            self._dp.set_input_names(self._data_names, self._label_names)
 
     # ------------------------------------------------------------------
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
@@ -254,7 +255,14 @@ class Module(BaseModule):
         if data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
-        self._exec.forward(is_train=is_train, **feed)
+        for k, v in feed.items():
+            if isinstance(v, NDArray):
+                self._exec.arg_dict[k]._data = v._data.astype(self._exec.arg_dict[k].dtype)
+            else:
+                self._exec.arg_dict[k][:] = v
+        if self._dp is not None:
+            self._dp.place()
+        self._exec.forward(is_train=is_train)
 
     def forward_backward(self, data_batch):
         """Fused fast path: one XLA program computes outputs + grads
@@ -271,6 +279,10 @@ class Module(BaseModule):
                 self._exec.arg_dict[k]._data = v._data.astype(self._exec.arg_dict[k].dtype)
             else:
                 self._exec.arg_dict[k][:] = v
+        if self._dp is not None:
+            # shard batch / replicate params over the ICI mesh; XLA inserts
+            # the gradient allreduce inside the compiled step
+            self._dp.place()
         self._exec.run_train_step()
 
     def backward(self, out_grads=None):

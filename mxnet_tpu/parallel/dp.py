@@ -1,19 +1,250 @@
-"""Data-parallel execution over a device mesh (ref: SURVEY.md §2.3 DP row;
-replaces DataParallelExecutorGroup + kvstore device/NCCL reduce,
-python/mxnet/module/executor_group.py:128, src/kvstore/kvstore_nccl.h).
+"""Data-parallel execution over a device mesh.
 
-The full mesh runner lands with the parallel milestone (see parallel/mesh.py
-once present); Module(context=[...]) routes here.
+TPU rebuild of the reference's data-parallel machinery (SURVEY.md §2.3):
+DataParallelExecutorGroup batch slicing (python/mxnet/module/
+executor_group.py:128,266-288), kvstore 'device' tree-reduce
+(src/kvstore/comm.h:484) and KVStoreNCCL ring allreduce
+(src/kvstore/kvstore_nccl.h:281).
+
+Design ("computation follows data"): the batch is sharded over the mesh's
+``dp`` axis, parameters are replicated; XLA's SPMD partitioner then emits
+the gradient AllReduce over ICI automatically inside the compiled step —
+gradient exchange is fused INTO the backward pass, overlapping with it,
+which is what the reference approximated with engine priorities
+(python/mxnet/gluon/trainer.py:190).
+
+Two entry points:
+  * ``DataParallelRunner`` — shards an Executor's data inputs so
+    ``Module(context=[...])`` trains SPMD with unchanged code.
+  * ``FusedTrainStep``    — whole-step compilation for a gluon block:
+    forward + loss + backward + fused optimizer in ONE XLA program (the
+    kvstore('tpu') fast path; also the bench harness).
 """
 from __future__ import annotations
 
-from ..base import NotSupportedForTPU
+from .. import autograd
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .mesh import make_mesh
+
+__all__ = ["DataParallelRunner", "FusedTrainStep", "shard_batch", "replicate"]
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _shardings(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("dp")), NamedSharding(mesh, P())
+
+
+def shard_batch(arr, mesh):
+    """Place an array batch-sharded over the mesh's dp axis."""
+    jax = _jax()
+    data_sh, _ = _shardings(mesh)
+    if isinstance(arr, NDArray):
+        arr._data = jax.device_put(arr._data, data_sh)
+        return arr
+    return jax.device_put(arr, data_sh)
+
+
+def replicate(arr, mesh):
+    jax = _jax()
+    _, rep = _shardings(mesh)
+    if isinstance(arr, NDArray):
+        arr._data = jax.device_put(arr._data, rep)
+        return arr
+    return jax.device_put(arr, rep)
 
 
 class DataParallelRunner:
-    def __init__(self, executor, num_devices: int):
-        raise NotSupportedForTPU(
-            "multi-context Module data parallelism is provided by the mesh "
-            "runner (parallel milestone); single-context Module plus "
-            "kvstore('tpu') fused allreduce is the supported path right now"
+    """Shards an Executor's data/label cells over the dp axis and
+    replicates everything else (ref: executor_group.py decide_slices —
+    except slicing becomes sharding metadata, not copies)."""
+
+    def __init__(self, executor, num_devices: int, data_names=None,
+                 label_names=None):
+        jax = _jax()
+        if num_devices > len(jax.devices()):
+            raise MXNetError(
+                "requested %d devices, runtime has %d"
+                % (num_devices, len(jax.devices()))
+            )
+        self.mesh = make_mesh((num_devices,), ("dp",),
+                              jax.devices()[:num_devices])
+        self._executor = executor
+        self._data_names = set(data_names or ())
+        self._label_names = set(label_names or ())
+
+    def set_input_names(self, data_names, label_names):
+        self._data_names = set(data_names)
+        self._label_names = set(label_names)
+
+    def place(self) -> None:
+        """(Re)apply shardings to the executor's live cells."""
+        jax = _jax()
+        data_sh, rep = _shardings(self.mesh)
+        batch_names = self._data_names | self._label_names
+        for name, cell in self._executor.arg_dict.items():
+            sh = data_sh if name in batch_names else rep
+            cell._data = jax.device_put(cell._data, sh)
+        for cell in self._executor.aux_dict.values():
+            cell._data = jax.device_put(cell._data, rep)
+
+
+class FusedTrainStep:
+    """One compiled XLA program per step: forward + loss + backward +
+    optimizer update, gradients reduced over ICI by the SPMD partitioner.
+
+    This is the structural equivalent of the reference's fully-cached
+    GraphExecutor fast path (InitCachedOps + bulk segments + kvstore push),
+    collapsed into a single jit.  Used by bench.py and dryrun_multichip.
+
+    Parameters
+    ----------
+    block : initialized gluon HybridBlock
+    loss_fn : gluon Loss block
+    mesh : jax Mesh with a ``dp`` axis (optional extra axes for tp)
+    optimizer : 'sgd' only fast-fused here (momentum supported)
+    param_spec_fn : optional fn(param_name, shape) -> PartitionSpec for
+        tensor-parallel parameter sharding over non-dp axes (ctx_group's
+        TPU successor; see SURVEY.md §2.3 model-parallel row).
+    """
+
+    def __init__(self, block, loss_fn, mesh=None, learning_rate=0.05,
+                 momentum=0.9, weight_decay=0.0, param_spec_fn=None,
+                 dtype=None):
+        jax = _jax()
+        self.mesh = mesh if mesh is not None else make_mesh((1,), ("dp",),
+                                                            jax.devices()[:1])
+        self._block = block
+        self._loss_fn = loss_fn
+        self._learning_rate = learning_rate
+        self._momentum_cfg = momentum
+        self._weight_decay = weight_decay
+        self._param_spec_fn = param_spec_fn
+        self._built = False
+
+    def _build(self, sample_data):
+        """Finish deferred param shapes with one eager forward, then compile
+        the fused step (first call only)."""
+        jax = _jax()
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..gluon.block import CachedOp
+
+        block, loss_fn = self._block, self._loss_fn
+        param_spec_fn = self._param_spec_fn
+        learning_rate = self._learning_rate
+        momentum = self._momentum_cfg
+        weight_decay = self._weight_decay
+        with autograd.pause():
+            block(sample_data)  # settles deferred initialization
+        self._cached = CachedOp(block)
+        self._cells = [p for (_, _, p) in self._cached._param_cells]
+        self._aux_idx = set(self._cached._aux_positions)
+
+        data_sh = NamedSharding(self.mesh, P("dp"))
+        rep = NamedSharding(self.mesh, P())
+
+        # parameter shardings (tensor parallel hooks)
+        self._param_sh = []
+        for (_, _, p) in self._cached._param_cells:
+            spec = None
+            if param_spec_fn is not None:
+                spec = param_spec_fn(p.name, p.shape)
+            self._param_sh.append(
+                NamedSharding(self.mesh, spec) if spec is not None else rep
+            )
+        self._data_sh, self._rep = data_sh, rep
+
+        raw_fn = self._cached._raw_fn
+        n_params = len(self._cells)
+        loss_block = loss_fn
+        aux_idx = self._aux_idx
+        lr, mom_c, wd = learning_rate, momentum, weight_decay
+
+        def step(param_vals, mom_vals, data, label, key):
+            diff = {i: v for i, v in enumerate(param_vals) if i not in aux_idx}
+            aux = {i: v for i, v in enumerate(param_vals) if i in aux_idx}
+
+            def pure_loss(diff_params):
+                allp = [diff_params[i] if i in diff_params else aux[i]
+                        for i in range(n_params)]
+                outs = raw_fn(key, data, *allp, _training=True, _n_inputs=1)
+                outs = outs if isinstance(outs, tuple) else (outs,)
+                n_aux = len(aux_idx)
+                visible = outs[: len(outs) - n_aux] if n_aux else outs
+                new_aux = outs[len(outs) - n_aux:] if n_aux else ()
+                out_nd = NDArray.from_raw(visible[0])
+                lab_nd = NDArray.from_raw(label)
+                with autograd._RecordingScope(False, True):
+                    loss = loss_block(out_nd, lab_nd)
+                return loss._data.mean(), (new_aux, visible[0])
+
+            (loss_val, (new_aux, logits)), grads = jax.value_and_grad(
+                pure_loss, has_aux=True)(diff)
+
+            new_params = []
+            new_moms = []
+            aux_iter = iter(new_aux)
+            for i in range(n_params):
+                if i in aux_idx:
+                    new_params.append(next(aux_iter))
+                    new_moms.append(mom_vals[i])
+                else:
+                    g = grads[i] + wd * param_vals[i]
+                    m = mom_c * mom_vals[i] - lr * g
+                    new_params.append(param_vals[i] + m)
+                    new_moms.append(m)
+            return new_params, new_moms, loss_val, logits
+
+        donate = (0, 1)  # params + momenta buffers are donated: in-place update
+        self._step = jax.jit(
+            step,
+            in_shardings=(self._param_sh, self._param_sh, data_sh, data_sh, rep),
+            out_shardings=(self._param_sh, self._param_sh, rep, data_sh),
+            donate_argnums=donate,
         )
+
+        import jax.numpy as jnp
+
+        self._moms = [jnp.zeros_like(p.data()._data) for p in self._cells]
+        self._placed = False
+        self._built = True
+
+    def _place_params(self):
+        jax = _jax()
+        for p, sh in zip(self._cells, self._param_sh):
+            p.data()._data = jax.device_put(p.data()._data, sh)
+        self._moms = [jax.device_put(m, sh)
+                      for m, sh in zip(self._moms, self._param_sh)]
+        self._placed = True
+
+    def __call__(self, data, label):
+        """Run one optimizer step; returns (loss, logits) NDArrays."""
+        jax = _jax()
+        from .. import random as _random
+
+        if not self._built:
+            self._build(data if isinstance(data, NDArray) else NDArray(data))
+        if not self._placed:
+            self._place_params()
+        raw_data = data._data if isinstance(data, NDArray) else data
+        raw_label = label._data if isinstance(label, NDArray) else label
+        raw_data = jax.device_put(raw_data, self._data_sh)
+        raw_label = jax.device_put(raw_label, self._data_sh)
+        params = [p.data()._data for p in self._cells]
+        key = _random._next_key()
+        new_params, self._moms, loss, logits = self._step(
+            params, self._moms, raw_data, raw_label, key
+        )
+        for p, v in zip(self._cells, new_params):
+            cell = p.data()
+            cell._data = v
+            cell._vt = object()
+        return NDArray.from_raw(loss), NDArray.from_raw(logits)

@@ -1,0 +1,59 @@
+"""Device mesh construction (ref: SURVEY.md §2.3 — the TPU replacement for
+the reference's context lists + NCCL communicators).
+
+A mesh names the ICI topology; shardings over it drive XLA to insert
+collectives (psum/all-gather) in compiled programs — this is the layer that
+replaces KVStoreNCCL (src/kvstore/kvstore_nccl.h) and the Comm tree-reduce
+(src/kvstore/comm.h).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as _np
+
+__all__ = ["make_mesh", "data_parallel_mesh", "current_device_count"]
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def current_device_count() -> int:
+    return len(_jax().devices())
+
+
+def make_mesh(shape: Optional[Tuple[int, ...]] = None,
+              axis_names: Sequence[str] = ("dp",),
+              devices=None):
+    """Create a ``jax.sharding.Mesh``.
+
+    ``shape=None`` uses all devices on one axis.  Axis naming convention:
+    ``dp`` data parallel, ``mp`` tensor/model parallel, ``pp`` pipeline,
+    ``sp`` sequence — shardings choose which axes they use.
+    """
+    jax = _jax()
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),)
+    total = 1
+    for s in shape:
+        total *= s
+    if total > len(devices):
+        raise ValueError(
+            "mesh shape %s needs %d devices, only %d available"
+            % (shape, total, len(devices))
+        )
+    arr = _np.array(devices[:total]).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None):
+    jax = _jax()
+    devices = jax.devices()
+    n = num_devices if num_devices is not None else len(devices)
+    return make_mesh((n,), ("dp",), devices[:n])

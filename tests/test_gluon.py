@@ -268,3 +268,29 @@ def test_symbol_block_import(tmp_path):
     x = nd.ones((2, 3))
     np.testing.assert_allclose(block(x).asnumpy(), net(x).asnumpy(),
                                rtol=1e-5)
+
+
+def test_model_zoo_families():
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    for name, shape in [("resnet18_v1", (1, 3, 32, 32)),
+                        ("resnet18_v2", (1, 3, 32, 32)),
+                        ("mobilenet0.25", (1, 3, 32, 32)),
+                        ("squeezenet1.1", (1, 3, 64, 64))]:
+        net = vision.get_model(name, classes=10)
+        net.initialize(mx.init.Xavier())
+        out = net(nd.random.uniform(shape=shape))
+        assert out.shape == (1, 10), name
+    with pytest.raises(ValueError):
+        vision.get_model("nosuchmodel")
+
+
+def test_resnet50_param_count():
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net(nd.random.uniform(shape=(1, 3, 64, 64)))
+    n = sum(int(np.prod(p.shape)) for p in net.collect_params().values())
+    # torchvision/reference resnet50 ≈ 25.5M params
+    assert 25_000_000 < n < 26_500_000, n

@@ -1,0 +1,150 @@
+"""Data-parallel / mesh tests on the 8-device virtual CPU mesh
+(tests SURVEY.md §2.3's DP strategy; the reference tested multi-device on
+CPU too — tests/python/unittest/test_model_parallel.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, sym
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.mesh import make_mesh, current_device_count
+from mxnet_tpu.parallel.dp import FusedTrainStep, shard_batch, replicate
+
+
+def _need_devices(n):
+    if current_device_count() < n:
+        pytest.skip("needs %d devices" % n)
+
+
+def test_make_mesh():
+    _need_devices(8)
+    mesh = make_mesh((8,), ("dp",))
+    assert mesh.axis_names == ("dp",)
+    mesh2 = make_mesh((4, 2), ("dp", "mp"))
+    assert mesh2.devices.shape == (4, 2)
+    with pytest.raises(ValueError):
+        make_mesh((64,), ("dp",))
+
+
+def test_shard_and_replicate():
+    _need_devices(8)
+    mesh = make_mesh((8,), ("dp",))
+    x = nd.ones((16, 4))
+    shard_batch(x, mesh)
+    assert "dp" in str(x._data.sharding.spec)
+    w = nd.ones((4, 4))
+    replicate(w, mesh)
+    np.testing.assert_allclose(x.asnumpy(), 1.0)
+
+
+def test_fused_train_step_dp8():
+    _need_devices(8)
+    mesh = make_mesh((8,), ("dp",))
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, learning_rate=0.5, momentum=0.9)
+    np.random.seed(0)
+    X = np.random.rand(32, 10).astype("float32")
+    y = (X @ np.arange(10) > 4.5).astype("float32")  # separable rule
+    X, y = nd.array(X), nd.array(y)
+    losses = []
+    for _ in range(30):
+        loss, logits = step(X, y)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert logits.shape == (32, 4)
+
+
+def test_fused_step_matches_single_device():
+    """DP over 8 devices must give the same loss trajectory as 1 device
+    (the exact-arithmetic identity style of tests/nightly/dist_sync_kvstore.py)."""
+    _need_devices(8)
+
+    def run(mesh):
+        np.random.seed(3)
+        mx.random.seed(3)
+        net = nn.Dense(4, in_units=6)
+        net.initialize(mx.init.Xavier())
+        step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              mesh=mesh, learning_rate=0.1, momentum=0.0)
+        X = nd.array(np.random.RandomState(5).rand(16, 6).astype("float32"))
+        y = nd.array(np.random.RandomState(6).randint(0, 4, 16).astype("float32"))
+        out = [float(step(X, y)[0].asnumpy()) for _ in range(5)]
+        return out
+
+    l1 = run(make_mesh((1,), ("dp",)))
+    l8 = run(make_mesh((8,), ("dp",)))
+    np.testing.assert_allclose(l1, l8, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_step_with_batchnorm_aux():
+    _need_devices(8)
+    mesh = make_mesh((8,), ("dp",))
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), mesh=mesh)
+    X = nd.array(np.random.rand(16, 8).astype("float32"))
+    y = nd.array(np.random.randint(0, 2, 16).astype("float32"))
+    step(X, y)
+    rm = [p for name, p in net.collect_params().items()
+          if name.endswith("running_mean")][0]
+    assert float(np.abs(rm.data().asnumpy()).sum()) > 0, \
+        "BN running stats must update through the fused step"
+
+
+def test_tensor_parallel_sharding():
+    _need_devices(8)
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh((4, 2), ("dp", "mp"))
+    net = nn.Dense(8, in_units=6)
+    net.initialize(mx.init.Xavier())
+
+    def spec(name, shape):
+        if name.endswith("weight"):
+            return P("mp", None)
+        return None
+
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, param_spec_fn=spec)
+    X = nd.array(np.random.rand(8, 6).astype("float32"))
+    y = nd.array(np.random.randint(0, 8, 8).astype("float32"))
+    loss, _ = step(X, y)
+    assert np.isfinite(float(loss.asnumpy()))
+    w = net.weight.data()._data
+    assert "mp" in str(w.sharding.spec), w.sharding
+
+
+def test_module_multi_context():
+    """Module(context=[...]) data parallel — reference multi-device Module."""
+    _need_devices(8)
+    ctxs = [mx.cpu(i) for i in range(8)]
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+    X = np.random.rand(64, 10).astype("float32")
+    y = (X @ np.arange(10) > 4.5).astype("float32")  # separable
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(symbol=net, context=ctxs)
+    mod.fit(it, optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+            num_epoch=10)
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.85, score
+
+
+def test_dryrun_entrypoints():
+    _need_devices(8)
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
