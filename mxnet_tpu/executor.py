@@ -71,7 +71,7 @@ def build_graph_eval(symbol) -> Callable:
                 continue
             op = _op_registry.get(node.op)
             params = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
-            if op.name in ("BatchNorm", "Dropout"):
+            if op.train_aware:
                 params["_training"] = training
             args = [env[id(p)][oi] for p, oi in node.inputs]
             if op.rng:

@@ -101,11 +101,19 @@ class DataLoader:
                    for _ in range(self._num_workers)]
         for t in threads:
             t.start()
-        for j in range(len(batches)):
-            events[j].wait()
-            status, payload = out_q[j]
-            out_q[j] = None
-            budget.release()
-            if status == "err":
-                raise payload
-            yield payload
+        try:
+            for j in range(len(batches)):
+                events[j].wait()
+                status, payload = out_q[j]
+                out_q[j] = None
+                budget.release()
+                if status == "err":
+                    raise payload
+                yield payload
+        finally:
+            # consumer stopped early (break/close/error): unpark any workers
+            # blocked on the backpressure semaphore so the threads exit
+            with lock:
+                next_job[0] = len(batches)
+            for _ in threads:
+                budget.release()

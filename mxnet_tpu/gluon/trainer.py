@@ -34,14 +34,17 @@ class Trainer:
             self._param2idx[p.name] = i
         self._scale = 1.0
         optimizer_params = dict(optimizer_params or {})
+        idx2name = {i: p.name for i, p in enumerate(self._params)}
         if isinstance(optimizer, _opt.Optimizer):
             self._optimizer = optimizer
             if optimizer_params:
                 raise ValueError(
                     "optimizer_params must be None when optimizer is an instance"
                 )
+            # updater calls go by integer index; the instance needs the
+            # index→name map or name-keyed lr_mult/wd_mult below never match
+            self._optimizer.idx2name.update(idx2name)
         else:
-            idx2name = {i: p.name for i, p in enumerate(self._params)}
             self._optimizer = _opt.create(optimizer, param_idx2name=idx2name,
                                           **optimizer_params)
         # name-keyed so per-param settings override set_wd_mult's seeded

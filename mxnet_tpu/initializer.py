@@ -105,6 +105,12 @@ class One(Initializer):
         arr[:] = _np.ones(arr.shape, dtype=arr.dtype)
 
 
+# the reference accepts both spellings ("zeros" in Gluon layer defaults,
+# "zero" in the registry — ref: python/mxnet/initializer.py Zero/One aliases)
+_REGISTRY["zeros"] = Zero
+_REGISTRY["ones"] = One
+
+
 @register
 class Constant(Initializer):
     def __init__(self, value=0.0):

@@ -249,6 +249,13 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if is_train is None:
             is_train = self.for_training
+        self._feed(data_batch)
+        if self._dp is not None:
+            self._dp.place()
+        self._exec.forward(is_train=is_train)
+
+    def _feed(self, data_batch):
+        """Copy a batch into the bound executor's argument buffers."""
         feed = {}
         for name, arr in zip(self._data_names, data_batch.data):
             feed[name] = arr
@@ -256,29 +263,18 @@ class Module(BaseModule):
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
         for k, v in feed.items():
+            if k not in self._exec.arg_dict:
+                raise MXNetError("forward: unknown argument %r" % k)
             if isinstance(v, NDArray):
                 self._exec.arg_dict[k]._data = v._data.astype(self._exec.arg_dict[k].dtype)
             else:
                 self._exec.arg_dict[k][:] = v
-        if self._dp is not None:
-            self._dp.place()
-        self._exec.forward(is_train=is_train)
 
     def forward_backward(self, data_batch):
         """Fused fast path: one XLA program computes outputs + grads
         (ref: the cached-opr RunOps fast path, graph_executor.cc:1440)."""
         assert self.binded and self.params_initialized
-        feed = {}
-        for name, arr in zip(self._data_names, data_batch.data):
-            feed[name] = arr
-        if data_batch.label:
-            for name, arr in zip(self._label_names, data_batch.label):
-                feed[name] = arr
-        for k, v in feed.items():
-            if isinstance(v, NDArray):
-                self._exec.arg_dict[k]._data = v._data.astype(self._exec.arg_dict[k].dtype)
-            else:
-                self._exec.arg_dict[k][:] = v
+        self._feed(data_batch)
         if self._dp is not None:
             # shard batch / replicate params over the ICI mesh; XLA inserts
             # the gradient allreduce inside the compiled step

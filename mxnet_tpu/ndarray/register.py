@@ -13,12 +13,10 @@ from typing import Any, Dict
 from ..ops import registry as _registry
 from .ndarray import NDArray, invoke
 
-_TRAIN_AWARE = {"BatchNorm", "Dropout"}  # ops whose body branches on train mode
-
-
 def _make_wrapper(op: _registry.Op):
     name = op.name
     input_names = op.input_names
+    train_aware = op.train_aware
 
     def wrapper(*args, **kwargs):
         out = kwargs.pop("out", None)
@@ -36,7 +34,7 @@ def _make_wrapper(op: _registry.Op):
                     kwargs.pop(iname)
                 else:
                     break
-        if name in _TRAIN_AWARE and "_training" not in kwargs:
+        if train_aware and "_training" not in kwargs:
             from .. import autograd
 
             kwargs["_training"] = autograd.is_training()

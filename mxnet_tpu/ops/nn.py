@@ -376,7 +376,7 @@ _make_regression(
 # outputs: out [, batch_mean, batch_var] + aux writebacks
 # ---------------------------------------------------------------------------
 @register("BatchNorm", aliases=("batch_norm", "BatchNorm_v1"),
-          mutate_aux=(3, 4))
+          mutate_aux=(3, 4), train_aware=True)
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                 momentum=0.9, fix_gamma=True, use_global_stats=False,
                 output_mean_var=False, axis=1, cudnn_off=False,
@@ -442,7 +442,7 @@ def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **_):
 # ---------------------------------------------------------------------------
 # Dropout (ref: src/operator/dropout.cc; rng op, identity at inference)
 # ---------------------------------------------------------------------------
-@register("Dropout", aliases=("dropout",), rng=True)
+@register("Dropout", aliases=("dropout",), rng=True, train_aware=True)
 def _dropout(key, data, p=0.5, mode="training", axes=(), _training=True, **_):
     if not _training and mode != "always":
         return data

@@ -43,6 +43,9 @@ class Op:
         writes these back into the input cells.
     rng : whether the op consumes a PRNG key (Dropout, random samplers).
         Such ops take ``key`` as their first array argument.
+    train_aware : whether the op body branches on train/inference mode and
+        takes a ``_training`` keyword (BatchNorm, Dropout, RNN) — the invoke
+        layers thread ``autograd.is_training()`` through automatically.
     """
 
     __slots__ = (
@@ -53,6 +56,7 @@ class Op:
         "mutate_aux",
         "rng",
         "nondiff",
+        "train_aware",
         "doc",
         "aliases",
         "input_names",
@@ -67,6 +71,7 @@ class Op:
         mutate_aux: Sequence[int] = (),
         rng: bool = False,
         nondiff: bool = False,
+        train_aware: bool = False,
         doc: str = "",
         input_names: Optional[Sequence[str]] = None,
     ):
@@ -79,6 +84,7 @@ class Op:
         self.mutate_aux = tuple(mutate_aux)
         self.rng = rng
         self.nondiff = nondiff
+        self.train_aware = train_aware
         self.doc = doc or (fn.__doc__ or "")
         self.aliases: List[str] = []
         if input_names is None:

@@ -1,0 +1,177 @@
+"""Fused recurrent ops — the TPU answer to the reference's cuDNN RNN.
+
+The reference's fused RNN is GPU-only (src/operator/rnn.cc:33 "RNN is only
+available for gpu"; spec in src/operator/cudnn_rnn-inl.h: vanilla/LSTM/GRU,
+multi-layer, bidirectional, inter-layer dropout, fused parameter blob).  On
+TPU the scan-based formulation below is the *primary* implementation:
+
+  * the input projection for all timesteps is one big batched matmul
+    (T·N × I @ I × G·H) that XLA tiles onto the MXU;
+  * only the recurrent h→h matmul lives inside ``lax.scan``, which compiles
+    to a single fused while-loop — no per-timestep dispatch;
+  * bidirectional runs the reverse direction as a second scan over the
+    time-flipped input, concatenating features, matching cuDNN semantics.
+
+Parameter blob layout mirrors the reference (src/operator/rnn-inl.h
+GetRnnParamSize / cuDNN linLayer order): all weights first — per layer, per
+direction: W_i2h (G·H × in), W_h2h (G·H × H) — then all biases per
+layer/direction: b_i2h (G·H), b_h2h (G·H).  Gate order is cuDNN's:
+LSTM = [i, f, g, o], GRU = [r, z, n] (linear-before-reset variant).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NUM_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional=False,
+                   mode="lstm"):
+    """Total flat-parameter length (ref: rnn-inl.h GetRnnParamSize)."""
+    ng = _NUM_GATES[mode]
+    nd = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * nd
+        size += nd * ng * state_size * (isz + state_size + 2)
+    return size
+
+
+def _split_params(flat, mode, num_layers, num_dir, input_size, H):
+    """Unpack the fused blob into per-(layer, direction) weight/bias arrays.
+
+    All slice offsets are Python ints, so under jit this is free reshaping.
+    """
+    ng = _NUM_GATES[mode]
+    weights, idx = [], 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else H * num_dir
+        per_layer = []
+        for _ in range(num_dir):
+            w_i2h = flat[idx:idx + ng * H * isz].reshape(ng * H, isz)
+            idx += ng * H * isz
+            w_h2h = flat[idx:idx + ng * H * H].reshape(ng * H, H)
+            idx += ng * H * H
+            per_layer.append([w_i2h, w_h2h])
+        weights.append(per_layer)
+    for layer in range(num_layers):
+        for d in range(num_dir):
+            b_i2h = flat[idx:idx + ng * H]
+            idx += ng * H
+            b_h2h = flat[idx:idx + ng * H]
+            idx += ng * H
+            weights[layer][d] += [b_i2h, b_h2h]
+    return weights
+
+
+def _scan_one_direction(x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, mode, H,
+                        reverse=False, clip_min=None, clip_max=None):
+    """One (layer, direction) pass.  x: (T, N, I) → (T, N, H), h_T[, c_T]."""
+    if reverse:
+        x = jnp.flip(x, axis=0)
+
+    if mode == "lstm":
+        # input projection for every timestep at once — MXU-sized matmul
+        gx = jnp.einsum("tni,gi->tng", x, w_i2h) + b_i2h + b_h2h
+
+        def step(carry, gx_t):
+            h, c = carry
+            gates = gx_t + h @ w_h2h.T
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            if clip_min is not None and clip_max is not None:
+                # cuDNN clips the cell state inside the recurrence
+                # (ref: src/operator/cudnn_rnn-inl.h lstm_state_clip_*)
+                c_new = jnp.clip(c_new, clip_min, clip_max)
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        (h_T, c_T), ys = lax.scan(step, (h0, c0), gx)
+    elif mode == "gru":
+        # linear-before-reset (cuDNN): n = tanh(Wx_n + r * (Rh_n + b_Rn));
+        # b_Rn must not be pre-added, so keep b_h2h inside the step.
+        gx = jnp.einsum("tni,gi->tng", x, w_i2h) + b_i2h
+
+        def step(h, gx_t):
+            gh = h @ w_h2h.T + b_h2h
+            xr, xz, xn = jnp.split(gx_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1.0 - z) * n + z * h
+            return h_new, h_new
+
+        h_T, ys = lax.scan(step, h0, gx)
+        c_T = None
+    else:
+        gx = jnp.einsum("tni,gi->tng", x, w_i2h) + b_i2h + b_h2h
+        act = jnp.tanh if mode == "rnn_tanh" else lambda v: jnp.maximum(v, 0)
+
+        def step(h, gx_t):
+            h_new = act(gx_t + h @ w_h2h.T)
+            return h_new, h_new
+
+        h_T, ys = lax.scan(step, h0, gx)
+        c_T = None
+
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, h_T, c_T
+
+
+@register("RNN", rng=True, train_aware=True,
+          input_names=("data", "parameters", "state", "state_cell"))
+def _rnn(key, data, parameters, state, *maybe_cell, state_size=0,
+         num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+         state_outputs=False, lstm_state_clip_min=None,
+         lstm_state_clip_max=None, lstm_state_clip_nan=False,
+         _training=True, **_):
+    """Fused multi-layer (bi)directional RNN over time-major (T, N, I) data.
+
+    Returns ``output`` — plus final ``state`` (and ``state_cell`` for LSTM)
+    when ``state_outputs`` is set, matching the reference's output list.
+    """
+    H = int(state_size)
+    num_dir = 2 if bidirectional else 1
+    T, N, input_size = data.shape
+    weights = _split_params(parameters.reshape(-1), mode, num_layers, num_dir,
+                            input_size, H)
+    cell0 = maybe_cell[0] if (mode == "lstm" and maybe_cell) else None
+
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(num_layers):
+        if layer > 0 and p > 0.0 and _training:
+            key, sub = jax.random.split(key)
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(sub, keep, x.shape).astype(x.dtype)
+            x = x * mask / keep
+        outs = []
+        for d in range(num_dir):
+            sidx = layer * num_dir + d
+            h0 = state[sidx]
+            c0 = cell0[sidx] if cell0 is not None else None
+            w_i2h, w_h2h, b_i2h, b_h2h = weights[layer][d]
+            ys, h_T, c_T = _scan_one_direction(
+                x, h0, c0, w_i2h, w_h2h, b_i2h, b_h2h, mode, H,
+                reverse=(d == 1), clip_min=lstm_state_clip_min,
+                clip_max=lstm_state_clip_max)
+            outs.append(ys)
+            h_finals.append(h_T)
+            if c_T is not None:
+                c_finals.append(c_T)
+        x = outs[0] if num_dir == 1 else jnp.concatenate(outs, axis=-1)
+
+    if not state_outputs:
+        return x
+    h_out = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        return x, h_out, jnp.stack(c_finals, axis=0)
+    return x, h_out

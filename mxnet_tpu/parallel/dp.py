@@ -127,6 +127,7 @@ class FusedTrainStep:
         self._momentum_cfg = momentum
         self._weight_decay = weight_decay
         self._param_spec_fn = param_spec_fn
+        self._dtype = dtype
         self._built = False
 
     def _build(self, sample_data):
@@ -144,6 +145,11 @@ class FusedTrainStep:
         weight_decay = self._weight_decay
         with autograd.pause():
             block(sample_data)  # settles deferred initialization
+        if self._dtype is not None:
+            # whole-model cast — the reference's dtype-training story
+            # (example/image-classification --dtype float16); on TPU the
+            # natural choice is bfloat16 for MXU throughput
+            block.cast(self._dtype)
         self._cached = CachedOp(block)
         self._cells = [p for (_, _, p) in self._cached._param_cells]
         self._aux_idx = set(self._cached._aux_positions)
@@ -236,6 +242,8 @@ class FusedTrainStep:
             self._place_params()
         raw_data = data._data if isinstance(data, NDArray) else data
         raw_label = label._data if isinstance(label, NDArray) else label
+        if self._dtype is not None:
+            raw_data = raw_data.astype(self._dtype)
         raw_data = jax.device_put(raw_data, self._data_sh)
         raw_label = jax.device_put(raw_label, self._data_sh)
         params = [p.data()._data for p in self._cells]
