@@ -43,6 +43,7 @@ from . import kvstore as kv
 from . import module
 from . import module as mod
 from . import gluon
+from . import rnn
 from .initializer import Xavier, Uniform, Normal
 from .model import save_checkpoint, load_checkpoint
 

@@ -1,3 +1,4 @@
 """``mx.mod`` — Module API (ref: python/mxnet/module/)."""
 from .base_module import BaseModule
 from .module import Module
+from .bucketing_module import BucketingModule

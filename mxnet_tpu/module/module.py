@@ -244,6 +244,16 @@ class Module(BaseModule):
             self._preloaded_states = None
         self.optimizer_initialized = True
 
+    def borrow_optimizer(self, shared_module):
+        """Share optimizer state with another module bound to the same
+        parameters (ref: module.py borrow_optimizer — the bucketing path)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._updater = shared_module._updater
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self.optimizer_initialized = True
+
     # ------------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized

@@ -110,6 +110,27 @@ def _regression_rule(p, s):
     return {"label": tuple(data)}
 
 
+def _rnn_rule(p, s):
+    """Derive the fused blob + state shapes from (T, N, I) data (ref: the
+    reference's RNN FInferShape, src/operator/rnn-inl.h)."""
+    data = s.get("data")
+    if data is None or len(data) != 3:
+        return {}
+    from ..ops.rnn import rnn_param_size
+
+    H = int(p.get("state_size", 0))
+    L = int(p.get("num_layers", 1))
+    bidir = bool(p.get("bidirectional", False))
+    mode = p.get("mode", "lstm")
+    nd_ = 2 if bidir else 1
+    state = (L * nd_, data[1], H)
+    return {
+        "parameters": (rnn_param_size(L, data[2], H, bidir, mode),),
+        "state": state,
+        "state_cell": state,
+    }
+
+
 PARAM_SHAPE_RULES = {
     "FullyConnected": _fc_rule,
     "Convolution": _conv_rule,
@@ -126,6 +147,7 @@ PARAM_SHAPE_RULES = {
     "LinearRegressionOutput": _regression_rule,
     "LogisticRegressionOutput": _regression_rule,
     "MAERegressionOutput": _regression_rule,
+    "RNN": _rnn_rule,
 }
 
 # inputs that are integer-typed by nature (indices / labels stay float in
@@ -213,7 +235,7 @@ def _infer_walk(symbol, known_shapes: Dict[str, Tuple[int, ...]],
         if op.rng:
             key_spec = jax.ShapeDtypeStruct((2,), _np.uint32)
             in_specs = [key_spec] + in_specs
-        if op.name in ("BatchNorm", "Dropout"):
+        if op.train_aware:
             params.setdefault("_training", True)
         try:
             out = jax.eval_shape(fake_fn, *in_specs)

@@ -1,0 +1,199 @@
+"""Symbolic RNN cells + BucketingModule tests (modelled on the reference's
+tests/python/unittest/test_rnn.py and tests/python/train/test_bucketing.py,
+and the config-3 baseline example/rnn/bucketing/lstm_bucketing.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.LSTMCell(num_hidden=50, prefix="lstm_")
+    inputs = [sym.Variable("t%d_data" % i) for i in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    grouped = sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == sorted(
+        ["lstm_h2h_bias", "lstm_h2h_weight", "lstm_i2h_bias",
+         "lstm_i2h_weight"])
+    arg_shapes, out_shapes, _ = grouped.infer_shape(
+        t0_data=(10, 20), t1_data=(10, 20), t2_data=(10, 20))
+    assert out_shapes == [(10, 50)] * 3
+
+
+def test_gru_and_vanilla_cells():
+    for cell in [mx.rnn.GRUCell(num_hidden=16, prefix="gru_"),
+                 mx.rnn.RNNCell(num_hidden=16, prefix="rnn_")]:
+        inputs = [sym.Variable("t%d_data" % i) for i in range(2)]
+        outputs, states = cell.unroll(2, inputs)
+        grouped = sym.Group(outputs)
+        _, out_shapes, _ = grouped.infer_shape(t0_data=(4, 8),
+                                               t1_data=(4, 8))
+        assert out_shapes == [(4, 16)] * 2
+
+
+def test_stacked_and_bidirectional():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(num_hidden=8, prefix="l0_"))
+    stack.add(mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(num_hidden=8, prefix="bl_"),
+        mx.rnn.LSTMCell(num_hidden=8, prefix="br_")))
+    data = sym.Variable("data")
+    outputs, states = stack.unroll(3, data, layout="NTC", merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 4))
+    assert out_shapes == [(2, 3, 16)]
+    assert len(states) == 6  # lstm 2 + bidir 2*2
+
+
+def test_residual_and_dropout_cells():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.GRUCell(num_hidden=4, prefix="g0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(num_hidden=4, prefix="g1_")))
+    stack.add(mx.rnn.DropoutCell(0.3))
+    data = sym.Variable("data")
+    outputs, _ = stack.unroll(2, data, layout="NTC", merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(3, 2, 4))
+    assert out_shapes == [(3, 2, 4)]
+
+
+def test_fused_cell_matches_unfused():
+    """FusedRNNCell (scan-based RNN op) == unfused explicit cells given the
+    same packed weights (the reference's test_rnn.py test_fused consistency
+    check)."""
+    T, N, I, H = 4, 2, 3, 5
+    fused = mx.rnn.FusedRNNCell(H, num_layers=2, mode="lstm",
+                                get_next_state=True, prefix="lstm_")
+    data = sym.Variable("data")
+    f_out, f_states = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+
+    ex = f_out.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            arr[:] = nd.random.uniform(-0.1, 0.1, shape=arr.shape)
+    x = np.random.randn(N, T, I).astype("float32")
+    ex.arg_dict["data"][:] = x
+    fused_out = ex.forward()[0].asnumpy()
+
+    # unfuse and evaluate with unpacked weights
+    stack = fused.unfuse()
+    u_out, _ = stack.unroll(T, data, layout="NTC", merge_outputs=True)
+    args = {k: v for k, v in ex.arg_dict.items() if k != "data"}
+    unpacked = fused.unpack_weights(args)
+    cell_args = stack.pack_weights(unpacked)
+    ex2 = u_out.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    for name, arr in ex2.arg_dict.items():
+        if name == "data":
+            arr[:] = x
+        elif name in cell_args:
+            arr[:] = cell_args[name]
+        else:
+            raise AssertionError("missing weight %s" % name)
+    unfused_out = ex2.forward()[0].asnumpy()
+    np.testing.assert_allclose(fused_out, unfused_out, atol=1e-5)
+
+    # pack_weights round-trips
+    repacked = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(
+        repacked["lstm_parameters"].asnumpy(),
+        ex.arg_dict["lstm_parameters"].asnumpy(), atol=1e-6)
+
+
+def _lm_sym_gen(num_hidden=32, num_embed=16, vocab=20):
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data=data, input_dim=vocab,
+                              output_dim=num_embed, name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        stack.add(mx.rnn.LSTMCell(num_hidden=num_hidden, prefix="lstm_l0_"))
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = sym.FullyConnected(data=pred, num_hidden=vocab, name="pred")
+        lab = sym.Reshape(label, shape=(-1,))
+        pred = sym.SoftmaxOutput(data=pred, label=lab, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    return sym_gen
+
+
+def _synthetic_sentences(n=300, vocab=20, min_len=3, max_len=12):
+    """Learnable synthetic language: wrap-around counting sequences."""
+    rng = np.random.RandomState(0)
+    sentences = []
+    for _ in range(n):
+        L = rng.randint(min_len, max_len + 1)
+        start = rng.randint(1, vocab)
+        sentences.append([(start + t) % (vocab - 1) + 1 for t in range(L)])
+    return sentences
+
+
+def test_bucket_sentence_iter():
+    sentences = _synthetic_sentences()
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[5, 10, 12], invalid_label=0)
+    seen_keys = set()
+    n = 0
+    for batch in it:
+        seen_keys.add(batch.bucket_key)
+        assert batch.data[0].shape == (8, batch.bucket_key)
+        assert batch.label[0].shape == (8, batch.bucket_key)
+        # label is data shifted one step left
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_array_equal(d[:, 1:], l[:, :-1])
+        n += 1
+    assert n > 5
+    assert len(seen_keys) > 1
+
+
+def test_bucketing_module_trains():
+    """End-to-end LSTM bucketing LM converges on counting sequences (ref:
+    tests/python/train/test_bucketing.py: train a small LM, assert the
+    metric improves)."""
+    vocab = 20
+    sentences = _synthetic_sentences(n=400, vocab=vocab)
+    train_iter = mx.rnn.BucketSentenceIter(sentences, batch_size=16,
+                                           buckets=[5, 8, 12],
+                                           invalid_label=0)
+    mod = mx.mod.BucketingModule(
+        sym_gen=_lm_sym_gen(vocab=vocab),
+        default_bucket_key=train_iter.default_bucket_key,
+        context=mx.cpu())
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.Perplexity(ignore_label=0)
+
+    last_ppl = None
+    for epoch in range(4):
+        train_iter.reset()
+        metric.reset()
+        for batch in train_iter:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        last_ppl = metric.get()[1]
+    # counting sequences are deterministic: perplexity should fall well
+    # below uniform (vocab=20 → 20.0)
+    assert last_ppl < 4.0, "perplexity %s did not drop" % last_ppl
+
+
+def test_bucketing_module_switch_shares_params():
+    vocab = 20
+    sym_gen = _lm_sym_gen(vocab=vocab)
+    mod = mx.mod.BucketingModule(sym_gen=sym_gen, default_bucket_key=12,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataDesc
+
+    mod.bind(data_shapes=[DataDesc("data", (4, 12))],
+             label_shapes=[DataDesc("softmax_label", (4, 12))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.switch_bucket(5, [DataDesc("data", (4, 5))],
+                      [DataDesc("softmax_label", (4, 5))])
+    m5 = mod._buckets[5]
+    m12 = mod._buckets[12]
+    # parameter cells are the same objects → updates propagate
+    assert m5._exec.arg_dict["pred_weight"] is m12._exec.arg_dict["pred_weight"]
