@@ -1,2 +1,26 @@
 """Parallelism over device meshes (ref: SURVEY.md §2.3) — data/model
-parallel built on jax.sharding + collectives. Populated by mesh.py/dp.py."""
+parallel built on jax.sharding + collectives, plus the long-context
+sequence/context parallel layer (ring attention, Ulysses all-to-all).
+
+Submodules import lazily (PEP 562) so importing the package — or mesh-only
+helpers — does not initialise jax before platform config is settled."""
+from .mesh import make_mesh, data_parallel_mesh, current_device_count
+
+_LAZY = {
+    "attention_reference": "attention",
+    "flash_attention": "attention",
+    "pallas_flash_attention": "attention",
+    "ring_attention": "ring_attention",
+    "ring_attention_sharded": "ring_attention",
+    "ulysses_attention": "sequence",
+    "ulysses_attention_sharded": "sequence",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module("." + _LAZY[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
