@@ -20,7 +20,8 @@ Semantics preserved from the reference:
   * push accumulates (sums) all values pushed for a key; pull broadcasts
   * ``set_updater`` moves the optimizer into the store
     (update_on_kvstore path, ref: kvstore_local.h updater_)
-  * row_sparse pull degrades to dense (documented TPU divergence)
+  * row_sparse_pull gathers only the requested rows on device and returns
+    a RowSparseNDArray (ref: kvstore_dist.h:258 PullRowSparseImpl)
 """
 from __future__ import annotations
 
@@ -78,15 +79,23 @@ class KVStore:
         """Sum all pushed values per key (ref: kvstore_local.h Push →
         Comm::Reduce).  Engine-priority overlap is not needed: XLA's async
         dispatch already overlaps these reductions with other work."""
+        from .ndarray import sparse as _sp
+
         keys, values = _key_value(key, value)
         for k, vlist in zip(keys, values):
             vs = _as_list(vlist)
             merged = vs[0]
             if len(vs) > 1:
-                acc = vs[0]._data
-                for v in vs[1:]:
-                    acc = acc + v._data
-                merged = NDArray.from_raw(acc, vs[0].context)
+                if all(isinstance(v, _sp.RowSparseNDArray) for v in vs):
+                    # row-sparse reduce keeps the merged gradient sparse
+                    # (ref: comm.h ReduceRowSparse)
+                    for v in vs[1:]:
+                        merged = _sp.add(merged, v)
+                else:
+                    acc = vs[0]._data
+                    for v in vs[1:]:
+                        acc = acc + v._data
+                    merged = NDArray.from_raw(acc, vs[0].context)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError("push before init on key %r" % k)
@@ -113,9 +122,45 @@ class KVStore:
         self.pull(key, out if out is not None else value, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None) -> None:
-        """Dense fallback (TPU has no native sparse rows; documented
-        divergence from kvstore_dist.h PullRowSparseImpl)."""
-        self.pull(key, out, priority)
+        """Pull only the rows named in ``row_ids`` as a RowSparseNDArray
+        (ref: kvstore_dist.h:258 PullRowSparseImpl; kvstore_local.h
+        PullRowSparseImpl gathers the requested rows)."""
+        import jax.numpy as jnp
+
+        from .ndarray import sparse as _sp
+
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids (matches reference)")
+        if out is None:
+            raise MXNetError("row_sparse_pull requires out (matches reference)")
+        keys, outs = _key_value(key, out)
+        rids = _as_list(row_ids)
+        if len(rids) == 1 and len(keys) > 1:
+            rids = rids * len(keys)
+        for k, olist, rid in zip(keys, outs, rids):
+            # same source precedence as pull(): pending push wins when no
+            # updater is installed
+            if self._updater is not None or k not in self._pending:
+                src = self._store.get(k, self._pending.get(k))
+            else:
+                src = self._pending[k]
+            if src is None:
+                raise MXNetError("pull on uninitialised key %r" % k)
+            rows = _np.unique(
+                (rid.asnumpy() if isinstance(rid, NDArray) else _np.asarray(rid))
+                .astype(_np.int64).ravel())
+            # device-side gather of only the requested rows — the full table
+            # never leaves HBM (ref: kvstore_local.h PullRowSparseImpl)
+            taken = jnp.take(src._data, jnp.asarray(rows), axis=0)
+            pulled = _sp.RowSparseNDArray._make(
+                src.shape, src.dtype,
+                {"data": taken, "indices": jnp.asarray(rows)}, src.context)
+            for o in _as_list(olist):
+                if isinstance(o, _sp.RowSparseNDArray):
+                    pulled.copyto(o)
+                else:
+                    # dense out: caller gets the retained rows densified
+                    pulled.todense().copyto(o)
 
     def set_gradient_compression(self, compression_params) -> None:
         self._compression_params = dict(compression_params or {})

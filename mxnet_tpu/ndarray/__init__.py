@@ -16,6 +16,8 @@ from .ndarray import (
     waitall,
 )
 from .utils import save, load, load_frombuffer
+from . import sparse
+from .sparse import BaseSparseNDArray, CSRNDArray, RowSparseNDArray
 from . import register as _register
 
 # imperative random namespace: mx.nd.random.uniform(...)

@@ -604,6 +604,11 @@ def empty(shape, ctx=None, dtype=None) -> NDArray:
 
 
 def zeros(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    stype = kwargs.pop("stype", None)
+    if stype is not None and stype != "default":
+        from . import sparse as _sp
+
+        return _sp.zeros(stype, shape, ctx, dtype)
     return invoke("_zeros", [], {"shape": as_shape(shape),
                                  "dtype": dtype_name(dtype)}, ctx=ctx)
 

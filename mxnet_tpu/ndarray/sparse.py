@@ -1,0 +1,585 @@
+"""Sparse NDArray storage — ``row_sparse`` and ``csr`` on a dense machine.
+
+TPU rebuild of the reference's sparse storage layer
+(ref: include/mxnet/ndarray.h:59-63 storage types;
+python/mxnet/ndarray/sparse.py CSRNDArray/RowSparseNDArray;
+src/operator/tensor/cast_storage-inl.h; src/operator/tensor/dot.cc CSR dot;
+src/operator/tensor/sparse_retain.cc).
+
+Design stance (SURVEY.md §7 hard part 4): the TPU has no native sparse
+memory layout, so sparsity here is a *storage contract*, not a kernel
+format:
+
+  * a sparse NDArray holds its compressed parts (``data`` + ``indices``
+    [+ ``indptr``]) as ordinary device arrays;
+  * compute that profits from sparsity (CSR matmul, row-sparse optimizer
+    updates, retain) runs on device via gather / segment-sum formulations —
+    the MXU- and HBM-friendly way to express sparsity on XLA;
+  * everything else *falls back to dense* transparently: reading ``_data``
+    densifies on demand (the analogue of the reference's storage-fallback
+    dispatch, ref: src/executor/infer_graph_attr_pass.cc dispatch-mode
+    fallback + the "Storage fallback detected" warning), and writing
+    ``_data`` marks the compressed parts stale so they recompress lazily.
+
+nnz is dynamic per array instance (we are outside jit at the cell layer);
+each distinct nnz shape gets its own cached XLA executable, exactly like
+any other shape bucket.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from ..base import dtype_name, np_dtype
+from ..context import Context, current_context
+from .ndarray import NDArray, array as _dense_array, invoke
+
+__all__ = [
+    "BaseSparseNDArray",
+    "CSRNDArray",
+    "RowSparseNDArray",
+    "csr_matrix",
+    "row_sparse_array",
+    "cast_storage",
+    "retain",
+    "dot",
+    "add",
+    "subtract",
+    "multiply",
+    "zeros",
+    "empty",
+    "array",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# base class
+# ---------------------------------------------------------------------------
+class BaseSparseNDArray(NDArray):
+    """Base of CSRNDArray / RowSparseNDArray
+    (ref: python/mxnet/ndarray/sparse.py:105 BaseSparseNDArray).
+
+    ``_data`` (the dense jax buffer every dense op reads) is a *property*
+    here: reading densifies lazily; writing stores the dense result and
+    marks the compressed parts stale.  This gives the reference's
+    dense-fallback dispatch without a per-op storage-type inference pass.
+    """
+
+    __slots__ = ("_sp_shape", "_sp_dtype", "_sp_parts", "_dense_cache")
+
+    def __init__(self):  # pragma: no cover - use constructors below
+        raise TypeError("use csr_matrix / row_sparse_array / cast_storage")
+
+    @classmethod
+    def _make(cls, shape, dtype, parts, ctx):
+        out = cls.__new__(cls)
+        out._sp_shape = tuple(int(s) for s in shape)
+        out._sp_dtype = np_dtype(dtype)
+        out._sp_parts = parts  # dict of jax arrays, stype-specific
+        out._dense_cache = None
+        out._ctx = ctx if ctx is not None else current_context()
+        out._grad = None
+        out._grad_req = "null"
+        out._fresh_grad_node = None
+        out._is_ag_variable = False
+        out._vt = object()
+        return out
+
+    # -- the dense-fallback bridge --------------------------------------
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._densify()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        # a dense op wrote through this cell (e.g. invoke(out=self)); the
+        # dense buffer becomes the truth and compressed parts recompress
+        # lazily on next access (ref: cast_storage dense→sparse)
+        self._dense_cache = value
+        self._sp_parts = None
+
+    def _parts(self):
+        if self._sp_parts is None:
+            self._sp_parts = self._compress(_np.asarray(self._dense_cache))
+        return self._sp_parts
+
+    # subclass hooks
+    def _densify(self):  # -> jax array
+        raise NotImplementedError
+
+    @classmethod
+    def _compress(cls, dense_np):  # -> parts dict
+        raise NotImplementedError
+
+    # -- common surface --------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._sp_shape
+
+    @property
+    def dtype(self):
+        return self._sp_dtype
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self._sp_shape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self) -> int:
+        return len(self._sp_shape)
+
+    @property
+    def data(self) -> NDArray:
+        """The values array (ref: sparse.py CSRNDArray.data)."""
+        return NDArray.from_raw(self._parts()["data"], self._ctx)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray.from_raw(self._parts()["indices"], self._ctx)
+
+    def asnumpy(self) -> _np.ndarray:
+        return _np.asarray(self._data)
+
+    def todense(self) -> NDArray:
+        return NDArray.from_raw(self._data, self._ctx)
+
+    def tostype(self, stype: str) -> NDArray:
+        return cast_storage(self, stype)
+
+    def astype(self, dtype, copy: bool = True):
+        if not copy and self._sp_dtype == np_dtype(dtype):
+            return self
+        return cast_storage(self.todense().astype(dtype), self.stype)
+
+    def wait_to_read(self) -> None:
+        parts = self._sp_parts
+        if parts is not None:
+            for v in parts.values():
+                v.block_until_ready()
+        elif self._dense_cache is not None:
+            self._dense_cache.block_until_ready()
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return cast_storage(
+                NDArray(self.asnumpy(), ctx=Context(other)), self.stype
+            )
+        if isinstance(other, BaseSparseNDArray) and other.stype == self.stype:
+            other._sp_shape = self._sp_shape
+            other._sp_dtype = self._sp_dtype
+            other._sp_parts = dict(self._parts())
+            other._dense_cache = None
+            other._vt = object()
+            return other
+        return super().copyto(other)
+
+    def copy(self):
+        return cast_storage(self.todense(), self.stype)
+
+    def __setitem__(self, key, value):
+        if isinstance(key, slice) and key == slice(None):
+            if isinstance(value, NDArray):
+                value = value.asnumpy()
+            self._data = _jnp().asarray(
+                _np.broadcast_to(_np.asarray(value, dtype=self._sp_dtype),
+                                 self._sp_shape)
+            )
+            self._vt = object()
+            return
+        raise ValueError(
+            "sparse NDArray only supports wholesale assignment x[:] = v "
+            "(ref: sparse.py __setitem__)"
+        )
+
+    def __getitem__(self, key):
+        return NDArray.from_raw(self._data, self._ctx)[key]
+
+    def __repr__(self) -> str:
+        nnz = int(self._parts()["data"].shape[0])
+        return "\n<%s %s @%s, %d stored elements>" % (
+            type(self).__name__,
+            "x".join(str(s) for s in self._sp_shape),
+            self._ctx,
+            nnz,
+        )
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix
+    (ref: python/mxnet/ndarray/sparse.py CSRNDArray)."""
+
+    @property
+    def stype(self) -> str:
+        return "csr"
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray.from_raw(self._parts()["indptr"], self._ctx)
+
+    def _densify(self):
+        jnp = _jnp()
+        parts = self._sp_parts
+        rows, cols = self._sp_shape
+        data, indices, indptr = parts["data"], parts["indices"], parts["indptr"]
+        counts = _np.diff(_np.asarray(indptr))
+        row_ids = _np.repeat(_np.arange(rows, dtype=_np.int64), counts)
+        flat = jnp.zeros((rows * cols,), dtype=self._sp_dtype)
+        if data.shape[0]:
+            pos = jnp.asarray(row_ids) * cols + indices.astype("int64")
+            flat = flat.at[pos].set(data)
+        return flat.reshape(rows, cols)
+
+    @classmethod
+    def _compress(cls, dense_np):
+        jnp = _jnp()
+        dense_np = _np.asarray(dense_np)
+        rows, cols = dense_np.shape
+        mask = dense_np != 0
+        indptr = _np.zeros(rows + 1, dtype=_np.int64)
+        indptr[1:] = _np.cumsum(mask.sum(axis=1))
+        r, c = _np.nonzero(mask)
+        return {
+            "data": jnp.asarray(dense_np[r, c]),
+            "indices": jnp.asarray(c.astype(_np.int64)),
+            "indptr": jnp.asarray(indptr),
+        }
+
+    def _row_ids(self) -> _np.ndarray:
+        """Per-nnz row id, host-side (indptr is concrete)."""
+        counts = _np.diff(_np.asarray(self._parts()["indptr"]))
+        return _np.repeat(_np.arange(self._sp_shape[0], dtype=_np.int64), counts)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse tensor: ``data[i]`` is row ``indices[i]`` of the dense
+    tensor, all other rows zero
+    (ref: python/mxnet/ndarray/sparse.py RowSparseNDArray).  The storage
+    type of gradients for sparse embeddings and of kvstore row-sparse
+    pull (ref: src/kvstore/kvstore_dist.h:258 PullRowSparseImpl)."""
+
+    @property
+    def stype(self) -> str:
+        return "row_sparse"
+
+    def _densify(self):
+        jnp = _jnp()
+        parts = self._sp_parts
+        data, indices = parts["data"], parts["indices"]
+        out = jnp.zeros(self._sp_shape, dtype=self._sp_dtype)
+        if data.shape[0]:
+            out = out.at[indices.astype("int64")].set(data)
+        return out
+
+    @classmethod
+    def _compress(cls, dense_np):
+        jnp = _jnp()
+        dense_np = _np.asarray(dense_np)
+        flat = dense_np.reshape(dense_np.shape[0], -1)
+        rows = _np.nonzero(flat.any(axis=1))[0]
+        return {
+            "data": jnp.asarray(dense_np[rows]),
+            "indices": jnp.asarray(rows.astype(_np.int64)),
+        }
+
+    def retain(self, indices) -> "RowSparseNDArray":
+        return retain(self, indices)
+
+
+# ---------------------------------------------------------------------------
+# constructors (ref: python/mxnet/ndarray/sparse.py csr_matrix / row_sparse_array)
+# ---------------------------------------------------------------------------
+def _as_jax(x, dtype=None):
+    jnp = _jnp()
+    if isinstance(x, NDArray):
+        x = x.asnumpy()
+    x = _np.asarray(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jnp.asarray(x)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    """Build a CSRNDArray from ``(data, indices, indptr)``, a dense source,
+    or a scipy.sparse matrix (ref: sparse.py csr_matrix)."""
+    ctx = ctx if ctx is not None else current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        dtype = np_dtype(dtype) if dtype is not None else _np.asarray(
+            data.asnumpy() if isinstance(data, NDArray) else data
+        ).dtype
+        if dtype.kind not in "fiu":
+            dtype = _np.dtype(_np.float32)
+        if shape is None:
+            raise ValueError("shape is required for (data, indices, indptr)")
+        parts = {
+            "data": _as_jax(data, dtype),
+            "indices": _as_jax(indices, _np.int64),
+            "indptr": _as_jax(indptr, _np.int64),
+        }
+        return CSRNDArray._make(shape, dtype, parts, ctx)
+    if hasattr(arg1, "tocsr"):  # scipy matrix
+        m = arg1.tocsr()
+        return csr_matrix((m.data, m.indices, m.indptr), shape=m.shape,
+                          ctx=ctx, dtype=dtype or m.dtype)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    if dtype is not None:
+        dense = dense.astype(np_dtype(dtype))
+    elif dense.dtype == _np.float64:
+        dense = dense.astype(_np.float32)
+    if shape is not None and tuple(shape) != dense.shape:
+        raise ValueError("shape mismatch")
+    return CSRNDArray._make(dense.shape, dense.dtype,
+                            CSRNDArray._compress(dense), ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    """Build a RowSparseNDArray from ``(data, indices)`` or a dense source
+    (ref: sparse.py row_sparse_array)."""
+    ctx = ctx if ctx is not None else current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data_np = _np.asarray(data.asnumpy() if isinstance(data, NDArray) else data)
+        dtype = np_dtype(dtype) if dtype is not None else (
+            data_np.dtype if data_np.dtype.kind in "fiu" and
+            data_np.dtype != _np.float64 else _np.dtype(_np.float32))
+        if shape is None:
+            raise ValueError("shape is required for (data, indices)")
+        parts = {
+            "data": _as_jax(data_np, dtype),
+            "indices": _as_jax(indices, _np.int64),
+        }
+        return RowSparseNDArray._make(shape, dtype, parts, ctx)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    if dtype is not None:
+        dense = dense.astype(np_dtype(dtype))
+    elif dense.dtype == _np.float64:
+        dense = dense.astype(_np.float32)
+    if shape is not None and tuple(shape) != dense.shape:
+        raise ValueError("shape mismatch")
+    return RowSparseNDArray._make(dense.shape, dense.dtype,
+                                  RowSparseNDArray._compress(dense), ctx)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """ref: sparse.py array() — build from another sparse array / scipy."""
+    if isinstance(source_array, BaseSparseNDArray):
+        out = source_array.copy()
+        if ctx is not None or dtype is not None:
+            dense = source_array.asnumpy()
+            if dtype is not None:
+                dense = dense.astype(np_dtype(dtype))
+            return cast_storage(NDArray(dense, ctx=ctx), source_array.stype)
+        return out
+    if hasattr(source_array, "tocsr"):
+        return csr_matrix(source_array, ctx=ctx, dtype=dtype)
+    raise ValueError("use csr_matrix/row_sparse_array for dense sources")
+
+
+def zeros(stype: str, shape, ctx=None, dtype=None, **kwargs):
+    """ref: python/mxnet/ndarray/utils.py zeros(stype=...)."""
+    jnp = _jnp()
+    ctx = ctx if ctx is not None else current_context()
+    dtype = np_dtype(dtype) if dtype is not None else _np.dtype(_np.float32)
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    if stype == "default":
+        from . import ndarray as _nd
+
+        return _nd.zeros(shape, ctx, dtype)
+    if stype == "row_sparse":
+        parts = {
+            "data": jnp.zeros((0,) + shape[1:], dtype=dtype),
+            "indices": jnp.zeros((0,), dtype="int64"),
+        }
+        return RowSparseNDArray._make(shape, dtype, parts, ctx)
+    if stype == "csr":
+        parts = {
+            "data": jnp.zeros((0,), dtype=dtype),
+            "indices": jnp.zeros((0,), dtype="int64"),
+            "indptr": jnp.zeros((shape[0] + 1,), dtype="int64"),
+        }
+        return CSRNDArray._make(shape, dtype, parts, ctx)
+    raise ValueError("unknown storage type %r" % stype)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx, dtype)
+
+
+# ---------------------------------------------------------------------------
+# storage casts (ref: src/operator/tensor/cast_storage-inl.h)
+# ---------------------------------------------------------------------------
+def cast_storage(arr: NDArray, stype: str):
+    if stype == "default":
+        if isinstance(arr, BaseSparseNDArray):
+            return arr.todense()
+        return arr
+    cls = {"row_sparse": RowSparseNDArray, "csr": CSRNDArray}.get(stype)
+    if cls is None:
+        raise ValueError("unknown storage type %r" % stype)
+    if isinstance(arr, cls):
+        return arr
+    if stype == "csr" and arr.ndim != 2:
+        raise ValueError("csr requires a 2-D array")
+    dense = arr.asnumpy()
+    return cls._make(dense.shape, dense.dtype, cls._compress(dense), arr._ctx)
+
+
+# ---------------------------------------------------------------------------
+# sparse-aware compute (device-side gather / segment-sum formulations)
+# ---------------------------------------------------------------------------
+def retain(rsp: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """Keep only the listed rows (ref: src/operator/tensor/sparse_retain.cc).
+
+    Host-side index set intersection (indices are metadata), device-side
+    gather of the kept rows.
+    """
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    jnp = _jnp()
+    want = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                       else indices).astype(_np.int64).ravel()
+    have = _np.asarray(rsp._parts()["indices"])
+    keep_mask = _np.isin(have, want)
+    pos = _np.nonzero(keep_mask)[0]
+    parts = {
+        "data": jnp.take(rsp._parts()["data"], jnp.asarray(pos), axis=0)
+        if pos.size else _jnp().zeros((0,) + rsp.shape[1:], dtype=rsp.dtype),
+        "indices": _jnp().asarray(have[pos]),
+    }
+    return RowSparseNDArray._make(rsp.shape, rsp.dtype, parts, rsp._ctx)
+
+
+def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
+    """Sparse-aware matmul (ref: src/operator/tensor/dot.cc CSR dot).
+
+    csr × dense       →  segment-sum over nnz  (rows = lhs rows)
+    csr.T × dense     →  scatter-add over nnz  (rows = lhs cols)
+    rsp × dense       →  dense rows gathered then matmul
+    dense × csr[.T]   →  via the transpose identities
+    dense × dense     →  plain MXU matmul
+    """
+    jnp = _jnp()
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_b:
+            raise ValueError("dot(csr, dense, transpose_b=True) unsupported "
+                             "(matches reference)")
+        parts = lhs._parts()
+        data, col_ids = parts["data"], parts["indices"].astype("int64")
+        row_ids = jnp.asarray(lhs._row_ids())
+        rows, cols = lhs.shape
+        if not transpose_a:
+            # out[r] = Σ_nnz(r) data · rhs[col]: gather + segment-sum over rows
+            gathered = jnp.take(rhs._data, col_ids, axis=0)  # (nnz, k)
+            out = _segment_sum(gathered * data[:, None], row_ids, rows)
+        else:
+            # out[c] = Σ_nnz(c) data · rhs[row]: gather + scatter-add to cols
+            gathered = jnp.take(rhs._data, row_ids, axis=0)
+            out = _segment_sum(gathered * data[:, None], col_ids, cols)
+        return NDArray.from_raw(out.astype(lhs.dtype), lhs._ctx)
+    if isinstance(lhs, RowSparseNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        if transpose_a:
+            dense = lhs._data
+            return invoke("dot", [NDArray.from_raw(dense, lhs._ctx), rhs],
+                          {"transpose_a": True, "transpose_b": transpose_b})
+        parts = lhs._parts()
+        rows = parts["indices"].astype("int64")
+        partial = jnp.matmul(parts["data"],
+                             rhs._data.T if transpose_b else rhs._data)
+        k = (rhs.shape[0] if transpose_b else rhs.shape[1])
+        out = jnp.zeros((lhs.shape[0], k), dtype=partial.dtype)
+        if parts["data"].shape[0]:
+            out = out.at[rows].set(partial)
+        return NDArray.from_raw(out.astype(lhs.dtype), lhs._ctx)
+    if isinstance(rhs, BaseSparseNDArray) and not isinstance(lhs, BaseSparseNDArray):
+        # dense @ csr == (csr.T @ dense.T).T
+        if isinstance(rhs, CSRNDArray):
+            inner = dot(rhs, NDArray.from_raw(
+                lhs._data.T if not transpose_a else lhs._data, lhs._ctx),
+                transpose_a=not transpose_b)
+            return NDArray.from_raw(inner._data.T, lhs._ctx)
+        rhs = rhs.todense()
+    return invoke("dot", [lhs if isinstance(lhs, NDArray) else _dense_array(lhs),
+                          rhs if isinstance(rhs, NDArray) else _dense_array(rhs)],
+                  {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+
+def _segment_sum(vals, seg_ids, num_segments):
+    jnp = _jnp()
+    out = jnp.zeros((num_segments,) + vals.shape[1:], dtype=vals.dtype)
+    if vals.shape[0]:
+        out = out.at[seg_ids].add(vals)
+    return out
+
+
+def _merge_rsp(a: RowSparseNDArray, b: RowSparseNDArray, op):
+    """Union-of-rows elementwise combine; result stays row_sparse
+    (ref: src/operator/tensor/elemwise_binary_op_basic.cc sparse paths)."""
+    jnp = _jnp()
+    ia = _np.asarray(a._parts()["indices"])
+    ib = _np.asarray(b._parts()["indices"])
+    union = _np.union1d(ia, ib)
+    pos_a = _np.searchsorted(union, ia)
+    pos_b = _np.searchsorted(union, ib)
+    row_shape = a.shape[1:]
+    da = _segment_sum(a._parts()["data"], jnp.asarray(pos_a), union.size) \
+        if ia.size else jnp.zeros((union.size,) + row_shape, dtype=a.dtype)
+    db = _segment_sum(b._parts()["data"], jnp.asarray(pos_b), union.size) \
+        if ib.size else jnp.zeros((union.size,) + row_shape, dtype=b.dtype)
+    parts = {"data": op(da, db), "indices": jnp.asarray(union.astype(_np.int64))}
+    return RowSparseNDArray._make(a.shape, a.dtype, parts, a._ctx)
+
+
+def add(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        return _merge_rsp(lhs, rhs, lambda x, y: x + y)
+    return invoke("broadcast_add", [lhs, rhs])
+
+
+def subtract(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        return _merge_rsp(lhs, rhs, lambda x, y: x - y)
+    return invoke("broadcast_sub", [lhs, rhs])
+
+
+def multiply(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        # intersection of rows would suffice; union with zero products is
+        # equivalent and reuses the merge path
+        return _merge_rsp(lhs, rhs, lambda x, y: x * y)
+    return invoke("broadcast_mul", [lhs, rhs])
+
+
+def square_sum(rsp, axis=None, keepdims=False):
+    """Σ data² without densifying (ref: src/operator/tensor/square_sum.cc,
+    used by the row-sparse LAMB/normalisation paths)."""
+    if isinstance(rsp, RowSparseNDArray):
+        jnp = _jnp()
+        d = rsp._parts()["data"]
+        if axis is None:
+            return NDArray.from_raw(jnp.sum(d * d), rsp._ctx)
+        if axis in (1, (1,), -1):
+            per_row = jnp.sum(d * d, axis=tuple(range(1, d.ndim)),
+                              keepdims=keepdims)
+            out = jnp.zeros((rsp.shape[0],) + per_row.shape[1:], dtype=d.dtype)
+            if d.shape[0]:
+                out = out.at[rsp._parts()["indices"].astype("int64")].set(per_row)
+            return NDArray.from_raw(out, rsp._ctx)
+    return invoke("square_sum", [rsp], {"axis": axis, "keepdims": keepdims})

@@ -168,3 +168,68 @@ def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
     new_z = beta1 * z + (1.0 - beta1) * g - sigma * weight
     new_w = -new_z / d_t
     return new_w, d_t, new_v, new_z
+
+
+# ---------------------------------------------------------------------------
+# row-sparse (lazy) updates: only rows present in the gradient are touched
+# (ref: src/operator/optimizer_op.cc SGDUpdateRspImpl / SGDMomLazyUpdate /
+# AdamUpdateRspImpl / AdagradUpdateRspImpl; "lazy_update" semantics:
+# momentum/EMA state of untouched rows is NOT decayed).
+# Inputs take the gradient as (rows, gdata) pairs; each distinct nnz gets
+# its own cached XLA executable, like any other shape bucket.
+# ---------------------------------------------------------------------------
+def _row_clip_wd(gdata, wrows, wd, rescale_grad, clip_gradient):
+    g = gdata * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * wrows
+
+
+@register("_sparse_sgd_update", nondiff=True)
+def _sparse_sgd_update(weight, gdata, rows, lr=0.01, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, **_):
+    rows = rows.astype(jnp.int64)
+    wrows = jnp.take(weight, rows, axis=0)
+    g = _row_clip_wd(gdata, wrows, wd, rescale_grad,
+                     clip_gradient if clip_gradient > 0 else None)
+    return weight.at[rows].add(-lr * g)
+
+
+@register("_sparse_sgd_mom_update", nondiff=True, mutate_aux=(3,))
+def _sparse_sgd_mom_update(weight, gdata, rows, mom, lr=0.01, momentum=0.0,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
+    rows = rows.astype(jnp.int64)
+    wrows = jnp.take(weight, rows, axis=0)
+    g = _row_clip_wd(gdata, wrows, wd, rescale_grad,
+                     clip_gradient if clip_gradient > 0 else None)
+    new_mrows = momentum * jnp.take(mom, rows, axis=0) - lr * g
+    return (weight.at[rows].add(new_mrows),
+            mom.at[rows].set(new_mrows))
+
+
+@register("_sparse_adam_update", nondiff=True, mutate_aux=(3, 4))
+def _sparse_adam_update(weight, gdata, rows, mean, var, lr=0.001, beta1=0.9,
+                        beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, **_):
+    rows = rows.astype(jnp.int64)
+    wrows = jnp.take(weight, rows, axis=0)
+    g = _row_clip_wd(gdata, wrows, wd, rescale_grad,
+                     clip_gradient if clip_gradient > 0 else None)
+    mrows = beta1 * jnp.take(mean, rows, axis=0) + (1.0 - beta1) * g
+    vrows = beta2 * jnp.take(var, rows, axis=0) + (1.0 - beta2) * jnp.square(g)
+    new_wrows = wrows - lr * mrows / (jnp.sqrt(vrows) + epsilon)
+    return (weight.at[rows].set(new_wrows),
+            mean.at[rows].set(mrows),
+            var.at[rows].set(vrows))
+
+
+@register("_sparse_adagrad_update", nondiff=True, mutate_aux=(3,))
+def _sparse_adagrad_update(weight, gdata, rows, history, lr=0.01, epsilon=1e-7,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **_):
+    rows = rows.astype(jnp.int64)
+    wrows = jnp.take(weight, rows, axis=0)
+    g = _row_clip_wd(gdata, wrows, wd, rescale_grad,
+                     clip_gradient if clip_gradient > 0 else None)
+    hrows = jnp.take(history, rows, axis=0) + jnp.square(g)
+    return (weight.at[rows].add(-lr * g / jnp.sqrt(hrows + epsilon)),
+            history.at[rows].set(hrows))

@@ -28,6 +28,18 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta", "RMSProp",
 _REGISTRY: Dict[str, type] = {}
 
 
+def _rsp_grad(grad):
+    """If ``grad`` is row-sparse, return (gdata, rows) NDArrays for the
+    lazy row-wise update ops; else None (dense path)."""
+    from .ndarray import sparse as _sparse
+
+    if isinstance(grad, _sparse.RowSparseNDArray):
+        p = grad._parts()
+        return (NDArray.from_raw(p["data"], grad.context),
+                NDArray.from_raw(p["indices"], grad.context))
+    return None
+
+
 def register(klass):
     """ref: Optimizer.register."""
     _REGISTRY[klass.__name__.lower()] = klass
@@ -135,7 +147,19 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        if state is None:
+        sp = _rsp_grad(grad) if self.lazy_update else None
+        if sp is not None:
+            gdata, rows = sp
+            if state is None:
+                invoke("_sparse_sgd_update", [weight, gdata, rows],
+                       {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                        "clip_gradient": self._clip()}, out=weight)
+            else:
+                invoke("_sparse_sgd_mom_update", [weight, gdata, rows, state],
+                       {"lr": lr, "momentum": self.momentum, "wd": wd,
+                        "rescale_grad": self.rescale_grad,
+                        "clip_gradient": self._clip()}, out=weight)
+        elif state is None:
             invoke("sgd_update", [weight, grad],
                    {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
                     "clip_gradient": self._clip()}, out=weight)
@@ -177,6 +201,7 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (zeros(weight.shape, weight.context, dtype=weight.dtype),
@@ -188,6 +213,15 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         mean, var = state
+        sp = _rsp_grad(grad) if self.lazy_update else None
+        if sp is not None:
+            gdata, rows = sp
+            invoke("_sparse_adam_update", [weight, gdata, rows, mean, var],
+                   {"lr": lr, "beta1": self.beta1, "beta2": self.beta2,
+                    "epsilon": self.epsilon, "wd": wd,
+                    "rescale_grad": self.rescale_grad,
+                    "clip_gradient": self._clip()}, out=weight)
+            return
         invoke("adam_update", [weight, grad, mean, var],
                {"lr": lr, "beta1": self.beta1, "beta2": self.beta2,
                 "epsilon": self.epsilon, "wd": wd,
@@ -206,6 +240,15 @@ class AdaGrad(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
+        sp = _rsp_grad(grad)
+        if sp is not None:
+            gdata, rows = sp
+            invoke("_sparse_adagrad_update", [weight, gdata, rows, state],
+                   {"lr": self._get_lr(index), "epsilon": self.float_stable_eps,
+                    "wd": self._get_wd(index),
+                    "rescale_grad": self.rescale_grad,
+                    "clip_gradient": self._clip()}, out=weight)
+            return
         invoke("adagrad_update", [weight, grad, state],
                {"lr": self._get_lr(index), "epsilon": self.float_stable_eps,
                 "wd": self._get_wd(index), "rescale_grad": self.rescale_grad,

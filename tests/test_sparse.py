@@ -1,0 +1,238 @@
+"""Sparse storage tests, modeled on the reference's
+tests/python/unittest/test_sparse_ndarray.py and test_sparse_operator.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    arr = rng.uniform(-1, 1, size=shape).astype(np.float32)
+    mask = rng.uniform(size=shape) < density
+    return (arr * mask).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# creation / round trips
+# ---------------------------------------------------------------------------
+def test_csr_roundtrip():
+    dense = _rand_dense((7, 5))
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert csr.shape == (7, 5)
+    np.testing.assert_allclose(csr.asnumpy(), dense, rtol=1e-6)
+    back = csr.tostype("default")
+    assert back.stype == "default"
+    np.testing.assert_allclose(back.asnumpy(), dense, rtol=1e-6)
+
+
+def test_csr_from_parts():
+    # 2x3: [[0,1,0],[2,0,3]]
+    csr = sparse.csr_matrix(
+        (np.array([1.0, 2.0, 3.0]), np.array([1, 0, 2]), np.array([0, 1, 3])),
+        shape=(2, 3))
+    np.testing.assert_allclose(csr.asnumpy(),
+                               [[0, 1, 0], [2, 0, 3]], rtol=1e-6)
+    np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 1, 3])
+    np.testing.assert_array_equal(csr.indices.asnumpy(), [1, 0, 2])
+
+
+def test_rsp_roundtrip():
+    dense = np.zeros((6, 4), np.float32)
+    dense[1] = 1.5
+    dense[4] = -2.0
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 4])
+    assert rsp.data.shape == (2, 4)
+    np.testing.assert_allclose(rsp.asnumpy(), dense, rtol=1e-6)
+
+
+def test_rsp_from_parts():
+    rsp = sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([0, 3])), shape=(5, 3))
+    out = rsp.asnumpy()
+    assert out[0].sum() == 3 and out[3].sum() == 3 and out.sum() == 6
+
+
+def test_cast_storage():
+    dense = nd.array(_rand_dense((4, 6)))
+    for stype in ("csr", "row_sparse"):
+        sp = sparse.cast_storage(dense, stype)
+        assert sp.stype == stype
+        np.testing.assert_allclose(sp.asnumpy(), dense.asnumpy(), rtol=1e-6)
+        assert sparse.cast_storage(sp, stype) is sp
+    assert dense.tostype("csr").stype == "csr"
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (3, 2))
+    assert z.stype == "row_sparse" and z.asnumpy().sum() == 0
+    zc = sparse.zeros("csr", (3, 2))
+    assert zc.stype == "csr" and zc.asnumpy().sum() == 0
+    via_nd = nd.zeros((3, 2), stype="csr")
+    assert via_nd.stype == "csr"
+
+
+def test_dense_fallback_write():
+    """Writing through the dense bridge invalidates + recompresses parts."""
+    rsp = sparse.row_sparse_array(np.zeros((4, 2), np.float32))
+    rsp[:] = np.ones((4, 2), np.float32)
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(rsp.asnumpy(), np.ones((4, 2)), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sparse compute
+# ---------------------------------------------------------------------------
+def test_retain():
+    dense = np.zeros((6, 2), np.float32)
+    dense[[1, 3, 5]] = [[1, 1], [3, 3], [5, 5]]
+    rsp = sparse.row_sparse_array(dense)
+    kept = sparse.retain(rsp, nd.array([1, 5]))
+    np.testing.assert_array_equal(kept.indices.asnumpy(), [1, 5])
+    expected = np.zeros_like(dense)
+    expected[[1, 5]] = dense[[1, 5]]
+    np.testing.assert_allclose(kept.asnumpy(), expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("ta", [False, True])
+def test_csr_dot(ta):
+    lhs = _rand_dense((8, 5), 0.4, seed=1)
+    rhs = np.random.RandomState(2).uniform(-1, 1, (8 if ta else 5, 3)).astype(np.float32)
+    csr = sparse.csr_matrix(lhs)
+    out = sparse.dot(csr, nd.array(rhs), transpose_a=ta)
+    expected = (lhs.T if ta else lhs) @ rhs
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_rsp_dot():
+    lhs = np.zeros((6, 4), np.float32)
+    lhs[[0, 2]] = np.random.RandomState(3).uniform(-1, 1, (2, 4))
+    rhs = np.random.RandomState(4).uniform(-1, 1, (4, 3)).astype(np.float32)
+    out = sparse.dot(sparse.row_sparse_array(lhs), nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), lhs @ rhs, rtol=1e-4, atol=1e-5)
+
+
+def test_dense_dot_csr():
+    lhs = np.random.RandomState(5).uniform(-1, 1, (3, 4)).astype(np.float32)
+    rhs = _rand_dense((4, 6), 0.4, seed=6)
+    out = sparse.dot(nd.array(lhs), sparse.csr_matrix(rhs))
+    np.testing.assert_allclose(out.asnumpy(), lhs @ rhs, rtol=1e-4, atol=1e-5)
+
+
+def test_rsp_elemwise():
+    a = np.zeros((5, 3), np.float32)
+    b = np.zeros((5, 3), np.float32)
+    a[[0, 2]] = 1.0
+    b[[2, 4]] = 2.0
+    ra, rb = sparse.row_sparse_array(a), sparse.row_sparse_array(b)
+    s = sparse.add(ra, rb)
+    assert s.stype == "row_sparse"
+    np.testing.assert_allclose(s.asnumpy(), a + b, rtol=1e-6)
+    np.testing.assert_array_equal(s.indices.asnumpy(), [0, 2, 4])
+    np.testing.assert_allclose(sparse.subtract(ra, rb).asnumpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose(sparse.multiply(ra, rb).asnumpy(), a * b, rtol=1e-6)
+
+
+def test_square_sum():
+    dense = _rand_dense((6, 4), 0.5, seed=7)
+    rsp = sparse.row_sparse_array(dense)
+    np.testing.assert_allclose(sparse.square_sum(rsp).asnumpy(),
+                               (dense ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(sparse.square_sum(rsp, axis=1).asnumpy(),
+                               (dense ** 2).sum(axis=1), rtol=1e-5)
+
+
+def test_dense_ops_on_sparse_fallback():
+    """Dense ops read sparse inputs through the densify bridge."""
+    dense = _rand_dense((4, 4), 0.5, seed=8)
+    csr = sparse.csr_matrix(dense)
+    out = nd.sum(csr)
+    np.testing.assert_allclose(out.asnumpy(), dense.sum(), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# optimizer lazy updates
+# ---------------------------------------------------------------------------
+def _run_opt(opt_name, touched_rows, steps=3, **opt_kw):
+    shape = (8, 3)
+    rng = np.random.RandomState(9)
+    w0 = rng.uniform(-1, 1, shape).astype(np.float32)
+    gd = rng.uniform(-1, 1, (len(touched_rows),) + shape[1:]).astype(np.float32)
+
+    opt = mx.optimizer.create(opt_name, learning_rate=0.1, **opt_kw)
+    w = nd.array(w0)
+    state = opt.create_state(0, w)
+    for _ in range(steps):
+        grad = sparse.row_sparse_array((gd, np.asarray(touched_rows)), shape=shape)
+        opt.update(0, w, grad, state)
+    return w0, w.asnumpy()
+
+
+@pytest.mark.parametrize("opt_name,kw", [
+    ("sgd", {"momentum": 0.9}),
+    ("sgd", {}),
+    ("adam", {}),
+    ("adagrad", {}),
+])
+def test_lazy_update_untouched_rows(opt_name, kw):
+    touched = [1, 4, 6]
+    w0, w1 = _run_opt(opt_name, touched, **kw)
+    untouched = [r for r in range(8) if r not in touched]
+    # lazy semantics: rows absent from the gradient are bit-identical
+    np.testing.assert_array_equal(w0[untouched], w1[untouched])
+    # touched rows moved
+    assert np.abs(w1[touched] - w0[touched]).max() > 1e-4
+
+
+def test_sparse_sgd_matches_dense_on_touched_rows():
+    """With wd=0 the lazy row update equals the dense update on touched rows."""
+    shape = (6, 2)
+    rng = np.random.RandomState(11)
+    w0 = rng.uniform(-1, 1, shape).astype(np.float32)
+    g_rows = np.array([0, 3])
+    gd = rng.uniform(-1, 1, (2, 2)).astype(np.float32)
+    g_dense = np.zeros(shape, np.float32)
+    g_dense[g_rows] = gd
+
+    opt_s = mx.optimizer.create("sgd", learning_rate=0.2, momentum=0.9)
+    opt_d = mx.optimizer.create("sgd", learning_rate=0.2, momentum=0.9)
+    ws, wd_ = nd.array(w0), nd.array(w0)
+    ss, sd = opt_s.create_state(0, ws), opt_d.create_state(0, wd_)
+    for _ in range(3):
+        opt_s.update(0, ws, sparse.row_sparse_array((gd, g_rows), shape=shape), ss)
+        opt_d.update(0, wd_, nd.array(g_dense), sd)
+    np.testing.assert_allclose(ws.asnumpy()[g_rows], wd_.asnumpy()[g_rows],
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# kvstore row_sparse
+# ---------------------------------------------------------------------------
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(6, 2))
+    kv.init(3, w)
+    out = sparse.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull(3, out=out, row_ids=nd.array([1, 4]))
+    assert out.stype == "row_sparse"
+    np.testing.assert_array_equal(out.indices.asnumpy(), [1, 4])
+    expected = np.zeros((6, 2), np.float32)
+    expected[[1, 4]] = w.asnumpy()[[1, 4]]
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-6)
+
+
+def test_kvstore_rsp_push():
+    kv = mx.kv.create("local")
+    shape = (5, 2)
+    kv.init("w", nd.zeros(shape))
+    a = np.zeros(shape, np.float32); a[1] = 1.0
+    b = np.zeros(shape, np.float32); b[3] = 2.0
+    kv.push("w", [sparse.row_sparse_array(a), sparse.row_sparse_array(b)])
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), a + b, rtol=1e-6)
