@@ -36,6 +36,7 @@ from . import optimizer
 from . import metric
 from . import lr_scheduler
 from . import io
+from . import recordio
 from . import callback
 from . import model
 from . import kvstore

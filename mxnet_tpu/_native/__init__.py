@@ -1,0 +1,108 @@
+"""Native library loader — builds and binds ``libmxtpu_io.so``.
+
+The reference ships its data path as C++ (dmlc recordio + the OMP decode
+pipeline of src/io/iter_image_recordio_2.cc); this package compiles the
+TPU rebuild's native equivalents from ``native/*.cc`` on first use and
+exposes them over ctypes (the framework's C-ABI boundary, standing in for
+the reference's ``libmxnet.so`` C API surface).
+
+Build is a single g++ invocation cached by source mtimes — no cmake dance
+for two translation units.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LOCK = threading.Lock()
+_LIB = None
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_ROOT, "native")
+_SOURCES = ("recordio.cc", "image_pipeline.cc")
+_OUT = os.path.join(_SRC_DIR, "build", "libmxtpu_io.so")
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_OUT):
+        return True
+    out_mtime = os.path.getmtime(_OUT)
+    for s in _SOURCES + ("recordio.h",):
+        if os.path.getmtime(os.path.join(_SRC_DIR, s)) > out_mtime:
+            return True
+    return False
+
+
+def _build() -> None:
+    os.makedirs(os.path.dirname(_OUT), exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
+        "-Wall", "-Wextra", "-Wno-unused-parameter",
+    ] + [os.path.join(_SRC_DIR, s) for s in _SOURCES] + [
+        "-o", _OUT, "-ljpeg",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            "native build failed:\n%s\n%s" % (" ".join(cmd), proc.stderr)
+        )
+
+
+def lib() -> ctypes.CDLL:
+    """Load (building if stale) the native IO library."""
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _needs_build():
+            _build()
+        L = ctypes.CDLL(_OUT)
+
+        # recordio
+        L.MXTPURecordIOWriterCreate.argtypes = [ctypes.c_char_p,
+                                                ctypes.POINTER(ctypes.c_void_p)]
+        L.MXTPURecordIOWriterWrite.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p, ctypes.c_size_t]
+        L.MXTPURecordIOWriterTell.argtypes = [ctypes.c_void_p,
+                                              ctypes.POINTER(ctypes.c_size_t)]
+        L.MXTPURecordIOWriterFree.argtypes = [ctypes.c_void_p]
+        L.MXTPURecordIOReaderCreate.argtypes = [ctypes.c_char_p,
+                                                ctypes.POINTER(ctypes.c_void_p)]
+        L.MXTPURecordIOReaderRead.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ctypes.POINTER(ctypes.c_size_t)]
+        L.MXTPURecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        L.MXTPURecordIOReaderTell.argtypes = [ctypes.c_void_p,
+                                              ctypes.POINTER(ctypes.c_size_t)]
+        L.MXTPURecordIOReaderFree.argtypes = [ctypes.c_void_p]
+        L.MXTPURecordIOGetLastError.restype = ctypes.c_char_p
+
+        # image iter
+        L.MXTPUImageIterCreate.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p)]
+        L.MXTPUImageIterNext.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_int)]
+        L.MXTPUImageIterReset.argtypes = [ctypes.c_void_p]
+        L.MXTPUImageIterFree.argtypes = [ctypes.c_void_p]
+        L.MXTPUImageIterNumRecords.argtypes = [ctypes.c_void_p,
+                                               ctypes.POINTER(ctypes.c_size_t)]
+        L.MXTPUImageIterGetLastError.restype = ctypes.c_char_p
+
+        _LIB = L
+        return _LIB
+
+
+def last_error() -> str:
+    return lib().MXTPURecordIOGetLastError().decode()

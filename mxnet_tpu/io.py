@@ -3,9 +3,9 @@
 Round-1 set: ``DataIter`` base, ``NDArrayIter`` (the workhorse for tests and
 small jobs), ``MNISTIter`` (loads idx files or generates a deterministic
 synthetic set when files are absent — keeps train_mnist runnable in
-zero-egress environments), ``CSVIter``, ``ResizeIter``, ``PrefetchingIter``.
-The C++ record-file pipeline (ImageRecordIter, src/io/iter_image_recordio_2.cc)
-lands with the native IO milestone.
+zero-egress environments), ``CSVIter``, ``ResizeIter``, ``PrefetchingIter``,
+and ``ImageRecordIter`` — the C++ record-file pipeline
+(src/io/iter_image_recordio_2.cc) backed by native/image_pipeline.cc.
 """
 from __future__ import annotations
 
@@ -23,7 +23,7 @@ from .base import MXNetError
 from .context import Context, cpu
 from .ndarray import NDArray, array
 
-__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter",
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter", "ImageRecordIter",
            "CSVIter", "ResizeIter", "PrefetchingIter"]
 
 
@@ -428,3 +428,101 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class ImageRecordIter(DataIter):
+    """Record-file image iterator backed by the native C++ pipeline
+    (ref: src/io/iter_image_recordio_2.cc registered as ImageRecordIter at
+    :724; threaded JPEG decode + augment + batch + bounded prefetch).
+
+    Accepts the reference's main kwargs: ``path_imgrec``, ``data_shape``
+    (c, h, w), ``batch_size``, ``shuffle``, ``rand_crop``, ``rand_mirror``,
+    ``mean_r/g/b``, ``std_r/g/b``, ``resize`` (shorter side),
+    ``label_width``, ``preprocess_threads``, ``round_batch``, ``seed``,
+    ``prefetch_buffer``.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0,
+                 resize=0, label_width=1, preprocess_threads=4,
+                 round_batch=True, seed=0, prefetch_buffer=4,
+                 data_name="data", label_name="softmax_label", ctx=None,
+                 dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        import ctypes as _ct
+
+        from . import _native
+
+        self._L = _native.lib()
+        c, h, w = (int(s) for s in data_shape)
+        self._shape = (c, h, w)
+        self._label_width = int(label_width)
+        self._data_name, self._label_name = data_name, label_name
+        self._dtype = dtype
+        mean = (_ct.c_float * 3)(mean_r, mean_g, mean_b)
+        std = (_ct.c_float * 3)(std_r, std_g, std_b)
+        handle = _ct.c_void_p()
+        rc = self._L.MXTPUImageIterCreate(
+            str(path_imgrec).encode(), int(batch_size), c, h, w,
+            int(bool(shuffle)), int(bool(rand_crop)), int(bool(rand_mirror)),
+            mean, std, int(preprocess_threads), int(seed),
+            self._label_width, int(resize), int(bool(round_batch)),
+            int(prefetch_buffer), _ct.byref(handle))
+        if rc != 0:
+            raise MXNetError(self._L.MXTPUImageIterGetLastError().decode())
+        self._handle = handle
+        n = _ct.c_size_t()
+        self._L.MXTPUImageIterNumRecords(self._handle, _ct.byref(n))
+        self.num_records = n.value
+        self._first_batch = None
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name, (self.batch_size,) + self._shape,
+                         self._dtype)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self._label_width == 1
+                 else (self.batch_size, self._label_width))
+        return [DataDesc(self._label_name, shape, self._dtype)]
+
+    def reset(self):
+        self._L.MXTPUImageIterReset(self._handle)
+
+    def next(self) -> DataBatch:
+        import ctypes as _ct
+
+        data_p = _ct.POINTER(_ct.c_float)()
+        label_p = _ct.POINTER(_ct.c_float)()
+        pad = _ct.c_int()
+        rc = self._L.MXTPUImageIterNext(self._handle, _ct.byref(data_p),
+                                        _ct.byref(label_p), _ct.byref(pad))
+        if rc < 0:
+            raise MXNetError(self._L.MXTPUImageIterGetLastError().decode())
+        if rc == 0:
+            raise StopIteration
+        c, h, w = self._shape
+        n = self.batch_size
+        data = _np.ctypeslib.as_array(data_p, shape=(n, c, h, w)).copy()
+        label = _np.ctypeslib.as_array(
+            label_p, shape=(n, self._label_width)).copy()
+        if self._label_width == 1:
+            label = label.reshape(n)
+        if self._dtype != "float32":
+            data = data.astype(self._dtype)
+            label = label.astype(self._dtype)
+        return DataBatch([array(data)], [array(label)], pad=pad.value)
+
+    def iter_next(self):
+        raise NotImplementedError("ImageRecordIter uses next() directly")
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            try:
+                self._L.MXTPUImageIterFree(self._handle)
+            except Exception:
+                pass
+            self._handle = None

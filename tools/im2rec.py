@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder (or .lst manifest) into RecordIO
+(ref: tools/im2rec.py / tools/im2rec.cc).
+
+Usage:
+    python tools/im2rec.py prefix image_root [--list] [--recursive]
+        [--quality 95] [--resize N] [--num-thread N]
+
+With ``--list``, writes ``prefix.lst`` (``index\\tlabel\\tpath`` lines,
+labels = per-subdirectory class ids, like the reference's list mode).
+Without it, reads ``prefix.lst`` and writes ``prefix.rec`` + ``prefix.idx``.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = {".jpg", ".jpeg", ".png"}
+
+
+def make_list(prefix, root, recursive=False):
+    classes = []
+    if recursive:
+        for d in sorted(os.listdir(root)):
+            if os.path.isdir(os.path.join(root, d)):
+                classes.append(d)
+    entries = []
+    if classes:
+        for label, d in enumerate(classes):
+            for fn in sorted(os.listdir(os.path.join(root, d))):
+                if os.path.splitext(fn)[1].lower() in _EXTS:
+                    entries.append((label, os.path.join(d, fn)))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if os.path.splitext(fn)[1].lower() in _EXTS:
+                entries.append((0, fn))
+    with open(prefix + ".lst", "w") as f:
+        for i, (label, rel) in enumerate(entries):
+            f.write("%d\t%f\t%s\n" % (i, float(label), rel))
+    print("wrote %s (%d entries, %d classes)"
+          % (prefix + ".lst", len(entries), max(1, len(classes))))
+
+
+def make_rec(prefix, root, quality=95, resize=0):
+    import cv2
+
+    from mxnet_tpu import recordio
+
+    record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(prefix + ".lst") as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, rel = int(parts[0]), float(parts[1]), parts[-1]
+            img = cv2.imread(os.path.join(root, rel), cv2.IMREAD_COLOR)
+            if img is None:
+                print("skipping unreadable %s" % rel, file=sys.stderr)
+                continue
+            if resize:
+                h, w = img.shape[:2]
+                if h < w:
+                    img = cv2.resize(img, (int(w * resize / h), resize))
+                else:
+                    img = cv2.resize(img, (resize, int(h * resize / w)))
+            header = recordio.IRHeader(0, label, idx, 0)
+            record.write_idx(idx, recordio.pack_img(header, img,
+                                                    quality=quality))
+            n += 1
+    record.close()
+    print("wrote %s.rec / %s.idx (%d records)" % (prefix, prefix, n))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true", help="generate .lst only")
+    p.add_argument("--recursive", action="store_true",
+                   help="per-subdirectory class labels")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--resize", type=int, default=0)
+    args = p.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root, args.recursive)
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            make_list(args.prefix, args.root, True)
+        make_rec(args.prefix, args.root, args.quality, args.resize)
+
+
+if __name__ == "__main__":
+    main()
