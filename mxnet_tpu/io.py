@@ -465,7 +465,9 @@ class ImageRecordIter(DataIter):
         std = (_ct.c_float * 3)(std_r, std_g, std_b)
         handle = _ct.c_void_p()
         rc = self._L.MXTPUImageIterCreate(
-            str(path_imgrec).encode(), int(batch_size), c, h, w,
+            str(path_imgrec).encode(),
+            str(path_imgidx).encode() if path_imgidx else b"",
+            int(batch_size), c, h, w,
             int(bool(shuffle)), int(bool(rand_crop)), int(bool(rand_mirror)),
             mean, std, int(preprocess_threads), int(seed),
             self._label_width, int(resize), int(bool(round_batch)),

@@ -23,6 +23,9 @@ from . import register as _register
 # imperative random namespace: mx.nd.random.uniform(...)
 from .. import random  # noqa: F401
 
+# mx.nd.linalg.gemm2(...) etc. (ref: python/mxnet/ndarray/linalg.py)
+from . import linalg  # noqa: F401
+
 # generate one function per registered op into this module
 _register.populate(globals())
 

@@ -1,0 +1,17 @@
+"""``mx.nd.linalg`` — advanced linear algebra (ref: python/mxnet/ndarray/linalg.py).
+
+Short names (``gemm``, ``potrf``, ...) delegating to the ``_linalg_*``
+operator registrations in :mod:`mxnet_tpu.ops.linalg`.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .register import _make_wrapper
+
+_PREFIX = "_linalg_"
+
+for _name in list(_registry._REGISTRY):
+    if _name.startswith(_PREFIX):
+        globals()[_name[len(_PREFIX):]] = _make_wrapper(_registry.get(_name))
+
+del _name

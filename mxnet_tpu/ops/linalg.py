@@ -1,0 +1,197 @@
+"""Advanced linear-algebra operators (ref: src/operator/tensor/la_op.cc).
+
+The reference implements these over BLAS/LAPACK (``src/operator/tensor/
+c_lapack_api.h``) with hand-written backward passes (``la_op-inl.h``).  On
+TPU every op lowers to XLA's native linalg HLOs (Cholesky, TriangularSolve,
+Eigh, QR) which run on the MXU; gradients come from jax's differentiable
+implementations, so the hand-derived backward kernels collapse away.
+
+All ops operate on the trailing two dimensions with arbitrary leading batch
+dims, matching the reference's "tensors of matrices" convention.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _t(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+def _op_mat(x, transpose):
+    return _t(x) if transpose else x
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0, **_):
+    """out = alpha * op(A) @ op(B) + beta * C (ref: la_op.cc _linalg_gemm)."""
+    return alpha * jnp.matmul(_op_mat(A, transpose_a), _op_mat(B, transpose_b)) + beta * C
+
+
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def _linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **_):
+    """out = alpha * op(A) @ op(B) (ref: la_op.cc _linalg_gemm2)."""
+    return alpha * jnp.matmul(_op_mat(A, transpose_a), _op_mat(B, transpose_b))
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def _linalg_potrf(A, **_):
+    """Cholesky factor L with A = L @ L.T, L lower triangular
+    (ref: la_op.cc _linalg_potrf)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def _linalg_potri(A, **_):
+    """Inverse of B from its Cholesky factor A (B = A @ A.T, out = B^-1)
+    (ref: la_op.cc _linalg_potri).  Solved as two triangular solves against
+    the identity — XLA TriangularSolve, no explicit inverse kernel."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_l = jax.lax.linalg.triangular_solve(A, eye, left_side=True, lower=True)
+    return jax.lax.linalg.triangular_solve(
+        A, inv_l, left_side=True, lower=True, transpose_a=True
+    )
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **_):
+    """Triangular matrix multiply: out = alpha * op(tri(A)) @ B (or B @ op(tri(A))
+    with ``rightside``) (ref: la_op.cc _linalg_trmm).  Only A's triangle is
+    read, matching BLAS trmm."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = _op_mat(tri, transpose)
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **_):
+    """Solve op(tri(A)) @ X = alpha * B (or X @ op(tri(A)) = alpha * B with
+    ``rightside``) (ref: la_op.cc _linalg_trsm)."""
+    return jax.lax.linalg.triangular_solve(
+        A,
+        alpha * B,
+        left_side=not rightside,
+        lower=lower,
+        transpose_a=transpose,
+    )
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def _linalg_sumlogdiag(A, **_):
+    """Sum of log of the diagonal elements (ref: la_op.cc _linalg_sumlogdiag)."""
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def _linalg_syrk(A, transpose=False, alpha=1.0, **_):
+    """Symmetric rank-k update: out = alpha * A @ A.T (or A.T @ A)
+    (ref: la_op.cc _linalg_syrk)."""
+    op_a = _op_mat(A, transpose)
+    return alpha * jnp.matmul(op_a, _t(op_a))
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2,
+          input_names=("A",))
+def _linalg_gelqf(A, **_):
+    """LQ factorization A = L @ Q with Q's rows orthonormal, for m <= n
+    (ref: la_op.cc _linalg_gelqf).  Computed as QR of A.T — XLA's QR HLO —
+    then transposed back."""
+    q, r = jnp.linalg.qr(_t(A), mode="reduced")
+    return _t(q), _t(r)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2,
+          input_names=("A",))
+def _linalg_syevd(A, **_):
+    """Symmetric eigendecomposition A = U.T @ diag(L) @ U, eigenvectors as
+    *rows* of U (ref: la_op.cc _linalg_syevd; the row convention is MXNet's).
+    Lowered to XLA Eigh (jnp.linalg.eigh returns column eigenvectors)."""
+    w, v = jnp.linalg.eigh(A)
+    return _t(v), w
+
+
+@register("_linalg_makediag", aliases=("linalg_makediag",))
+def _linalg_makediag(A, offset=0, **_):
+    """Expand the last axis into a diagonal matrix (ref: la_op.cc
+    _linalg_makediag)."""
+    n = A.shape[-1] + abs(offset)
+    base = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    return base.at[..., rows, cols].set(A)
+
+
+@register("_linalg_extractdiag", aliases=("linalg_extractdiag",))
+def _linalg_extractdiag(A, offset=0, **_):
+    """Extract a diagonal from the trailing matrix (ref: la_op.cc
+    _linalg_extractdiag)."""
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+def _trian_indices(n, offset, lower):
+    """Row/col indices of the triangle selected by (offset, lower): positive
+    offset = upper band, negative = lower band, zero = full triangle chosen
+    by ``lower`` (matches mxnet's linalg_extracttrian docs)."""
+    import numpy as _np
+
+    if offset > 0:
+        return _np.triu_indices(n, k=offset)
+    if offset < 0:
+        return _np.tril_indices(n, k=offset)
+    return _np.tril_indices(n) if lower else _np.triu_indices(n)
+
+
+@register("_linalg_maketrian", aliases=("linalg_maketrian",))
+def _linalg_maketrian(A, offset=0, lower=True, **_):
+    """Pack a vector of triangle entries into a triangular matrix
+    (later-era la_op extension kept for completeness).  ``offset > 0``
+    selects the upper band at that offset, ``offset < 0`` the lower band;
+    ``lower`` applies only when ``offset == 0``."""
+    import numpy as _np
+
+    k = A.shape[-1]
+    off = abs(offset)
+    # k = m*(m+1)/2 entries for the triangle of an m x m block; the full
+    # matrix is n = m + off per side so the offset diagonal fits
+    m = int((_np.sqrt(8 * k + 1) - 1) // 2)
+    n = m + off
+    base = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    rows, cols = _trian_indices(n, offset, lower)
+    return base.at[..., rows, cols].set(A)
+
+
+@register("_linalg_extracttrian", aliases=("linalg_extracttrian",))
+def _linalg_extracttrian(A, offset=0, lower=True, **_):
+    """Extract triangle entries as a vector (later-era la_op extension).
+    ``offset > 0`` reads the upper band at that offset, ``offset < 0`` the
+    lower band; ``lower`` applies only when ``offset == 0``."""
+    rows, cols = _trian_indices(A.shape[-1], offset, lower)
+    return A[..., rows, cols]
+
+
+@register("_linalg_inverse", aliases=("linalg_inverse",))
+def _linalg_inverse(A, **_):
+    """General matrix inverse (ref: la_op.cc _linalg_inverse; later-era op kept
+    for completeness — lowers to XLA LU solve)."""
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_slogdet", aliases=("linalg_slogdet",), num_outputs=2,
+          input_names=("A",))
+def _linalg_slogdet(A, **_):
+    """Sign and log|det| (ref: la_op.cc _linalg_slogdet)."""
+    sign, logabs = jnp.linalg.slogdet(A)
+    return sign, logabs
+
+
+@register("_linalg_det", aliases=("linalg_det",))
+def _linalg_det(A, **_):
+    """Determinant (ref: la_op.cc _linalg_det)."""
+    return jnp.linalg.det(A)

@@ -4,3 +4,6 @@ from . import register as _register
 from .infer import infer_shape, infer_type
 
 _register.populate(globals())
+
+# mx.sym.linalg.gemm2(...) etc. (ref: python/mxnet/symbol/linalg.py)
+from . import linalg  # noqa: F401

@@ -1,0 +1,13 @@
+"""``mx.sym.linalg`` — symbolic linear algebra (ref: python/mxnet/symbol/linalg.py)."""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .register import _make_wrapper
+
+_PREFIX = "_linalg_"
+
+for _name in list(_registry._REGISTRY):
+    if _name.startswith(_PREFIX):
+        globals()[_name[len(_PREFIX):]] = _make_wrapper(_registry.get(_name))
+
+del _name

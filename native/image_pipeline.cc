@@ -136,6 +136,7 @@ struct BatchBuf {
 struct ImageIter {
   ImageIterCfg cfg;
   std::string rec_path;
+  std::string idx_path;
   std::vector<size_t> offsets;  // record start offsets
   std::vector<size_t> order;    // epoch order (item -> record id)
   size_t n_items = 0;           // items this epoch (incl. padded tail)
@@ -168,7 +169,25 @@ struct ImageIter {
     abort_flag = false;
   }
 
+  bool LoadIndex() {
+    // .idx sidecar: "key\tpos\n" per record (tools/im2rec format); avoids a
+    // full sequential scan of the .rec at construction
+    FILE *fp = fopen(idx_path.c_str(), "r");
+    if (!fp) return false;
+    offsets.clear();
+    char line[256];
+    while (fgets(line, sizeof(line), fp)) {
+      char *tab = strchr(line, '\t');
+      if (!tab) continue;
+      offsets.push_back(strtoull(tab + 1, nullptr, 10));
+    }
+    fclose(fp);
+    std::sort(offsets.begin(), offsets.end());
+    return !offsets.empty();
+  }
+
   bool ScanOffsets() {
+    if (!idx_path.empty() && LoadIndex()) return true;
     RecordIOHandle r;
     if (MXTPURecordIOReaderCreate(rec_path.c_str(), &r) != 0) return false;
     offsets.clear();
@@ -398,8 +417,22 @@ struct ImageIter {
 
     // HWC u8 → CHW f32 normalized into the batch buffer
     float *dst = bb.data.data() + in_batch * size_t(cfg.c) * th * tw;
+    if (cfg.c == 1 && src_ch >= 3) {
+      // grayscale target from a color decode: BT.601 luma, matching the
+      // reference's grayscale imdecode path (iter_image_recordio_2.cc)
+      float mean = cfg.mean[0], inv = cfg.std[0] != 0.f ? 1.f / cfg.std[0] : 1.f;
+      for (int y = 0; y < th; ++y) {
+        for (int x = 0; x < tw; ++x) {
+          int sx = mirror ? tw - 1 - x : x;
+          const uint8_t *px = plane + (size_t(y) * tw + sx) * src_ch;
+          float luma = 0.299f * px[0] + 0.587f * px[1] + 0.114f * px[2];
+          dst[size_t(y) * tw + x] = (luma - mean) * inv;
+        }
+      }
+      return true;
+    }
     for (int ch = 0; ch < cfg.c; ++ch) {
-      int sc = std::min(ch, src_ch - 1);  // grayscale targets read channel 0
+      int sc = std::min(ch, src_ch - 1);
       float mean = cfg.mean[ch % 3], stdv = cfg.std[ch % 3];
       float inv = stdv != 0.f ? 1.f / stdv : 1.f;
       for (int y = 0; y < th; ++y) {
@@ -455,7 +488,8 @@ typedef void *ImageIterHandle;
 
 const char *MXTPUImageIterGetLastError(void) { return g_iter_error.c_str(); }
 
-int MXTPUImageIterCreate(const char *rec_path, int batch, int c, int h, int w,
+int MXTPUImageIterCreate(const char *rec_path, const char *idx_path,
+                         int batch, int c, int h, int w,
                          int shuffle, int rand_crop, int rand_mirror,
                          const float *mean, const float *std_, int nthreads,
                          int seed, int label_width, int resize_shorter,
@@ -469,6 +503,7 @@ int MXTPUImageIterCreate(const char *rec_path, int batch, int c, int h, int w,
                          nthreads,  seed,      label_width,
                          resize_shorter, round_batch};
   it->rec_path = rec_path;
+  it->idx_path = idx_path ? idx_path : "";
   if (!it->ScanOffsets()) {
     g_iter_error = MXTPURecordIOGetLastError();
     delete it;

@@ -68,6 +68,11 @@ struct Writer {
       } else {
         cflag = 2;
       }
+      if (end - begin >= (size_t(1) << 29)) {
+        // LRec packs the length into 29 bits (dmlc-core recordio framing);
+        // refuse instead of silently truncating the stream
+        return false;
+      }
       uint32_t len = static_cast<uint32_t>(end - begin);
       uint32_t lrec = EncodeLRec(cflag, len);
       if (!WriteAll(&kMagic, 4)) return false;

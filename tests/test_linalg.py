@@ -1,0 +1,135 @@
+"""linalg op tests (modelled on tests/python/unittest/test_operator.py's
+test_laop* — forward numerics against numpy + finite-difference gradients)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def _rand_spd(n, batch=(), dtype="float64"):
+    a = np.random.rand(*batch, n, n).astype(dtype)
+    return np.matmul(a, np.swapaxes(a, -1, -2)) + n * np.eye(n, dtype=dtype)
+
+
+def test_gemm():
+    A = np.random.rand(2, 3, 4).astype("float64")
+    B = np.random.rand(2, 4, 5).astype("float64")
+    C = np.random.rand(2, 3, 5).astype("float64")
+    out = nd.linalg.gemm(nd.array(A), nd.array(B), nd.array(C), alpha=2.0, beta=0.5)
+    assert_almost_equal(out, 2.0 * A @ B + 0.5 * C, rtol=1e-8, atol=1e-8)
+    out = nd.linalg.gemm(
+        nd.array(np.swapaxes(A, -1, -2)), nd.array(B), nd.array(C), transpose_a=True
+    )
+    assert_almost_equal(out, A @ B + C, rtol=1e-8, atol=1e-8)
+
+
+def test_gemm2_grad():
+    A = nd.array(np.random.rand(3, 4).astype("float64"))
+    B = nd.array(np.random.rand(4, 2).astype("float64"))
+    check_numeric_gradient(lambda a, b: nd.linalg.gemm2(a, b, alpha=1.5), [A, B])
+
+
+def test_potrf_potri_sumlogdiag():
+    S = _rand_spd(4, batch=(2,))
+    L = nd.linalg.potrf(nd.array(S))
+    assert_almost_equal(np.matmul(L.asnumpy(), np.swapaxes(L.asnumpy(), -1, -2)), S,
+                        rtol=1e-6, atol=1e-6)
+    Sinv = nd.linalg.potri(L)
+    assert_almost_equal(np.matmul(Sinv.asnumpy(), S),
+                        np.broadcast_to(np.eye(4), S.shape), rtol=1e-6, atol=1e-6)
+    sld = nd.linalg.sumlogdiag(L)
+    assert_almost_equal(sld, np.sum(np.log(np.diagonal(L.asnumpy(), axis1=-2, axis2=-1)),
+                                    axis=-1), rtol=1e-6, atol=1e-6)
+
+
+def test_potrf_grad():
+    S = nd.array(_rand_spd(3))
+    check_numeric_gradient(lambda a: nd.linalg.potrf(a), [S], eps=1e-6)
+
+
+def test_trmm_trsm():
+    A = np.tril(np.random.rand(4, 4) + np.eye(4) * 4).astype("float64")
+    B = np.random.rand(4, 3).astype("float64")
+    out = nd.linalg.trmm(nd.array(A), nd.array(B), alpha=2.0)
+    assert_almost_equal(out, 2.0 * A @ B, rtol=1e-8, atol=1e-8)
+    X = nd.linalg.trsm(nd.array(A), nd.array(A @ B))
+    assert_almost_equal(X, B, rtol=1e-6, atol=1e-6)
+    # rightside: X @ A = alpha * B
+    Br = np.random.rand(3, 4).astype("float64")
+    Xr = nd.linalg.trsm(nd.array(A), nd.array(Br @ A), rightside=True)
+    assert_almost_equal(Xr, Br, rtol=1e-6, atol=1e-6)
+
+
+def test_syrk():
+    A = np.random.rand(2, 3, 4).astype("float64")
+    out = nd.linalg.syrk(nd.array(A), alpha=0.5)
+    assert_almost_equal(out, 0.5 * A @ np.swapaxes(A, -1, -2), rtol=1e-8, atol=1e-8)
+    out_t = nd.linalg.syrk(nd.array(A), transpose=True)
+    assert_almost_equal(out_t, np.swapaxes(A, -1, -2) @ A, rtol=1e-8, atol=1e-8)
+
+
+def test_gelqf():
+    A = np.random.rand(3, 5).astype("float64")
+    Q, L = nd.linalg.gelqf(nd.array(A))
+    assert_almost_equal(L.asnumpy() @ Q.asnumpy(), A, rtol=1e-6, atol=1e-6)
+    assert_almost_equal(Q.asnumpy() @ Q.asnumpy().T, np.eye(3), rtol=1e-6, atol=1e-6)
+    # L lower triangular
+    assert_almost_equal(np.triu(L.asnumpy(), k=1), np.zeros((3, 3)), rtol=0, atol=1e-12)
+
+
+def test_syevd():
+    S = _rand_spd(4)
+    U, lam = nd.linalg.syevd(nd.array(S))
+    Un, ln = U.asnumpy(), lam.asnumpy()
+    # MXNet convention: A = U^T diag(lam) U (rows of U are eigenvectors)
+    assert_almost_equal(Un.T @ np.diag(ln) @ Un, S, rtol=1e-6, atol=1e-6)
+
+
+def test_makediag_extractdiag():
+    v = np.random.rand(2, 3).astype("float64")
+    D = nd.linalg.makediag(nd.array(v))
+    assert D.shape == (2, 3, 3)
+    assert_almost_equal(nd.linalg.extractdiag(D), v, rtol=0, atol=0)
+    D1 = nd.linalg.makediag(nd.array(v), offset=1)
+    assert D1.shape == (2, 4, 4)
+    assert_almost_equal(nd.linalg.extractdiag(D1, offset=1), v, rtol=0, atol=0)
+
+
+def test_maketrian_extracttrian():
+    A = np.tril(np.random.rand(3, 3)).astype("float64")
+    v = nd.linalg.extracttrian(nd.array(A))
+    assert v.shape == (6,)
+    back = nd.linalg.maketrian(v)
+    assert_almost_equal(back, A, rtol=0, atol=0)
+    # positive offset selects the upper band regardless of `lower`
+    M = np.arange(9, dtype="float64").reshape(3, 3)
+    vu = nd.linalg.extracttrian(nd.array(M), offset=1)
+    assert_almost_equal(vu, np.array([1.0, 2.0, 5.0]), rtol=0, atol=0)
+    vl = nd.linalg.extracttrian(nd.array(M), offset=-1)
+    assert_almost_equal(vl, np.array([3.0, 6.0, 7.0]), rtol=0, atol=0)
+    bu = nd.linalg.maketrian(vu, offset=1).asnumpy()
+    assert_almost_equal(bu, np.array([[0, 1, 2], [0, 0, 5], [0, 0, 0]],
+                                     dtype="float64"), rtol=0, atol=0)
+
+
+def test_inverse_det_slogdet():
+    A = _rand_spd(3)
+    Ainv = nd.linalg.inverse(nd.array(A))
+    assert_almost_equal(Ainv.asnumpy() @ A, np.eye(3), rtol=1e-6, atol=1e-6)
+    d = nd.linalg.det(nd.array(A))
+    assert_almost_equal(d, np.linalg.det(A), rtol=1e-6, atol=1e-6)
+    sign, logabs = nd.linalg.slogdet(nd.array(A))
+    s_np, l_np = np.linalg.slogdet(A)
+    assert_almost_equal(sign, s_np, rtol=1e-6, atol=1e-6)
+    assert_almost_equal(logabs, l_np, rtol=1e-6, atol=1e-6)
+
+
+def test_symbol_linalg():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.linalg.gemm2(a, b)
+    ex = out.bind(mx.cpu(), {"a": nd.array(np.random.rand(3, 4)),
+                             "b": nd.array(np.random.rand(4, 2))})
+    y = ex.forward()[0]
+    assert y.shape == (3, 2)

@@ -142,6 +142,37 @@ def test_image_record_iter(image_rec):
                                b.data[0].asnumpy(), rtol=1e-6)
 
 
+def test_image_record_iter_with_idx(image_rec):
+    """path_imgidx loads offsets from the .idx sidecar (no full .rec scan)
+    and yields the identical stream."""
+    prefix, labels = image_rec
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+        data_shape=(3, 32, 32), batch_size=6,
+        shuffle=False, preprocess_threads=2, round_batch=False)
+    assert it.num_records == 24
+    ref = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", data_shape=(3, 32, 32), batch_size=6,
+        shuffle=False, preprocess_threads=2, round_batch=False)
+    for b, r in zip(it, ref):
+        np.testing.assert_allclose(b.data[0].asnumpy(), r.data[0].asnumpy(),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(b.label[0].asnumpy(), r.label[0].asnumpy())
+
+
+def test_image_record_iter_grayscale(image_rec):
+    """c=1 data_shape converts color JPEGs via BT.601 luma, not channel R."""
+    prefix, labels = image_rec
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", data_shape=(1, 32, 32), batch_size=6,
+        shuffle=False, preprocess_threads=2, round_batch=False)
+    b = next(it)
+    assert b.data[0].shape == (6, 1, 32, 32)
+    # class-0 grey-ish images: luma ≈ channel mean ≈ 40-60
+    mean_px = float(b.data[0].asnumpy().mean())
+    assert 30 < mean_px < 70
+
+
 def test_image_record_iter_shuffle_and_augment(image_rec):
     prefix, labels = image_rec
     it = mx.io.ImageRecordIter(
