@@ -37,6 +37,7 @@ from . import metric
 from . import lr_scheduler
 from . import io
 from . import recordio
+from . import image
 from . import callback
 from . import model
 from . import kvstore

@@ -1,0 +1,9 @@
+"""mx.image — Python-side image loading + augmentation pipeline.
+
+ref: python/mxnet/image/__init__.py. The flexible, per-image Python
+pipeline; the high-throughput batch path is the native C++
+ImageRecordIter (native/image_pipeline.cc).
+"""
+from .image import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from . import image, detection  # noqa: F401

@@ -1,0 +1,558 @@
+"""Detection-aware augmenters + ImageDetIter
+(ref: python/mxnet/image/detection.py).
+
+Labels ride through augmentation as numpy arrays of shape
+(num_objects, 5+): [class_id, xmin, ymin, xmax, ymax, ...] with
+coordinates normalized to [0, 1] — the reference's layout
+(detection.py:711 _parse_label).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import random as pyrandom
+
+import numpy as np
+
+from .. import io
+from ..ndarray import NDArray, array
+from . import image as _img
+from .image import (Augmenter, CastAug, ColorJitterAug, ColorNormalizeAug,
+                    ForceResizeAug, HueJitterAug, ImageIter, LightingAug,
+                    RandomGrayAug, ResizeAug, _to_np)
+
+__all__ = [
+    "DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+    "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+    "CreateMultiRandCropAugmenter", "CreateDetAugmenter", "ImageDetIter",
+]
+
+
+class DetAugmenter(object):
+    """Detection augmenter: __call__(src, label) → (src, label)
+    (ref: detection.py:39)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                kwargs[k] = v.asnumpy().tolist()
+            elif isinstance(v, np.ndarray):
+                kwargs[k] = v.tolist()
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter; label passes through
+    (ref: detection.py:65)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise TypeError("Borrowing from invalid Augmenter")
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(), self.augmenter.dumps()]
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one augmenter from a list (or skip with skip_prob)
+    (ref: detection.py:90)."""
+
+    def __init__(self, aug_list, skip_prob=0):
+        super().__init__(skip_prob=skip_prob)
+        if not isinstance(aug_list, (list, tuple)):
+            aug_list = [aug_list]
+        for aug in aug_list:
+            if not isinstance(aug, DetAugmenter):
+                raise ValueError("Allow DetAugmenter in list only")
+        if not aug_list:
+            skip_prob = 1  # disabled
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [x.dumps() for x in self.aug_list]]
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob:
+            return src, label
+        t = pyrandom.choice(self.aug_list)
+        return t(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image + x-coords of boxes (ref: detection.py:126)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = _to_np(src)[:, ::-1]
+            label = self._flip_label(label)
+        return src, label
+
+    def _flip_label(self, label):
+        label = np.array(label, copy=True)
+        valid = np.where(label[:, 0] > -1)[0]
+        tmp = 1.0 - label[valid, 1]
+        label[valid, 1] = 1.0 - label[valid, 3]
+        label[valid, 3] = tmp
+        return label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop with constraints on object coverage
+    (ref: detection.py:152 — the SSD sampling strategy)."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 min_eject_coverage=0.3, max_attempts=50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.enabled = (area_range[1] > area_range[0]
+                        or area_range[0] < 1.0 or area_range[0] > 1.0)
+        if not (area_range[0] <= area_range[1] and 0 < area_range[1] <= 1):
+            logging.warning("Skip DetRandomCropAug due to invalid "
+                            "area_range: %s", area_range)
+            self.enabled = False
+
+    def __call__(self, src, label):
+        crop = self._random_crop_proposal(label, *_to_np(src).shape[:2])
+        if crop:
+            x, y, w, h, label = crop
+            src = _img.fixed_crop(_to_np(src), x, y, w, h)
+        return src, label
+
+    def _calculate_areas(self, label):
+        heights = np.maximum(0, label[:, 3] - label[:, 1])
+        widths = np.maximum(0, label[:, 2] - label[:, 0])
+        return heights * widths
+
+    def _intersect(self, label, xmin, ymin, xmax, ymax):
+        left = np.maximum(label[:, 0], xmin)
+        right = np.minimum(label[:, 2], xmax)
+        top = np.maximum(label[:, 1], ymin)
+        bot = np.minimum(label[:, 3], ymax)
+        invalid = np.where(np.logical_or(left >= right, top >= bot))[0]
+        out = label.copy()
+        out[:, 0] = left
+        out[:, 1] = top
+        out[:, 2] = right
+        out[:, 3] = bot
+        out[invalid, :] = 0
+        return out
+
+    def _check_satisfy_constraints(self, label, xmin, ymin, xmax, ymax,
+                                   width, height):
+        if (xmax - xmin) * (ymax - ymin) < 2:
+            return False
+        x1 = float(xmin) / width
+        y1 = float(ymin) / height
+        x2 = float(xmax) / width
+        y2 = float(ymax) / height
+        object_areas = self._calculate_areas(label[:, 1:])
+        valid_objects = np.where(object_areas * width * height > 2)[0]
+        if valid_objects.size < 1:
+            return False
+        intersects = self._intersect(label[valid_objects, 1:], x1, y1,
+                                     x2, y2)
+        coverages = self._calculate_areas(intersects) / \
+            object_areas[valid_objects]
+        coverages = coverages[np.where(coverages > 0)[0]]
+        return coverages.size > 0 and np.amin(coverages) > \
+            self.min_object_covered
+
+    def _update_labels(self, label, crop_box, height, width):
+        xmin = float(crop_box[0]) / width
+        ymin = float(crop_box[1]) / height
+        w = float(crop_box[2]) / width
+        h = float(crop_box[3]) / height
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] - xmin) / w
+        out[:, (2, 4)] = (out[:, (2, 4)] - ymin) / h
+        out[:, 1:5] = np.maximum(0, out[:, 1:5])
+        out[:, 1:5] = np.minimum(1, out[:, 1:5])
+        coverage = self._calculate_areas(out[:, 1:]) * w * h / \
+            np.maximum(self._calculate_areas(label[:, 1:]), 1e-12)
+        valid = np.logical_and(out[:, 3] > out[:, 1], out[:, 4] > out[:, 2])
+        valid = np.logical_and(valid, coverage > self.min_eject_coverage)
+        valid = np.where(valid)[0]
+        if valid.size < 1:
+            return None
+        return out[valid, :]
+
+    def _random_crop_proposal(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return ()
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h = int(round(np.sqrt(min_area / ratio)))
+            max_h = int(round(np.sqrt(max_area / ratio)))
+            if round(max_h * ratio) > width:
+                max_h = int((width + 0.4999999) / ratio)
+            if max_h > height:
+                max_h = height
+            if h > max_h:
+                h = max_h
+            if h < max_h:
+                h = pyrandom.randint(h, max_h)
+            w = int(round(h * ratio))
+            area = w * h
+            if area < min_area or area > max_area or w > width or h > height:
+                continue
+            y = pyrandom.randint(0, max(0, height - h))
+            x = pyrandom.randint(0, max(0, width - w))
+            if self._check_satisfy_constraints(label, x, y, x + w, y + h,
+                                               width, height):
+                new_label = self._update_labels(label, (x, y, w, h),
+                                                height, width)
+                if new_label is not None:
+                    return (x, y, w, h, new_label)
+        return ()
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding (zoom-out) (ref: detection.py:325)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(128, 128, 128)):
+        if not isinstance(pad_val, (list, tuple)):
+            pad_val = (pad_val,)
+        if not isinstance(aspect_ratio_range, (list, tuple)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = pad_val
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.enabled = area_range[1] > 1.0 and \
+            area_range[0] >= 1.0 and \
+            aspect_ratio_range[0] <= aspect_ratio_range[1]
+        if not self.enabled:
+            logging.warning("Skip DetRandomPadAug due to invalid "
+                            "parameters: %s, %s", area_range,
+                            aspect_ratio_range)
+
+    def __call__(self, src, label):
+        a = _to_np(src)
+        height, width = a.shape[:2]
+        pad = self._random_pad_proposal(label, height, width)
+        if pad:
+            x, y, w, h, label = pad
+            out = np.full((h, w, a.shape[2]), self.pad_val[:a.shape[2]] if
+                          len(self.pad_val) >= a.shape[2] else
+                          self.pad_val[0], dtype=a.dtype)
+            out[y:y + height, x:x + width, :] = a
+            a = out
+        return a, label
+
+    def _update_labels(self, label, pad_box, height, width):
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] * width + pad_box[0]) / pad_box[2]
+        out[:, (2, 4)] = (out[:, (2, 4)] * height + pad_box[1]) / pad_box[3]
+        return out
+
+    def _random_pad_proposal(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return ()
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if ratio <= 0:
+                continue
+            h = int(round(np.sqrt(min_area / ratio)))
+            max_h = int(round(np.sqrt(max_area / ratio)))
+            if round(h * ratio) < width:
+                h = int((width + 0.499999) / ratio)
+            if h < height:
+                h = height
+            if h > max_h:
+                h = max_h
+            if h < max_h:
+                h = pyrandom.randint(h, max_h)
+            w = int(round(h * ratio))
+            if w * h < min_area or w * h > max_area:
+                continue
+            if w < width or h < height:
+                continue
+            x = pyrandom.randint(0, max(0, w - width))
+            y = pyrandom.randint(0, max(0, h - height))
+            new_label = self._update_labels(label, (x, y, w, h),
+                                            height, width)
+            return (x, y, w, h, new_label)
+        return ()
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """Batch-create a DetRandomSelectAug of crop augmenters from
+    list-valued params (ref: detection.py:419)."""
+    def align_parameters(params):
+        out_params = []
+        num = 1
+        for p in params:
+            if not isinstance(p, list):
+                p = [p]
+            out_params.append(p)
+            num = max(num, len(p))
+        for k, p in enumerate(out_params):
+            if len(p) != num:
+                assert len(p) == 1
+                out_params[k] = p * num
+        return out_params
+
+    aligned_params = align_parameters([min_object_covered,
+                                       aspect_ratio_range, area_range,
+                                       min_eject_coverage, max_attempts])
+    augs = []
+    for moc, arr, ar, mec, ma in zip(*aligned_params):
+        augs.append(DetRandomCropAug(min_object_covered=moc,
+                                     aspect_ratio_range=arr, area_range=ar,
+                                     min_eject_coverage=mec,
+                                     max_attempts=ma))
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection augmenter list (ref: detection.py:484)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop_augs = CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(1.0, area_range[1])), min_eject_coverage,
+            max_attempts, skip_prob=(1 - rand_crop))
+        auglist.append(crop_augs)
+    if rand_mirror > 0:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # apply pad before color jitter so pad_val is in raw pixel units
+    if rand_pad > 0:
+        pad_aug = DetRandomPadAug(aspect_ratio_range,
+                                  (1.0, area_range[1]), max_attempts,
+                                  pad_val)
+        auglist.append(DetRandomSelectAug([pad_aug], 1 - rand_pad))
+    # force resize to the network input size
+    auglist.append(DetBorrowAug(ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
+                                                   saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = np.asarray(mean)
+        assert mean.shape[0] in [1, 3]
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = np.asarray(std)
+        assert std.shape[0] in [1, 3]
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: variable-object labels padded to a fixed
+    (batch, num_obj, label_width) block with header_width metadata
+    (ref: detection.py:626)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="label",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, path_imgidx=path_imgidx,
+                         shuffle=shuffle, part_index=part_index,
+                         num_parts=num_parts, aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name,
+                         last_batch_handle=last_batch_handle, **kwargs)
+        if aug_list is None:
+            self.auglist = CreateDetAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        # estimate label shape by scanning
+        self.max_objects, self.label_width_det = self._estimate_label_shape()
+        self.label_shape = (self.max_objects, self.label_width_det)
+        self.provide_label_ = [io.DataDesc(
+            label_name, (self.batch_size,) + self.label_shape, "float32")]
+
+    def _check_valid_label(self, label):
+        if len(label.shape) != 2 or label.shape[1] < 5:
+            raise RuntimeError("Label with shape (1+, 5+) required, %s "
+                               "received." % str(label))
+        valid_label = np.where(np.logical_and(
+            label[:, 0] >= 0, label[:, 3] > label[:, 1]))[0]
+        if valid_label.size < 1:
+            raise RuntimeError("Invalid label occurs.")
+
+    def _estimate_label_shape(self):
+        """Scan the dataset once for the max object count
+        (ref: detection.py:697)."""
+        max_count = 0
+        label_width = 6
+        self.reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                label = self._parse_label(label)
+                max_count = max(max_count, label.shape[0])
+                label_width = label.shape[1]
+        except StopIteration:
+            pass
+        self.reset()
+        return max(max_count, 1), label_width
+
+    def _parse_label(self, label):
+        """Header-format label → (num_obj, width) float array
+        (ref: detection.py:711). Raw layout: [header_width, obj_width,
+        (extras...), obj0..., obj1...]."""
+        if isinstance(label, NDArray):
+            label = label.asnumpy()
+        raw = np.asarray(label).ravel().astype(np.float32)
+        if raw.size < 7:
+            raise RuntimeError("Label shape is invalid: " + str(raw.shape))
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if (raw.size - header_width) % obj_width != 0:
+            raise RuntimeError("Label shape %s inconsistent with annotation "
+                               "width %d." % (str(raw.shape), obj_width))
+        out = raw[header_width:].reshape(-1, obj_width)
+        self._check_valid_label(out)
+        return out
+
+    def reshape(self, data_shape=None, label_shape=None):
+        """Change data/label shape between epochs (ref: detection.py:737)."""
+        if data_shape is not None:
+            self.check_data_shape(data_shape)
+            self.provide_data_ = [io.DataDesc(
+                self.provide_data_[0].name,
+                (self.batch_size,) + data_shape,
+                self.provide_data_[0].dtype)]
+            self.data_shape = data_shape
+        if label_shape is not None:
+            self.check_label_shape(label_shape)
+            self.label_shape = label_shape
+            self.provide_label_ = [io.DataDesc(
+                self.provide_label_[0].name,
+                (self.batch_size,) + label_shape, "float32")]
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
+        batch_label = np.full((batch_size,) + self.label_shape, -1.0,
+                              dtype=np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                raw_label, s = self.next_sample()
+                data = self.imdecode(s)
+                try:
+                    self.check_valid_image(data)
+                    label = self._parse_label(raw_label)
+                except RuntimeError as e:
+                    logging.debug("Invalid image, skipping:  %s", str(e))
+                    continue
+                data, label = self.augmentation_transform(data, label)
+                n = min(label.shape[0], self.label_shape[0])
+                batch_label[i, :n, :label.shape[1]] = label[:n]
+                batch_data[i] = self.postprocess_data(data)
+                i += 1
+        except StopIteration:
+            if not i:
+                raise StopIteration
+        pad = batch_size - i
+        if pad != 0 and self.last_batch_handle == "discard":
+            raise StopIteration
+        if pad != 0:
+            self._allow_read = False
+        return io.DataBatch([array(batch_data)], [array(batch_label)],
+                            pad=pad)
+
+    def augmentation_transform(self, data, label):
+        for aug in self.auglist:
+            data, label = aug(data, label)
+        return _to_np(data), label
+
+    def check_label_shape(self, label_shape):
+        if not len(label_shape) == 2:
+            raise ValueError("label_shape should have length 2")
+        if label_shape[1] < 5:
+            raise ValueError("label_shape[1] should be at least 5")
+
+    def sync_label_shape(self, it, verbose=False):
+        """Synchronize label padding with another iterator (train/val
+        pairs) (ref: detection.py:902)."""
+        assert isinstance(it, ImageDetIter)
+        train_label_shape = self.label_shape
+        val_label_shape = it.label_shape
+        assert train_label_shape[1] == val_label_shape[1]
+        max_count = max(train_label_shape[0], val_label_shape[0])
+        if max_count > train_label_shape[0]:
+            self.reshape(None, (max_count, train_label_shape[1]))
+        if max_count > val_label_shape[0]:
+            it.reshape(None, (max_count, val_label_shape[1]))
+        if verbose and max_count > min(train_label_shape[0],
+                                       val_label_shape[0]):
+            logging.info("Resized label_shape to (%d, %d).", max_count,
+                         train_label_shape[1])
+        return it

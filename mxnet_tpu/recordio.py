@@ -58,6 +58,8 @@ class MXRecordIO:
     def close(self):
         if not getattr(self, "is_open", False):
             return
+        if _native is None or getattr(_native, "lib", None) is None:
+            return  # interpreter shutdown: module globals already torn down
         L = _native.lib()
         if self.writable:
             L.MXTPURecordIOWriterFree(self.handle)
@@ -198,26 +200,41 @@ def unpack(s: bytes):
 
 
 def pack_img(header: IRHeader, img, quality: int = 95, img_fmt: str = ".jpg") -> bytes:
-    """Encode an HWC uint8 image and pack it (ref: recordio.py pack_img)."""
-    import cv2
+    """Encode an HWC uint8 image and pack it (ref: recordio.py pack_img;
+    PIL stands in for OpenCV — the only codec in this image)."""
+    import io as _io
+
+    from PIL import Image
 
     img = np.asarray(img)
-    if img_fmt.lower() in (".jpg", ".jpeg"):
-        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
-    elif img_fmt.lower() == ".png":
-        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality // 10]
+    if img.ndim == 3 and img.shape[2] == 1:
+        img = img[:, :, 0]
+    buf = _io.BytesIO()
+    fmt = img_fmt.lower()
+    if fmt in (".jpg", ".jpeg"):
+        Image.fromarray(img).save(buf, format="JPEG", quality=quality)
+    elif fmt == ".png":
+        Image.fromarray(img).save(buf, format="PNG",
+                                  compress_level=min(9, quality // 10))
     else:
         raise ValueError("unsupported format %r" % img_fmt)
-    ok, buf = cv2.imencode(img_fmt, img, encode_params)
-    if not ok:
-        raise MXNetError("failed to encode image")
-    return pack(header, buf.tobytes())
+    return pack(header, buf.getvalue())
 
 
 def unpack_img(s: bytes, iscolor: int = -1):
-    """ref: recordio.py unpack_img → (IRHeader, BGR ndarray)."""
-    import cv2
+    """ref: recordio.py unpack_img → (IRHeader, HWC uint8 ndarray).
+    iscolor: -1 = as stored (cv2 IMREAD_UNCHANGED), 0 = grayscale,
+    1 = color (RGB here, not OpenCV BGR)."""
+    import io as _io
+
+    from PIL import Image
 
     header, img_bytes = unpack(s)
-    img = cv2.imdecode(np.frombuffer(img_bytes, dtype=np.uint8), iscolor)
+    im = Image.open(_io.BytesIO(img_bytes))
+    if iscolor == 0:
+        img = np.asarray(im.convert("L"))
+    elif iscolor < 0:
+        img = np.asarray(im)
+    else:
+        img = np.asarray(im.convert("RGB"))
     return header, img

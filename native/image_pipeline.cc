@@ -177,9 +177,22 @@ struct ImageIter {
     offsets.clear();
     char line[256];
     while (fgets(line, sizeof(line), fp)) {
+      // skip blank trailing line only; any other malformed line means a
+      // truncated/corrupt index — fail so ScanOffsets falls back to the .rec
+      if (line[0] == '\n' || line[0] == '\0') continue;
       char *tab = strchr(line, '\t');
-      if (!tab) continue;
-      offsets.push_back(strtoull(tab + 1, nullptr, 10));
+      if (!tab) {
+        fclose(fp);
+        return false;
+      }
+      char *endp = nullptr;
+      unsigned long long off = strtoull(tab + 1, &endp, 10);
+      if (endp == tab + 1 || (*endp != '\n' && *endp != '\r' &&
+                              *endp != '\0')) {
+        fclose(fp);
+        return false;
+      }
+      offsets.push_back(off);
     }
     fclose(fp);
     std::sort(offsets.begin(), offsets.end());

@@ -55,6 +55,16 @@ struct Writer {
       }
     }
     size_t npart = splits.size() + 1;
+    // validate every chunk length up front: refusing mid-record would leave
+    // a dangling multi-part record that corrupts the stream for readers
+    {
+      size_t begin = 0;
+      for (size_t p = 0; p < npart; ++p) {
+        size_t end = (p < splits.size()) ? splits[p] : size;
+        if (end - begin >= (size_t(1) << 29)) return false;
+        begin = end;
+      }
+    }
     size_t begin = 0;
     for (size_t p = 0; p < npart; ++p) {
       size_t end = (p < splits.size()) ? splits[p] : size;

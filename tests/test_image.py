@@ -1,0 +1,219 @@
+"""mx.image tests (model: tests/python/unittest/test_image.py in the
+reference — synthetic images instead of downloads)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+from mxnet_tpu.ndarray import NDArray
+
+
+def _synth_img(h=64, w=48, c=3, seed=0):
+    # smooth gradients (JPEG-friendly), offset per seed
+    yy, xx = np.mgrid[0:h, 0:w]
+    chans = [(yy * (i + 1) + xx * (3 - i) + seed * 17) % 256
+             for i in range(c)]
+    return np.stack(chans, axis=2).astype(np.uint8)
+
+
+def _jpeg_bytes(img):
+    import io as _io
+
+    from PIL import Image
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def test_imdecode_imread(tmp_path):
+    img = _synth_img()
+    raw = _jpeg_bytes(img)
+    out = image.imdecode(raw)
+    assert isinstance(out, NDArray)
+    assert out.shape == img.shape
+    # JPEG is lossy; just check it's in the ballpark
+    assert np.abs(out.asnumpy().astype(np.int32) -
+                  img.astype(np.int32)).mean() < 30
+    gray = image.imdecode(raw, flag=0)
+    assert gray.shape == (64, 48, 1)
+    bgr = image.imdecode(raw, to_rgb=False)
+    np.testing.assert_array_equal(bgr.asnumpy(), out.asnumpy()[:, :, ::-1])
+    p = tmp_path / "x.jpg"
+    p.write_bytes(raw)
+    rd = image.imread(str(p))
+    np.testing.assert_array_equal(rd.asnumpy(), out.asnumpy())
+
+
+def test_resize_and_crops():
+    img = _synth_img(100, 80)
+    out = image.imresize(img, 40, 50)
+    assert out.shape == (50, 40, 3)
+    short = image.resize_short(img, 60)
+    assert min(short.shape[:2]) == 60
+    assert short.shape[0] > short.shape[1]  # aspect kept (100x80 → 75x60)
+    crop = image.fixed_crop(img, 10, 20, 30, 40)
+    np.testing.assert_array_equal(crop, img[20:60, 10:40])
+    rc, (x0, y0, w, h) = image.random_crop(img, (32, 24))
+    assert rc.shape == (24, 32, 3)
+    np.testing.assert_array_equal(rc, img[y0:y0 + h, x0:x0 + w])
+    cc, _ = image.center_crop(img, (32, 24))
+    assert cc.shape == (24, 32, 3)
+    rsc, _ = image.random_size_crop(img, (32, 32), 0.3, (0.8, 1.2))
+    assert rsc.shape == (32, 32, 3)
+    assert image.scale_down((30, 40), (50, 50)) == (30, 30)
+
+
+def test_color_normalize_and_pad():
+    img = _synth_img(8, 8)
+    mean = np.array([1.0, 2.0, 3.0], np.float32)
+    std = np.array([2.0, 2.0, 2.0], np.float32)
+    out = image.color_normalize(img, mean, std)
+    np.testing.assert_allclose(out, (img - mean) / std, rtol=1e-5)
+    padded = image.copyMakeBorder(img, 1, 2, 3, 4, values=7)
+    assert padded.shape == (11, 15, 3)
+    assert (padded[0] == 7).all()
+
+
+def test_augmenters_shapes_and_types():
+    img = _synth_img(70, 60)
+    augs = image.CreateAugmenter((3, 32, 32), resize=40, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, hue=0.1, pca_noise=0.1,
+                                 rand_gray=0.5)
+    out = img
+    for a in augs:
+        out = a(out)
+    out = np.asarray(out)
+    assert out.shape == (32, 32, 3)
+    assert out.dtype == np.float32
+    # every augmenter serializes
+    for a in augs:
+        assert a.dumps()
+
+
+def test_augmenter_determinism_flip():
+    img = _synth_img(10, 10)
+    flip = image.HorizontalFlipAug(1.1)  # always flips
+    np.testing.assert_array_equal(np.asarray(flip(img)), img[:, ::-1])
+    noflip = image.HorizontalFlipAug(-0.1)
+    np.testing.assert_array_equal(np.asarray(noflip(img)), img)
+
+
+def _make_rec(tmp_path, n=12, label_width=1, det=False):
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n):
+        img = _synth_img(40 + i, 30 + i, seed=i)
+        if det:
+            # header: [header_width=2, obj_width=5] + one object
+            label = np.array([2, 5, i % 4, 0.1, 0.2, 0.8, 0.9],
+                             dtype=np.float32)
+            header = recordio.IRHeader(0, label, i, 0)
+        else:
+            header = recordio.IRHeader(0, float(i % 4), i, 0)
+        rec.write_idx(i, recordio.pack(header, _jpeg_bytes(img)))
+    rec.close()
+    return rec_path, idx_path
+
+
+def test_image_iter_rec(tmp_path):
+    rec_path, idx_path = _make_rec(tmp_path)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                         path_imgrec=rec_path, path_imgidx=idx_path,
+                         shuffle=True)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 28, 28)
+        assert batch.label[0].shape == (4,)
+        n += 4 - batch.pad
+    assert n == 12
+    it.reset()
+    first = next(iter(it))
+    assert first.data[0].shape == (4, 3, 28, 28)
+
+
+def test_image_iter_imglist(tmp_path):
+    files = []
+    for i in range(6):
+        p = tmp_path / ("img%d.jpg" % i)
+        p.write_bytes(_jpeg_bytes(_synth_img(seed=i)))
+        files.append([float(i % 3), "img%d.jpg" % i])
+    it = image.ImageIter(batch_size=3, data_shape=(3, 24, 24),
+                         imglist=files, path_root=str(tmp_path))
+    batch = next(iter(it))
+    assert batch.data[0].shape == (3, 3, 24, 24)
+    labels = batch.label[0].asnumpy()
+    assert set(labels.tolist()) <= {0.0, 1.0, 2.0}
+
+
+def test_image_iter_sharding(tmp_path):
+    rec_path, idx_path = _make_rec(tmp_path)
+    it0 = image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                          path_imgrec=rec_path, path_imgidx=idx_path,
+                          num_parts=2, part_index=0)
+    it1 = image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                          path_imgrec=rec_path, path_imgidx=idx_path,
+                          num_parts=2, part_index=1)
+    assert it0.num_image == 6 and it1.num_image == 6
+    assert set(it0.seq).isdisjoint(set(it1.seq))
+
+
+def test_det_augmenters():
+    img = _synth_img(60, 60)
+    label = np.array([[0, 0.2, 0.2, 0.6, 0.7]], dtype=np.float32)
+    flip = image.DetHorizontalFlipAug(1.1)
+    out, lab = flip(img, label)
+    np.testing.assert_array_equal(np.asarray(out), img[:, ::-1])
+    np.testing.assert_allclose(lab[0, 1], 1.0 - 0.6, rtol=1e-6)
+    np.testing.assert_allclose(lab[0, 3], 1.0 - 0.2, rtol=1e-6)
+    crop = image.DetRandomCropAug(min_object_covered=0.1,
+                                  area_range=(0.5, 1.0))
+    out, lab = crop(img, label)
+    assert lab.shape[1] == 5
+    assert (lab[:, 1:] >= 0).all() and (lab[:, 1:] <= 1).all()
+    pad = image.DetRandomPadAug(area_range=(1.5, 2.0))
+    out, lab = pad(img, label)
+    assert np.asarray(out).shape[0] >= 60
+    augs = image.CreateDetAugmenter((3, 32, 32), rand_crop=0.5,
+                                    rand_pad=0.5, rand_mirror=True,
+                                    mean=True, std=True, brightness=0.1)
+    out, lab = img, label
+    for a in augs:
+        out, lab = a(out, lab)
+    assert np.asarray(out).shape == (32, 32, 3)
+
+
+def test_image_det_iter(tmp_path):
+    rec_path, idx_path = _make_rec(tmp_path, det=True)
+    it = image.ImageDetIter(batch_size=4, data_shape=(3, 28, 28),
+                            path_imgrec=rec_path, path_imgidx=idx_path)
+    assert it.label_shape[1] == 5
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 28, 28)
+    lab = batch.label[0].asnumpy()
+    assert lab.shape == (4,) + it.label_shape
+    # padded slots are -1
+    assert (lab[:, 1:, :] == -1).all() or it.label_shape[0] == 1
+    # sync_label_shape
+    it2 = image.ImageDetIter(batch_size=4, data_shape=(3, 28, 28),
+                             path_imgrec=rec_path, path_imgidx=idx_path)
+    it2.reshape(label_shape=(5, 5))
+    it.sync_label_shape(it2)
+    assert it.label_shape[0] == 5
+
+
+def test_recordio_pack_unpack_img():
+    img = _synth_img(20, 20)
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack_img(header, img, quality=95)
+    h2, img2 = recordio.unpack_img(s)
+    assert h2.label == 3.0
+    assert img2.shape == (20, 20, 3)
+    assert np.abs(img2.astype(int) - img.astype(int)).mean() < 30
+    s = recordio.pack_img(header, img, img_fmt=".png")
+    _, img3 = recordio.unpack_img(s)
+    np.testing.assert_array_equal(img3, img)  # png lossless

@@ -43,7 +43,8 @@ def make_list(prefix, root, recursive=False):
 
 
 def make_rec(prefix, root, quality=95, resize=0):
-    import cv2
+    import numpy as np
+    from PIL import Image
 
     from mxnet_tpu import recordio
 
@@ -55,16 +56,20 @@ def make_rec(prefix, root, quality=95, resize=0):
             if len(parts) < 3:
                 continue
             idx, label, rel = int(parts[0]), float(parts[1]), parts[-1]
-            img = cv2.imread(os.path.join(root, rel), cv2.IMREAD_COLOR)
-            if img is None:
+            try:
+                im = Image.open(os.path.join(root, rel)).convert("RGB")
+            except (OSError, ValueError):
                 print("skipping unreadable %s" % rel, file=sys.stderr)
                 continue
             if resize:
-                h, w = img.shape[:2]
+                w, h = im.size
                 if h < w:
-                    img = cv2.resize(img, (int(w * resize / h), resize))
+                    im = im.resize((int(w * resize / h), resize),
+                                   Image.BICUBIC)
                 else:
-                    img = cv2.resize(img, (resize, int(h * resize / w)))
+                    im = im.resize((resize, int(h * resize / w)),
+                                   Image.BICUBIC)
+            img = np.asarray(im)
             header = recordio.IRHeader(0, label, idx, 0)
             record.write_idx(idx, recordio.pack_img(header, img,
                                                     quality=quality))
