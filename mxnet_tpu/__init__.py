@@ -38,6 +38,11 @@ from . import lr_scheduler
 from . import io
 from . import recordio
 from . import image
+from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
 from . import callback
 from . import model
 from . import kvstore
@@ -46,6 +51,7 @@ from . import module
 from . import module as mod
 from . import gluon
 from . import rnn
+from . import operator
 from .initializer import Xavier, Uniform, Normal
 from .model import save_checkpoint, load_checkpoint
 

@@ -44,9 +44,15 @@ def _jax():
 # ---------------------------------------------------------------------------
 # pure graph evaluator
 # ---------------------------------------------------------------------------
-def build_graph_eval(symbol) -> Callable:
+def build_graph_eval(symbol, collect_internals: bool = False) -> Callable:
     """Build fn(arg_vals, aux_vals, rng_key, training) ->
-    (outputs: list, aux_updates: dict name→val).  Pure; jit-traceable."""
+    (outputs: list, aux_updates: dict name→val).  Pure; jit-traceable.
+
+    With collect_internals=True the function returns a third value: a
+    dict name→val of every non-variable node's outputs (named
+    ``<node>_output`` / ``<node>_output<k>`` like the reference's
+    executor output naming) — the data source for Monitor taps
+    (ref: GraphExecutor::ExecuteMonCallback, graph_executor.cc:1418)."""
     import jax
 
     topo = symbol._topo()
@@ -59,6 +65,7 @@ def build_graph_eval(symbol) -> Callable:
                 training: bool):
         env: Dict[int, List[Any]] = {}
         aux_updates: Dict[str, Any] = {}
+        internals: Dict[str, Any] = {}
         for node in topo:
             if node.is_variable:
                 if node.name in aux_vals:
@@ -80,6 +87,10 @@ def build_graph_eval(symbol) -> Callable:
             outs = list(out) if isinstance(out, tuple) else [out]
             n_vis = len(outs) - len(op.mutate_aux)
             env[id(node)] = outs[:n_vis]
+            if collect_internals:
+                for k in range(n_vis):
+                    suffix = "_output" if n_vis == 1 else "_output%d" % k
+                    internals[node.name + suffix] = outs[k]
             # aux writebacks route to the feeding variable's name
             for k, pos in enumerate(op.mutate_aux):
                 if pos < len(node.inputs):
@@ -87,6 +98,8 @@ def build_graph_eval(symbol) -> Callable:
                     if parent.is_variable and parent.name in aux_names:
                         aux_updates[parent.name] = outs[n_vis + k]
         outputs = [env[id(n)][oi] for n, oi in flat_outputs]
+        if collect_internals:
+            return outputs, aux_updates, internals
         return outputs, aux_updates
 
     return eval_fn
@@ -149,6 +162,10 @@ class Executor:
 
         self.outputs: List[NDArray] = []
         self._cached_grads: Optional[Dict[str, Any]] = None
+        self._monitor_callback = None
+        self._monitor_all = False
+        self._monitor_eval = None
+        self._monitor_train_fn = None
 
     # -- binding entry points ------------------------------------------
     @staticmethod
@@ -235,13 +252,92 @@ class Executor:
                 self.arg_dict[k]._data = v._data.astype(self.arg_dict[k].dtype)
             else:
                 self.arg_dict[k][:] = v
-        fn = self._fwd_train if is_train else self._fwd_eval
-        outs, aux_upd = fn(self._arg_vals(), self._aux_vals(), self._next_key())
-        if is_train:
-            self._write_aux(aux_upd)
+        from . import profiler as _profiler
+
+        with _profiler.span("Forward<%s>" % (self._output_names[0]
+                                             if self._output_names else "?"),
+                            cat="symbolic"):
+            if self._monitor_callback is not None:
+                outs, aux_upd = self._forward_monitored(is_train)
+            else:
+                fn = self._fwd_train if is_train else self._fwd_eval
+                outs, aux_upd = fn(self._arg_vals(), self._aux_vals(),
+                                   self._next_key())
+            if _profiler.is_running() and _profiler._sync:
+                _jax().block_until_ready(outs)  # true span, not dispatch
+            if is_train:
+                self._write_aux(aux_upd)
         self._cached_grads = None
         self.outputs = [NDArray.from_raw(o, self._ctx) for o in outs]
         return self.outputs
+
+    # -- monitor tap (ref: MXExecutorSetMonitorCallback →
+    #    GraphExecutor::ExecuteMonCallback, graph_executor.cc:1418) ------
+    def set_monitor_callback(self, callback, monitor_all: bool = False):
+        """Install a (name, NDArray) callback fired for every internal
+        node output after each forward. monitor_all additionally reports
+        the input arrays (as ``<name>_data``)."""
+        self._monitor_callback = callback
+        self._monitor_all = monitor_all
+        self._monitor_eval = None
+        self._monitor_train_fn = None
+
+    def _forward_monitored(self, is_train):
+        jax = _jax()
+        if self._monitor_eval is None:
+            eval_int = build_graph_eval(self._symbol, collect_internals=True)
+
+            def f(arg_vals, aux_vals, key, training):
+                return eval_int(arg_vals, aux_vals, key, training)
+
+            self._monitor_eval = jax.jit(f, static_argnums=3)
+        outs, aux_upd, internals = self._monitor_eval(
+            self._arg_vals(), self._aux_vals(), self._next_key(),
+            bool(is_train))
+        self._fire_monitor(internals)
+        return outs, aux_upd
+
+    def _fire_monitor(self, internals):
+        if self._monitor_all:
+            for k, v in self.arg_dict.items():
+                self._monitor_callback(k + "_data",
+                                       NDArray.from_raw(v._data, self._ctx))
+        for name, val in internals.items():
+            self._monitor_callback(name, NDArray.from_raw(val, self._ctx))
+
+    def _train_step_monitored(self, cots):
+        """Fused fwd+bwd that additionally materializes every internal
+        node output for the Monitor tap — so mod.fit(monitor=...) sees
+        the *actual* training-step values (same rng, same batch)."""
+        jax = _jax()
+        if self._monitor_train_fn is None:
+            eval_int = build_graph_eval(self._symbol,
+                                        collect_internals=True)
+            grad_names = self._grad_names
+
+            def train_step(arg_vals, aux_vals, key, out_cots):
+                diff = {k: arg_vals[k] for k in grad_names}
+                rest = {k: v for k, v in arg_vals.items() if k not in diff}
+
+                def pure(diff_args):
+                    return eval_int({**rest, **diff_args}, aux_vals, key,
+                                    True)
+
+                (outs, aux_upd, internals), vjp_fn = jax.vjp(pure, diff)
+                cots2 = [
+                    c if c is not None else jax.numpy.ones_like(o)
+                    for c, o in zip(out_cots, outs)
+                ]
+                zero_aux = jax.tree.map(jax.numpy.zeros_like, aux_upd)
+                zero_int = jax.tree.map(jax.numpy.zeros_like, internals)
+                (grads,) = vjp_fn((cots2, zero_aux, zero_int))
+                return outs, grads, aux_upd, internals
+
+            self._monitor_train_fn = jax.jit(train_step)
+        outs, grads, aux_upd, internals = self._monitor_train_fn(
+            self._arg_vals(), self._aux_vals(), self._next_key(), cots)
+        self._fire_monitor(internals)
+        return outs, grads, aux_upd
 
     def backward(self, out_grads=None) -> None:
         """ref: GraphExecutor::Backward (graph_executor.cc:94).  Runs the
@@ -257,9 +353,19 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             cots = [g._data if g is not None else None for g in out_grads]
-        outs, grads, aux_upd = self._train_step(
-            self._arg_vals(), self._aux_vals(), self._next_key(), cots
-        )
+        from . import profiler as _profiler
+
+        with _profiler.span("Backward<%s>" % (self._output_names[0]
+                                              if self._output_names
+                                              else "?"), cat="symbolic"):
+            if self._monitor_callback is not None:
+                outs, grads, aux_upd = self._train_step_monitored(cots)
+            else:
+                outs, grads, aux_upd = self._train_step(
+                    self._arg_vals(), self._aux_vals(), self._next_key(),
+                    cots)
+            if _profiler.is_running() and _profiler._sync:
+                _jax().block_until_ready(outs)
         self._write_aux(aux_upd)
         if update_outputs or not self.outputs:
             self.outputs = [NDArray.from_raw(o, self._ctx) for o in outs]

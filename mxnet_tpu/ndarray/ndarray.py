@@ -502,6 +502,12 @@ def invoke(
 
     fn = op.bound(**params)
 
+    from .. import profiler as _profiler
+
+    _prof = _profiler.is_running()
+    if _prof:
+        _prof_start = _profiler._now_us()
+
     recording = (
         autograd.is_recording()
         and not op.nondiff
@@ -515,6 +521,12 @@ def invoke(
         outs, vjp_fn = _jax().vjp(fn, *raw)
     else:
         outs = fn(*raw)
+
+    if _prof:
+        if _profiler._sync:  # block for true op duration (NaiveEngine-style)
+            _jax().block_until_ready(outs)
+        _profiler.record_span(op.name, _prof_start,
+                              _profiler._now_us() - _prof_start)
 
     out_ctx = ctx or (inputs[0]._ctx if inputs and isinstance(inputs[0], NDArray)
                       else current_context())

@@ -1,0 +1,231 @@
+"""mx.operator — custom operators written in Python, usable from both the
+imperative (`mx.nd.Custom`) and symbolic (`mx.sym.Custom`) paths.
+
+ref: python/mxnet/operator.py:418 (CustomOp), :464 (CustomOpProp),
+:598 (register); backend bridge src/operator/custom/custom.cc.
+
+TPU-native design: the reference marshals custom-op callbacks onto a
+dedicated thread inside the engine (custom-inl.h); here the op body is
+embedded into the XLA program via `jax.pure_callback`, which gives:
+  * abstract evaluation for free (shape inference traces without
+    running the callback, so `infer_shape`/`simple_bind` work),
+  * the same op object works imperatively, in jitted graphs, and under
+    `jax.grad` (a `jax.custom_vjp` ties `CustomOp.backward` in as the
+    gradient, itself a pure_callback).
+
+Limitations vs the reference (documented, checked): a fresh CustomOp
+instance is created per forward/backward callback, so ops that carry
+state across calls must keep it on the Prop (one Prop instance per
+(op_type, kwargs) — cached); auxiliary states are not supported.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+
+class CustomOp(object):
+    """Base class for python operators (ref: operator.py:418)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs from in_data into out_data via
+        self.assign."""
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients into in_grad via self.assign."""
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write src into dst honouring the OpReqType
+        (ref: operator.py CustomOp.assign; kAddTo semantics from
+        include/mxnet/op_attr_types.h:45)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] += src
+        else:
+            raise ValueError("Invalid req %r" % req)
+
+
+class CustomOpProp(object):
+    """Registration-time metadata + operator factory
+    (ref: operator.py:464)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+        self.kwargs: Dict[str, str] = {}
+
+    def infer_shape(self, in_shape):
+        """default: all inputs/outputs share in_shape[0]."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def need_top_grad(self) -> bool:
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(reg_name: str):
+    """Decorator registering a CustomOpProp subclass under `reg_name`
+    (ref: operator.py:598). Usable afterwards as
+    ``mx.nd.Custom(*data, op_type=reg_name, **kwargs)`` or
+    ``mx.sym.Custom(...)``."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("Can only register subclass of CustomOpProp")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered():
+    return dict(_REGISTRY)
+
+
+@functools.lru_cache(maxsize=512)
+def _make_prop(prop_cls, frozen_kwargs: Tuple[Tuple[str, str], ...]):
+    # the reference passes all ctor kwargs as strings through the C API
+    # (SURVEY.md §5 "the frontend is schema-free"); we keep native types
+    prop = prop_cls(**dict(frozen_kwargs))
+    if prop.list_auxiliary_states():
+        raise MXNetError("Custom op declares auxiliary states, which "
+                         "are not supported by the TPU bridge")
+    prop.kwargs = dict(frozen_kwargs)
+    return prop
+
+
+def _get_prop(op_type: str, frozen_kwargs: Tuple[Tuple[str, str], ...]):
+    if op_type not in _REGISTRY:
+        raise MXNetError("Custom op %r not registered (known: %s)"
+                         % (op_type, sorted(_REGISTRY)))
+    # keyed on the class object, so re-registering an op_type (notebook
+    # iteration) invalidates the cache naturally
+    return _make_prop(_REGISTRY[op_type], frozen_kwargs)
+
+
+def _freeze_kwargs(kwargs) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(kwargs.items()))
+
+
+def num_outputs(op_type: str, kwargs) -> int:
+    """Static output count for the symbol layer."""
+    prop = _get_prop(op_type, _freeze_kwargs(
+        {k: v for k, v in kwargs.items()
+         if k != "op_type" and not k.startswith("_")}))
+    return len(prop.list_outputs())
+
+
+def _custom_fn(*arrays, op_type: str, _training: bool = False, **kwargs):
+    """The registered `Custom` op body: pure_callback forward with a
+    custom_vjp calling CustomOp.backward. `_training` is threaded in by
+    the invoke layer / graph evaluator (train_aware op)."""
+    import jax
+    import jax.numpy as jnp
+
+    is_train = bool(_training)
+    prop = _get_prop(op_type, _freeze_kwargs(kwargs))
+    n_out = len(prop.list_outputs())
+    in_shapes = [tuple(a.shape) for a in arrays]
+    in_dtypes = [a.dtype for a in arrays]
+    ishapes, oshapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    itypes, otypes, _ = prop.infer_type(list(in_dtypes))
+    result_spec = [jax.ShapeDtypeStruct(tuple(s), _np.dtype(t))
+                   for s, t in zip(oshapes, otypes)]
+
+    def host_forward(*np_in):
+        op = prop.create_operator(None, [list(a.shape) for a in np_in],
+                                  [a.dtype for a in np_in])
+        in_data = [_np.asarray(a) for a in np_in]
+        out_data = [_np.zeros(tuple(s), dtype=_np.dtype(t))
+                    for s, t in zip(oshapes, otypes)]
+        op.forward(is_train=is_train, req=["write"] * len(in_data),
+                   in_data=in_data, out_data=out_data, aux=[])
+        return tuple(out_data)
+
+    def host_backward(*np_args):
+        grads = list(np_args[:n_out])
+        ins = list(np_args[n_out:n_out + len(arrays)])
+        outs = list(np_args[n_out + len(arrays):])
+        op = prop.create_operator(None, [list(a.shape) for a in ins],
+                                  [a.dtype for a in ins])
+        in_grad = [_np.zeros(a.shape, dtype=a.dtype) for a in ins]
+        op.backward(req=["write"] * len(ins), out_grad=grads,
+                    in_data=ins, out_data=outs, in_grad=in_grad, aux=[])
+        return tuple(in_grad)
+
+    @jax.custom_vjp
+    def call(*xs):
+        return jax.pure_callback(host_forward, tuple(result_spec), *xs,
+                                 vmap_method="sequential")
+
+    def call_fwd(*xs):
+        outs = call(*xs)
+        return outs, (xs, outs)
+
+    def call_bwd(res, cots):
+        xs, outs = res
+        if not prop.need_top_grad():
+            cots = tuple(jnp.zeros(r.shape, r.dtype) for r in result_spec)
+        in_spec = tuple(jax.ShapeDtypeStruct(s, d)
+                        for s, d in zip(in_shapes, in_dtypes))
+        grads = jax.pure_callback(host_backward, in_spec,
+                                  *(tuple(cots) + tuple(xs) + tuple(outs)),
+                                  vmap_method="sequential")
+        return tuple(grads)
+
+    call.defvjp(call_fwd, call_bwd)
+    outs = call(*arrays)
+    return outs if n_out > 1 else outs[0]
+
+
+def _register_custom_op():
+    from .ops import registry as _reg
+
+    _reg.register("Custom", input_names=[], train_aware=True)(_custom_fn)
+    # the nd/sym namespaces were generated before this module imported;
+    # refresh them so mx.nd.Custom / mx.sym.Custom appear
+    from . import ndarray as _nd_pkg
+    from . import symbol as _sym_pkg
+    from .ndarray import register as _nd_reg
+    from .symbol import register as _sym_reg
+
+    _nd_reg.populate(_nd_pkg.__dict__)
+    _sym_reg.populate(_sym_pkg.__dict__)
+
+
+_register_custom_op()

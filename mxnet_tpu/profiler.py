@@ -1,0 +1,149 @@
+"""mx.profiler — op-level tracing with chrome://tracing output.
+
+ref: python/mxnet/profiler.py:27-58 (set_config/set_state/dump_profile),
+src/engine/profiler.{h,cc} (OprExecStat stamped around every executed op,
+DumpProfile emits "traceEvents" JSON, profiler.cc:155).
+
+Two layers, both TPU-native:
+  * **Python-side op events**: `mx.nd` invokes and Executor
+    forward/backward spans are stamped here. Because XLA dispatch is
+    async (the python call returns before the TPU finishes —
+    SURVEY.md §3.1), accurate per-op durations require synchronizing
+    after each op; `set_config(profile_sync=True)` (default) blocks on
+    each op's output the way `MXNET_ENGINE_TYPE=NaiveEngine` degrades
+    the reference engine to synchronous execution for debugging.
+  * **XLA device traces**: `set_config(profile_xla=True)` additionally
+    drives `jax.profiler.start_trace/stop_trace` so the real device
+    timeline (fusions, collectives, HBM traffic) lands in TensorBoard
+    format next to the chrome trace.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["set_config", "profiler_set_config", "set_state",
+           "profiler_set_state", "dump", "dump_profile", "pause", "resume"]
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_state = "stop"
+_paused = False
+_filename = "profile.json"
+_sync = True
+_xla = False
+_xla_dir: Optional[str] = None
+_t0 = None
+
+
+def is_running() -> bool:
+    return _state == "run" and not _paused
+
+
+def set_config(profile_all=False, profile_symbolic=False,
+               profile_imperative=False, profile_memory=False,
+               profile_api=False, filename="profile.json",
+               profile_sync=True, profile_xla=False, xla_trace_dir=None,
+               **kwargs):
+    """ref: profiler.py:27 set_config. The reference's mode flags select
+    which subsystems stamp events; here symbolic+imperative are both
+    python-side and always stamped, the flags are accepted for API
+    compatibility."""
+    global _filename, _sync, _xla, _xla_dir
+    with _lock:
+        _filename = filename
+        _sync = bool(profile_sync)
+        _xla = bool(profile_xla or profile_all and xla_trace_dir)
+        _xla_dir = xla_trace_dir
+
+
+profiler_set_config = set_config  # legacy alias (ref: profiler.py:27)
+
+
+def set_state(state="stop"):
+    """'run' | 'stop' (ref: profiler.py:42 set_state →
+    MXSetProfilerState)."""
+    global _state, _t0
+    assert state in ("run", "stop")
+    with _lock:
+        if state == "run" and _state != "run":
+            _events.clear()
+            _t0 = time.perf_counter_ns()
+            if _xla:
+                import jax
+
+                jax.profiler.start_trace(_xla_dir or
+                                         os.path.splitext(_filename)[0] +
+                                         "_xla")
+        elif state == "stop" and _state == "run":
+            if _xla:
+                import jax
+
+                jax.profiler.stop_trace()
+        _state = state
+
+
+profiler_set_state = set_state
+
+
+def pause():
+    """Suspend event collection without ending the session
+    (ref: MXProfilePause)."""
+    global _paused
+    _paused = True
+
+
+def resume():
+    global _paused
+    _paused = False
+
+
+def _now_us() -> float:
+    return (time.perf_counter_ns() - (_t0 or time.perf_counter_ns())) / 1e3
+
+
+def record_span(name: str, start_us: float, dur_us: float,
+                cat: str = "operator", tid: int = 0):
+    """Stamp one complete ('ph':'X') event (ref: OprExecStat →
+    traceEvents, profiler.cc:155)."""
+    if not is_running():
+        return
+    with _lock:
+        _events.append({"name": name, "cat": cat, "ph": "X",
+                        "ts": start_us, "dur": dur_us, "pid": 0,
+                        "tid": tid})
+
+
+class span:
+    """Context manager stamping a span around a python-side region."""
+
+    def __init__(self, name: str, cat: str = "operator"):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self.start = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        record_span(self.name, self.start, _now_us() - self.start, self.cat)
+        return False
+
+
+def dump(finished=True):
+    """Write the chrome://tracing JSON (ref: profiler.py:53 dump_profile
+    → MXDumpProfile; format per profiler.cc:155 DumpProfile)."""
+    with _lock:
+        payload = {"traceEvents": list(_events),
+                   "displayTimeUnit": "ms"}
+        with open(_filename, "w") as f:
+            json.dump(payload, f)
+        if finished:
+            _events.clear()
+    return _filename
+
+
+dump_profile = dump

@@ -403,6 +403,14 @@ def _static_num_outputs(op: _op_registry.Op, params: Dict[str, Any]) -> int:
     """Total arrays the op body returns (visible outputs + aux writebacks)."""
     if op.name == "SliceChannel":
         return int(params.get("num_outputs", 1))
+    if op.name == "Custom":
+        from ..base import MXNetError
+        from .. import operator as _custom_mod
+
+        if "op_type" not in params:
+            raise MXNetError("Custom requires an op_type= keyword naming "
+                             "a registered CustomOpProp")
+        return _custom_mod.num_outputs(params["op_type"], params)
     if op.name == "BatchNorm":
         return (3 if params.get("output_mean_var") else 1) + 2
     if op.name == "LayerNorm":

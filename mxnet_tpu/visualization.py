@@ -1,0 +1,165 @@
+"""mx.viz — network summary table + graphviz plotting.
+
+ref: python/mxnet/visualization.py (print_summary, plot_network).
+graphviz is optional (not baked into this image); plot_network raises a
+clear ImportError if it's missing, print_summary is dependency-free.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
+                                                                  .74, 1.)):
+    """Print a Keras-style layer table with output shapes and param
+    counts (ref: visualization.py print_summary)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    shape_dict = {}
+    if shape is not None:
+        from .symbol.infer import infer_shape
+
+        arg_shapes, out_shapes, aux_shapes = infer_shape(symbol, **shape)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        shape_dict = dict(zip(arg_names, arg_shapes))
+        shape_dict.update(dict(zip(aux_names, aux_shapes)))
+        # internal node output shapes
+        ints = symbol.get_internals()
+        _, int_out_shapes, _ = infer_shape(ints, **shape)
+        for name, s in zip(ints.list_outputs(), int_out_shapes):
+            shape_dict[name] = s
+
+    topo = symbol._topo()
+    positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = 0
+    arg_set = set(symbol.list_arguments())
+
+    for node in topo:
+        if node.is_variable:
+            continue
+        name = node.name
+        out_shape = shape_dict.get(name + "_output",
+                                   shape_dict.get(name + "_output0", ""))
+        # params = total size of this node's variable inputs (weights)
+        num_params = 0
+        pred = []
+        for parent, _ in node.inputs:
+            if parent.is_variable:
+                if parent.name in arg_set and not parent.name.endswith(
+                        ("_data", "_label")) and parent.name != "data":
+                    s = shape_dict.get(parent.name)
+                    if s:
+                        n = 1
+                        for d in s:
+                            n *= d
+                        num_params += n
+            else:
+                pred.append(parent.name)
+        total_params += num_params
+        print_row([name + " (" + node.op + ")", str(out_shape),
+                   str(num_params), ",".join(pred)], positions)
+        print("_" * line_length)
+    print("Total params: {params}".format(params=total_params))
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the symbol (ref: visualization.py
+    plot_network). Requires the `graphviz` python package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the graphviz package "
+                          "(not available in this environment); use "
+                          "print_summary instead")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    node_attrs = node_attrs or {}
+    shape_dict = {}
+    if shape is not None:
+        from .symbol.infer import infer_shape
+
+        ints = symbol.get_internals()
+        _, out_shapes, _ = infer_shape(ints, **shape)
+        shape_dict = dict(zip(ints.list_outputs(), out_shapes))
+
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    # the reference's color scheme (visualization.py plot_network)
+    cm = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
+          "#fdb462", "#b3de69", "#fccde5")
+
+    topo = symbol._topo()
+    drawn = set()
+    for node in topo:
+        name = node.name
+        if node.is_variable:
+            if hide_weights and name != "data" and \
+                    not name.endswith(("_data", "_label")):
+                continue
+            dot.node(name=name, label=name,
+                     **dict(node_attr, shape="oval", fillcolor=cm[0]))
+            drawn.add(name)
+            continue
+        op = node.op
+        label = name
+        fillcolor = cm[1]
+        if op in ("Convolution", "Deconvolution"):
+            label = "%s\n%s" % (op, node.attrs.get("kernel", ""))
+            fillcolor = cm[1]
+        elif op == "FullyConnected":
+            label = "%s\n%s" % (op, node.attrs.get("num_hidden", ""))
+            fillcolor = cm[1]
+        elif op == "BatchNorm":
+            fillcolor = cm[3]
+        elif op in ("Activation", "LeakyReLU"):
+            label = "%s\n%s" % (op, node.attrs.get("act_type", ""))
+            fillcolor = cm[2]
+        elif op == "Pooling":
+            label = "%s\n%s/%s" % (op, node.attrs.get("pool_type", ""),
+                                   node.attrs.get("kernel", ""))
+            fillcolor = cm[4]
+        elif op in ("Concat", "Flatten", "Reshape"):
+            fillcolor = cm[5]
+        elif op == "SoftmaxOutput":
+            fillcolor = cm[6]
+        dot.node(name=name, label=label, **dict(node_attr,
+                                                fillcolor=fillcolor))
+        drawn.add(name)
+
+    for node in topo:
+        if node.is_variable:
+            continue
+        for parent, oi in node.inputs:
+            pname = parent.name
+            if pname not in drawn:
+                continue
+            attrs = {"dir": "back", "arrowtail": "open"}
+            key = pname + "_output" if not parent.is_variable else pname
+            if key in shape_dict and shape_dict[key]:
+                attrs["label"] = "x".join(
+                    str(d) for d in shape_dict[key][1:])
+            dot.edge(tail_name=node.name, head_name=pname, **attrs)
+    return dot

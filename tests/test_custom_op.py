@@ -1,0 +1,164 @@
+"""Custom op bridge tests (model: test_operator.py test_custom_op in the
+reference, tests/python/unittest)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+@mx.operator.register("sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+
+@mx.operator.register("twosum")
+class TwoSumProp(mx.operator.CustomOpProp):
+    """Two inputs, two outputs: (a+b, a-b)."""
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["plus", "minus"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return TwoSum()
+
+
+class TwoSum(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] + in_data[1])
+        self.assign(out_data[1], req[1], in_data[0] - in_data[1])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] + out_grad[1])
+        self.assign(in_grad[1], req[1], out_grad[0] - out_grad[1])
+
+
+def test_custom_imperative_forward():
+    x = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    y = nd.Custom(x, op_type="sqr")
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2, rtol=1e-6)
+
+
+def test_custom_imperative_backward():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sqr")
+        z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+
+
+def test_custom_multi_output():
+    a = nd.array(np.array([3.0, 5.0], np.float32))
+    b = nd.array(np.array([1.0, 2.0], np.float32))
+    plus, minus = nd.Custom(a, b, op_type="twosum")
+    np.testing.assert_allclose(plus.asnumpy(), [4.0, 7.0])
+    np.testing.assert_allclose(minus.asnumpy(), [2.0, 3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        plus, minus = nd.Custom(a, b, op_type="twosum")
+        loss = (plus * 2 + minus).sum()
+    loss.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [3.0, 3.0])  # 2 + 1
+    np.testing.assert_allclose(b.grad.asnumpy(), [1.0, 1.0])  # 2 - 1
+
+
+def test_custom_symbolic():
+    data = mx.sym.Variable("data")
+    sqr = mx.sym.Custom(data, op_type="sqr", name="sq")
+    out_shapes = sqr.infer_shape(data=(2, 3))[1]
+    assert out_shapes == [(2, 3)]
+    exe = sqr.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    exe.arg_dict["data"][:] = xv
+    (out,) = exe.forward()
+    np.testing.assert_allclose(out.asnumpy(), xv ** 2, rtol=1e-6)
+    # backward through the graph executor
+    exe.backward(out_grads=nd.ones((2, 3)))
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * xv,
+                               rtol=1e-5)
+
+
+def test_custom_in_module_training():
+    """Custom op inside a Module.fit step trains end-to-end."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=2)
+    sq = mx.sym.Custom(fc, op_type="sqr", name="sq")
+    out = mx.sym.SoftmaxOutput(sq, name="softmax")
+    mod = mx.mod.Module(out, data_names=["data"],
+                        label_names=["softmax_label"], context=mx.cpu())
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 8).astype(np.float32)
+    y = (x.sum(axis=1) > 4).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=16)
+    mod.fit(it, num_epoch=2,
+            optimizer_params={"learning_rate": 0.1})
+    # it ran; loss finite
+    score = mod.score(it, mx.metric.Accuracy())
+    assert 0.0 <= score[0][1] <= 1.0
+
+
+def test_custom_with_kwargs():
+    @mx.operator.register("scalepow")
+    class ScalePowProp(mx.operator.CustomOpProp):
+        def __init__(self, power="2", scale="1.0"):
+            super().__init__(need_top_grad=True)
+            self.power = float(power)
+            self.scale = float(scale)
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            power, scale = self.power, self.scale
+
+            class Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                scale * in_data[0] ** power)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                scale * power * in_data[0] ** (power - 1)
+                                * out_grad[0])
+            return Op()
+
+    x = nd.array(np.array([2.0, 3.0], np.float32))
+    y = nd.Custom(x, op_type="scalepow", power="3", scale="2.0")
+    np.testing.assert_allclose(y.asnumpy(), [16.0, 54.0], rtol=1e-6)
+
+
+def test_custom_unregistered_raises():
+    x = nd.ones((2,))
+    with pytest.raises(Exception):
+        nd.Custom(x, op_type="definitely_not_registered")

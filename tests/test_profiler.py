@@ -1,0 +1,192 @@
+"""Profiler / Monitor / visualization tests
+(models: tests/python/unittest/test_profiler.py, test_monitor-style usage
+in the reference)."""
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, profiler
+
+
+def test_profiler_records_imperative_ops(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    profiler.set_config(filename=fname, profile_all=True)
+    profiler.set_state("run")
+    a = nd.ones((8, 8))
+    b = nd.ones((8, 8))
+    c = nd.dot(a, b)
+    d = (c + 1).sum()
+    d.wait_to_read()
+    profiler.set_state("stop")
+    out = profiler.dump()
+    assert out == fname
+    with open(fname) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    names = [e["name"] for e in events]
+    assert "dot" in names
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["dur"] >= 0
+        assert e["ts"] >= 0
+
+
+def test_profiler_pause_resume(tmp_path):
+    fname = str(tmp_path / "p.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    profiler.pause()
+    _ = nd.ones((4,)) + 1
+    profiler.resume()
+    x = nd.ones((4,)) * 2
+    x.wait_to_read()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        names = [e["name"] for e in json.load(f)["traceEvents"]]
+    assert "_mul_scalar" in names and "_plus_scalar" not in names
+
+
+def test_profiler_symbolic_spans(tmp_path):
+    fname = str(tmp_path / "s.json")
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+    out = mx.sym.SoftmaxOutput(fc, name="sm")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 8))
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    exe.forward(is_train=True)
+    exe.backward()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        names = [e["name"] for e in json.load(f)["traceEvents"]]
+    assert any(n.startswith("Forward") for n in names)
+    assert any(n.startswith("Backward") for n in names)
+
+
+def test_monitor_taps_internal_outputs():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    act = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=2)
+    out = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(4, 16))
+    exe.arg_dict["data"][:] = np.random.rand(4, 16)
+    exe.arg_dict["fc1_weight"][:] = np.random.rand(8, 16) * 0.1
+    exe.arg_dict["fc2_weight"][:] = np.random.rand(2, 8) * 0.1
+
+    mon = mx.Monitor(interval=1, pattern=".*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=True)
+    rows = mon.toc()
+    names = [k for _, k, _ in rows]
+    assert "fc1_output" in names
+    assert "relu1_output" in names
+    assert "softmax_output" in names
+    # param stats folded in at toc
+    assert "fc1_weight" in names
+    # stat values are parseable floats
+    for _, k, v in rows:
+        float(v.strip().split("\t")[0])
+
+
+def test_monitor_pattern_and_interval():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=4)
+    exe = fc1.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    mon = mx.Monitor(interval=2, pattern="fc1.*")
+    mon.install(exe)
+    mon.tic()  # step 0: active
+    exe.forward()
+    rows0 = mon.toc()
+    assert all(k.startswith(("fc1", "grad_fc1")) for _, k, _ in rows0)
+    mon.tic()  # step 1: inactive (interval=2)
+    exe.forward()
+    assert mon.toc() == []
+
+
+def test_monitor_fires_in_module_fit():
+    """Monitor must tap internals through the fit() train step
+    (run_train_step path), not just manual exe.forward()."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc1", num_hidden=2)
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(out, data_names=["data"],
+                        label_names=["softmax_label"], context=mx.cpu())
+    x = np.random.rand(8, 4).astype(np.float32)
+    y = (x.sum(axis=1) > 2).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=4)
+    seen = []
+    mon = mx.Monitor(interval=1, pattern="fc1.*")
+    orig = mon.stat_helper
+
+    def spy(name, arr):
+        seen.append(name)
+        orig(name, arr)
+
+    mon.stat_helper = spy
+    mod.fit(it, num_epoch=1, monitor=mon,
+            optimizer_params={"learning_rate": 0.1})
+    assert "fc1_output" in seen
+
+
+def test_custom_op_sees_is_train():
+    import mxnet_tpu.operator as mxop
+
+    seen = []
+
+    @mxop.register("trainspy")
+    class TrainSpyProp(mxop.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(mxop.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    seen.append(bool(is_train))
+                    self.assign(out_data[0], req[0], in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+            return Op()
+
+    x = mx.nd.ones((2, 2))
+    mx.nd.Custom(x, op_type="trainspy").wait_to_read()
+    assert seen[-1] is False  # outside autograd: inference
+    with mx.autograd.record():
+        mx.nd.Custom(x, op_type="trainspy").wait_to_read()
+    assert seen[-1] is True  # recording implies training
+
+
+def test_print_summary(capsys):
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    act = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=2)
+    total = mx.viz.print_summary(fc2, shape={"data": (1, 16)})
+    cap = capsys.readouterr().out
+    assert "fc1" in cap and "fc2" in cap
+    # fc1: 16*8 + 8; fc2: 8*2 + 2
+    assert total == 16 * 8 + 8 + 8 * 2 + 2
+
+
+def test_plot_network_gated():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=2)
+    try:
+        import graphviz  # noqa: F401
+        has_gv = True
+    except ImportError:
+        has_gv = False
+    if has_gv:
+        dot = mx.viz.plot_network(fc, shape={"data": (1, 4)})
+        assert "fc" in dot.source
+    else:
+        import pytest
+        with pytest.raises(ImportError):
+            mx.viz.plot_network(fc)
