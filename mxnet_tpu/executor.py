@@ -140,25 +140,7 @@ class Executor:
 
         grad_names = [k for k in self._arg_names if self._grad_req.get(k, "null") != "null"]
         self._grad_names = grad_names
-
-        def train_step(arg_vals, aux_vals, key, out_cots):
-            diff = {k: arg_vals[k] for k in grad_names}
-            rest = {k: v for k, v in arg_vals.items() if k not in diff}
-
-            def pure(diff_args):
-                outs, aux_upd = eval_fn({**rest, **diff_args}, aux_vals, key, True)
-                return outs, aux_upd
-
-            (outs, aux_upd), vjp_fn = jax.vjp(lambda d: pure(d), diff)
-            cots = [
-                c if c is not None else jax.numpy.ones_like(o)
-                for c, o in zip(out_cots, outs)
-            ]
-            zero_aux = jax.tree.map(jax.numpy.zeros_like, aux_upd)
-            (grads,) = vjp_fn((cots, zero_aux))
-            return outs, grads, aux_upd
-
-        self._train_step = jax.jit(train_step)
+        self._train_step = self._build_train_step(collect_internals=False)
 
         self.outputs: List[NDArray] = []
         self._cached_grads: Optional[Dict[str, Any]] = None
@@ -305,35 +287,39 @@ class Executor:
         for name, val in internals.items():
             self._monitor_callback(name, NDArray.from_raw(val, self._ctx))
 
-    def _train_step_monitored(self, cots):
-        """Fused fwd+bwd that additionally materializes every internal
-        node output for the Monitor tap — so mod.fit(monitor=...) sees
-        the *actual* training-step values (same rng, same batch)."""
+    def _build_train_step(self, collect_internals: bool):
+        """Fused fwd+vjp step; with collect_internals it additionally
+        materializes every internal node output for the Monitor tap, so
+        mod.fit(monitor=...) sees the *actual* training-step values
+        (same rng, same batch)."""
         jax = _jax()
+        eval_fn = build_graph_eval(self._symbol,
+                                   collect_internals=collect_internals)
+        grad_names = self._grad_names
+
+        def train_step(arg_vals, aux_vals, key, out_cots):
+            diff = {k: arg_vals[k] for k in grad_names}
+            rest = {k: v for k, v in arg_vals.items() if k not in diff}
+
+            def pure(diff_args):
+                return eval_fn({**rest, **diff_args}, aux_vals, key, True)
+
+            res, vjp_fn = jax.vjp(pure, diff)
+            outs = res[0]
+            cots = [
+                c if c is not None else jax.numpy.ones_like(o)
+                for c, o in zip(out_cots, outs)
+            ]
+            zero_rest = jax.tree.map(jax.numpy.zeros_like, res[1:])
+            (grads,) = vjp_fn((cots,) + tuple(zero_rest))
+            return (outs, grads) + tuple(res[1:])
+
+        return jax.jit(train_step)
+
+    def _train_step_monitored(self, cots):
         if self._monitor_train_fn is None:
-            eval_int = build_graph_eval(self._symbol,
-                                        collect_internals=True)
-            grad_names = self._grad_names
-
-            def train_step(arg_vals, aux_vals, key, out_cots):
-                diff = {k: arg_vals[k] for k in grad_names}
-                rest = {k: v for k, v in arg_vals.items() if k not in diff}
-
-                def pure(diff_args):
-                    return eval_int({**rest, **diff_args}, aux_vals, key,
-                                    True)
-
-                (outs, aux_upd, internals), vjp_fn = jax.vjp(pure, diff)
-                cots2 = [
-                    c if c is not None else jax.numpy.ones_like(o)
-                    for c, o in zip(out_cots, outs)
-                ]
-                zero_aux = jax.tree.map(jax.numpy.zeros_like, aux_upd)
-                zero_int = jax.tree.map(jax.numpy.zeros_like, internals)
-                (grads,) = vjp_fn((cots2, zero_aux, zero_int))
-                return outs, grads, aux_upd, internals
-
-            self._monitor_train_fn = jax.jit(train_step)
+            self._monitor_train_fn = self._build_train_step(
+                collect_internals=True)
         outs, grads, aux_upd, internals = self._monitor_train_fn(
             self._arg_vals(), self._aux_vals(), self._next_key(), cots)
         self._fire_monitor(internals)
@@ -358,7 +344,9 @@ class Executor:
         with _profiler.span("Backward<%s>" % (self._output_names[0]
                                               if self._output_names
                                               else "?"), cat="symbolic"):
-            if self._monitor_callback is not None:
+            # fire the monitor tap only on the fused-step path (fit's
+            # forward_backward); a manual forward() already fired it
+            if self._monitor_callback is not None and update_outputs:
                 outs, grads, aux_upd = self._train_step_monitored(cots)
             else:
                 outs, grads, aux_upd = self._train_step(

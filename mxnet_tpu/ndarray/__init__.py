@@ -13,6 +13,8 @@ from .ndarray import (
     ones_like,
     concatenate,
     moveaxis,
+    maximum,
+    minimum,
     waitall,
 )
 from .utils import save, load, load_frombuffer

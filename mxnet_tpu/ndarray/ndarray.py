@@ -658,6 +658,27 @@ def concatenate(arrays: Sequence[NDArray], axis: int = 0, always_copy: bool = Tr
     return invoke("Concat", list(arrays), {"dim": axis})
 
 
+def _public_binary(array_op: str, scalar_op: str):
+    """Scalar-aware public binary fn (ref: ndarray.py module-level
+    maximum/minimum/power dispatching on operand types)."""
+
+    def f(lhs, rhs):
+        if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+            return invoke(array_op, [lhs, rhs])
+        if isinstance(lhs, NDArray):
+            return invoke(scalar_op, [lhs], {"scalar": float(rhs)})
+        if isinstance(rhs, NDArray):
+            return invoke(scalar_op, [rhs], {"scalar": float(lhs)})
+        raise TypeError("at least one NDArray operand required")
+
+    f.__name__ = array_op.lstrip("_")
+    return f
+
+
+maximum = _public_binary("_maximum", "_maximum_scalar")
+minimum = _public_binary("_minimum", "_minimum_scalar")
+
+
 def moveaxis(tensor: NDArray, source: int, destination: int) -> NDArray:
     axes = list(range(tensor.ndim))
     axes.insert(destination, axes.pop(source))

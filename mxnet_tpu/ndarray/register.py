@@ -51,3 +51,25 @@ def populate(module_dict: Dict[str, Any]) -> None:
         op = _registry._REGISTRY[name]
         if name not in module_dict:
             module_dict[name] = _make_wrapper(op)
+    _populate_contrib(module_dict, _make_wrapper)
+
+
+def _populate_contrib(module_dict: Dict[str, Any], make_wrapper) -> None:
+    """Expose ``_contrib_X`` ops as a ``contrib`` sub-namespace
+    (ref: python/mxnet/ndarray/contrib.py generated namespace)."""
+    import types
+
+    contrib = module_dict.get("contrib")
+    if contrib is None:
+        contrib = types.SimpleNamespace()
+        module_dict["contrib"] = contrib
+    for name in list(_registry._REGISTRY):
+        if name.startswith("_contrib_"):
+            op = _registry._REGISTRY[name]
+            shorts = [name[len("_contrib_"):]]
+            # snake_case aliases (ctc_loss, box_nms, ...) live under
+            # contrib in the reference too
+            shorts += [a for a in op.aliases if not a.startswith("_")]
+            for short in shorts:
+                if not hasattr(contrib, short):
+                    setattr(contrib, short, make_wrapper(op))

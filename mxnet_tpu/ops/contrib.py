@@ -129,7 +129,8 @@ def _count_sketch(data, h, s, out_dim=0, **_):
     return out.at[:, hh].add(signed)
 
 
-@register("_contrib_quantize", aliases=("quantize",), nondiff=True)
+@register("_contrib_quantize", aliases=("quantize",), nondiff=True,
+          num_outputs=3)
 def _quantize(data, min_range, max_range, out_type="uint8", **_):
     # ref: contrib/quantize.cc — affine int8/uint8 quantisation experiments
     if out_type == "uint8":
@@ -149,3 +150,639 @@ def _dequantize(data, min_range, max_range, out_type="float32", **_):
         qmin, qmax = -127.0, 127.0
     scale = (max_range - min_range) / (qmax - qmin)
     return (data.astype(jnp.float32) - qmin) * scale + min_range
+
+
+# --------------------------------------------------------------------- #
+# SSD training/inference ops (ref: src/operator/contrib/multibox_*.cc)
+# --------------------------------------------------------------------- #
+
+def _encode_box(gt, anchor, variances):
+    """Corner gt/anchor → (dx, dy, dw, dh) regression target
+    (ref: multibox_target.cc encoding with variances)."""
+    aw = anchor[..., 2] - anchor[..., 0]
+    ah = anchor[..., 3] - anchor[..., 1]
+    ax = (anchor[..., 0] + anchor[..., 2]) / 2
+    ay = (anchor[..., 1] + anchor[..., 3]) / 2
+    gw = jnp.maximum(gt[..., 2] - gt[..., 0], 1e-12)
+    gh = jnp.maximum(gt[..., 3] - gt[..., 1], 1e-12)
+    gx = (gt[..., 0] + gt[..., 2]) / 2
+    gy = (gt[..., 1] + gt[..., 3]) / 2
+    dx = (gx - ax) / jnp.maximum(aw, 1e-12) / variances[0]
+    dy = (gy - ay) / jnp.maximum(ah, 1e-12) / variances[1]
+    dw = jnp.log(gw / jnp.maximum(aw, 1e-12)) / variances[2]
+    dh = jnp.log(gh / jnp.maximum(ah, 1e-12)) / variances[3]
+    return jnp.stack([dx, dy, dw, dh], axis=-1)
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+          nondiff=True, num_outputs=3)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2), **_):
+    """SSD training targets (ref: contrib/multibox_target.cc:305).
+
+    anchor (1, N, 4) corner; label (B, O, 5+) rows [cls, x1, y1, x2, y2]
+    padded with -1; cls_pred (B, C+1, N). Returns loc_target (B, N*4),
+    loc_mask (B, N*4), cls_target (B, N).
+
+    Matching follows the reference: bipartite (each gt grabs its best
+    anchor, greedy on global IoU) then per-anchor threshold matching;
+    optional hard-negative mining ranked by the anchor's best
+    non-background class probability.
+    """
+    anchor = anchor.reshape(-1, 4)
+    N = anchor.shape[0]
+    B, O = label.shape[0], label.shape[1]
+    variances = tuple(variances)
+
+    def per_batch(lab, pred):
+        cls_id = lab[:, 0]
+        valid_gt = cls_id >= 0
+        gt = lab[:, 1:5]
+        iou = jax.vmap(
+            lambda a: _box_iou_corner(a[None], gt).reshape(O))(anchor)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)  # (N, O)
+
+        # 1. bipartite: O greedy rounds of global argmax
+        def body(_, st):
+            m, anchor_gt = st
+            flat = jnp.argmax(m)
+            ai = (flat // O).astype(jnp.int32)
+            gi = (flat % O).astype(jnp.int32)
+            good = m[ai, gi] > 1e-12
+            anchor_gt = jnp.where(good, anchor_gt.at[ai].set(gi), anchor_gt)
+            m = jnp.where(good,
+                          m.at[ai, :].set(-1.0).at[:, gi].set(-1.0), m)
+            return m, anchor_gt
+
+        _, anchor_gt = jax.lax.fori_loop(
+            0, O, body, (iou, jnp.full((N,), -1, jnp.int32)))
+
+        # 2. threshold matching for the rest
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        thresh_match = (best_iou >= overlap_threshold) & (anchor_gt < 0)
+        anchor_gt = jnp.where(thresh_match, best_gt, anchor_gt)
+        matched = anchor_gt >= 0
+        gt_idx = jnp.maximum(anchor_gt, 0)
+
+        cls_target = jnp.where(matched, cls_id[gt_idx] + 1.0, 0.0)
+
+        # 3. hard negative mining (ref: multibox_target.cc negative mining)
+        if negative_mining_ratio > 0:
+            # score negatives by best non-background class prob
+            max_fg = jnp.max(pred[1:, :], axis=0)  # (N,)
+            neg_cand = (~matched) & (max_fg > negative_mining_thresh)
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.maximum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                minimum_negative_samples)
+            order = jnp.argsort(-jnp.where(neg_cand, max_fg, -jnp.inf))
+            rank = jnp.zeros(N, jnp.int32).at[order].set(
+                jnp.arange(N, dtype=jnp.int32))
+            keep_neg = neg_cand & (rank < num_neg)
+            # mining semantics (ref: multibox_target.cc): the selected
+            # hard negatives train as background 0, every other
+            # unmatched anchor is ignored
+            cls_target = jnp.where(matched, cls_target,
+                                   jnp.where(keep_neg, 0.0,
+                                             float(ignore_label)))
+
+        loc_t = _encode_box(gt[gt_idx], anchor, variances)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0)
+        loc_m = jnp.where(matched[:, None],
+                          jnp.ones((N, 4), anchor.dtype), 0.0)
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_target
+
+    loc_t, loc_m, cls_t = jax.vmap(per_batch)(label, cls_pred)
+    return (loc_t.astype(anchor.dtype), loc_m.astype(anchor.dtype),
+            cls_t.astype(anchor.dtype))
+
+
+def _decode_box(delta, anchor, variances, clip):
+    aw = anchor[..., 2] - anchor[..., 0]
+    ah = anchor[..., 3] - anchor[..., 1]
+    ax = (anchor[..., 0] + anchor[..., 2]) / 2
+    ay = (anchor[..., 1] + anchor[..., 3]) / 2
+    cx = delta[..., 0] * variances[0] * aw + ax
+    cy = delta[..., 1] * variances[1] * ah + ay
+    w = jnp.exp(delta[..., 2] * variances[2]) * aw / 2
+    h = jnp.exp(delta[..., 3] * variances[3]) * ah / 2
+    out = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+          nondiff=True)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **_):
+    """SSD decode + per-class NMS (ref: contrib/multibox_detection.cc).
+
+    cls_prob (B, C+1, N), loc_pred (B, N*4), anchor (1, N, 4) →
+    (B, N, 6) rows [class_id, score, x1, y1, x2, y2], -1 for suppressed.
+    """
+    anchor = anchor.reshape(-1, 4)
+    N = anchor.shape[0]
+    variances = tuple(variances)
+
+    def per_batch(prob, loc):
+        delta = loc.reshape(N, 4)
+        boxes = _decode_box(delta, anchor, variances, clip)
+        # drop background row, pick best class per anchor
+        fg = jnp.concatenate([prob[:background_id],
+                              prob[background_id + 1:]], axis=0)
+        best = jnp.argmax(fg, axis=0)
+        score = jnp.max(fg, axis=0)
+        cls_ = best.astype(cls_prob.dtype)
+        valid = score > threshold
+        rows = jnp.concatenate(
+            [jnp.where(valid, cls_, -1.0)[:, None],
+             jnp.where(valid, score, -1.0)[:, None], boxes], axis=1)
+        return rows
+
+    rows = jax.vmap(per_batch)(cls_prob, loc_pred)
+    return _box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                    topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                    background_id=-1, force_suppress=force_suppress)
+
+
+# --------------------------------------------------------------------- #
+# Region-proposal ops (ref: src/operator/contrib/proposal.cc,
+# multi_proposal.cc — Faster-RCNN RPN)
+# --------------------------------------------------------------------- #
+
+def _gen_base_anchors(base_size, scales, ratios):
+    """(A, 4) anchors centered on a base_size cell
+    (ref: proposal.cc GenerateAnchors)."""
+    import numpy as _onp
+
+    px = (base_size - 1) * 0.5
+    py = (base_size - 1) * 0.5
+    out = []
+    area = base_size * base_size
+    for r in ratios:
+        size_ratios = area / r
+        ws = _onp.round(_onp.sqrt(size_ratios))
+        hs = _onp.round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            out.append([px - 0.5 * (w - 1), py - 0.5 * (h - 1),
+                        px + 0.5 * (w - 1), py + 0.5 * (h - 1)])
+    return _onp.array(out, dtype=_onp.float32)
+
+
+def _proposal_single(score, bbox_delta, im_info, anchors_base, stride,
+                     pre_nms, post_nms, thresh, min_size, iou_loss):
+    """One image's RPN proposals. score (A, H, W) fg probs; bbox_delta
+    (4A, H, W); im_info (3,) [h, w, scale]."""
+    A = anchors_base.shape[0]
+    H, W = score.shape[1], score.shape[2]
+    shift_x = jnp.arange(W) * stride
+    shift_y = jnp.arange(H) * stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)  # (H, W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).astype(jnp.float32)
+    anchors = (anchors_base[None, None, :, :] + shifts[:, :, None, :])
+    anchors = anchors.reshape(-1, 4)  # (H*W*A, 4)
+
+    deltas = bbox_delta.reshape(A, 4, H, W).transpose(2, 3, 0, 1)
+    deltas = deltas.reshape(-1, 4)
+    scores = score.transpose(1, 2, 0).reshape(-1)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + 0.5 * (aw - 1.0)
+    ay = anchors[:, 1] + 0.5 * (ah - 1.0)
+    if iou_loss:
+        boxes = jnp.stack([anchors[:, 0] + deltas[:, 0],
+                           anchors[:, 1] + deltas[:, 1],
+                           anchors[:, 2] + deltas[:, 2],
+                           anchors[:, 3] + deltas[:, 3]], axis=1)
+    else:
+        cx = deltas[:, 0] * aw + ax
+        cy = deltas[:, 1] * ah + ay
+        w = jnp.exp(deltas[:, 2]) * aw
+        h = jnp.exp(deltas[:, 3]) * ah
+        boxes = jnp.stack([cx - 0.5 * (w - 1.0), cy - 0.5 * (h - 1.0),
+                           cx + 0.5 * (w - 1.0), cy + 0.5 * (h - 1.0)],
+                          axis=1)
+    # clip to image
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_info[1] - 1.0),
+                       jnp.clip(boxes[:, 1], 0, im_info[0] - 1.0),
+                       jnp.clip(boxes[:, 2], 0, im_info[1] - 1.0),
+                       jnp.clip(boxes[:, 3], 0, im_info[0] - 1.0)],
+                      axis=1)
+    ms = min_size * im_info[2]
+    keep_size = ((boxes[:, 2] - boxes[:, 0] + 1.0) >= ms) & \
+                ((boxes[:, 3] - boxes[:, 1] + 1.0) >= ms)
+    scores = jnp.where(keep_size, scores, -jnp.inf)
+
+    n = scores.shape[0]
+    pre = min(pre_nms, n) if pre_nms > 0 else n
+    order = jnp.argsort(-scores)[:pre]
+    sboxes = boxes[order]
+    sscores = scores[order]
+    svalid = jnp.isfinite(sscores)
+
+    iou = _box_iou_corner(sboxes[:, None, :], sboxes[None, :, :])
+
+    def body(i, keep):
+        sup = (iou[i] > thresh) & (jnp.arange(pre) > i)
+        return jnp.where(keep[i] & svalid[i], keep & ~sup, keep)
+
+    keep = jax.lax.fori_loop(0, pre, body,
+                             jnp.ones(pre, dtype=bool)) & svalid
+    # gather kept boxes in score order, pad by cycling through kept ones
+    # (the reference pads the roi batch with earlier proposals)
+    kidx = jnp.argsort(~keep)  # kept first, stable
+    take = kidx[jnp.arange(post_nms) % jnp.maximum(jnp.sum(keep), 1)]
+    out_boxes = sboxes[take]
+    out_scores = sscores[take]
+    return out_boxes, jnp.where(jnp.isfinite(out_scores), out_scores, 0.0)
+
+
+@register("_contrib_Proposal", aliases=("Proposal",), nondiff=True,
+          num_outputs=1)
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+              feature_stride=16, output_score=False, iou_loss=False, **_):
+    """RPN proposal generation (ref: contrib/proposal.cc; batch 1 like
+    the reference). cls_prob (1, 2A, H, W), bbox_pred (1, 4A, H, W),
+    im_info (1, 3) → rois (post_nms, 5) [0, x1, y1, x2, y2]
+    (+ scores (post_nms, 1) when output_score)."""
+    if cls_prob.shape[0] != 1:
+        raise ValueError("Proposal supports batch size 1 only (the "
+                         "reference CHECK-fails too); use MultiProposal "
+                         "for batched input")
+    base = jnp.asarray(_gen_base_anchors(feature_stride, scales, ratios))
+    A = base.shape[0]
+    fg = cls_prob[0, A:, :, :]
+    boxes, scores = _proposal_single(
+        fg, bbox_pred[0], im_info[0], base, feature_stride,
+        int(rpn_pre_nms_top_n), int(rpn_post_nms_top_n), threshold,
+        float(rpn_min_size), iou_loss)
+    rois = jnp.concatenate(
+        [jnp.zeros((boxes.shape[0], 1), boxes.dtype), boxes], axis=1)
+    if output_score:
+        return rois, scores[:, None]
+    return rois
+
+
+@register("_contrib_MultiProposal", aliases=("MultiProposal",),
+          nondiff=True, num_outputs=1)
+def _multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                    rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                    scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+                    feature_stride=16, output_score=False, iou_loss=False,
+                    **_):
+    """Batched Proposal (ref: contrib/multi_proposal.cc). Output
+    (B*post_nms, 5), first column = batch index."""
+    base = jnp.asarray(_gen_base_anchors(feature_stride, scales, ratios))
+    A = base.shape[0]
+    B = cls_prob.shape[0]
+
+    def one(args):
+        prob, delta, info = args
+        return _proposal_single(prob[A:], delta, info, base,
+                                feature_stride, int(rpn_pre_nms_top_n),
+                                int(rpn_post_nms_top_n), threshold,
+                                float(rpn_min_size), iou_loss)
+
+    boxes, scores = jax.vmap(lambda p, d, i: one((p, d, i)))(
+        cls_prob, bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype),
+                      int(rpn_post_nms_top_n))
+    rois = jnp.concatenate([bidx[:, None], boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+# --------------------------------------------------------------------- #
+# Position-sensitive / deformable ops (ref: contrib/psroi_pooling.cc,
+# deformable_convolution.cc, deformable_psroi_pooling.cc — DCN & R-FCN)
+# --------------------------------------------------------------------- #
+
+@register("_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def _psroi_pooling(data, rois, spatial_scale, output_dim, pooled_size,
+                   group_size=0, **_):
+    """Position-sensitive ROI average pooling (ref:
+    contrib/psroi_pooling.cc R-FCN). data (B, dim*g*g, H, W),
+    rois (R, 5) [batch, x1, y1, x2, y2] image coords →
+    (R, output_dim, k, k). Mask-mean formulation: each bin averages its
+    dedicated channel group over the bin's spatial extent — O(k²·H·W)
+    dense math that XLA fuses, instead of the reference's per-bin CUDA
+    gather."""
+    B, C, H, W = data.shape
+    k = int(pooled_size)
+    g = int(group_size) if group_size else k
+    dim = int(output_dim)
+    xs = jnp.arange(W, dtype=data.dtype)
+    ys = jnp.arange(H, dtype=data.dtype)
+
+    def per_roi(roi):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale - 0.5
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        img = data[bi]  # (C, H, W)
+
+        def bin_val(i, j):
+            sy = y1 + i * rh / k
+            ey = y1 + (i + 1.0) * rh / k
+            sx = x1 + j * rw / k
+            ex = x1 + (j + 1.0) * rw / k
+            my = (ys[:, None] >= jnp.floor(sy)) & (ys[:, None] < jnp.ceil(ey))
+            mx = (xs[None, :] >= jnp.floor(sx)) & (xs[None, :] < jnp.ceil(ex))
+            mask = (my & mx).astype(data.dtype)  # (H, W)
+            cnt = jnp.maximum(mask.sum(), 1.0)
+            gi = min(int(i * g / k), g - 1) if isinstance(i, int) else i
+            gj = min(int(j * g / k), g - 1) if isinstance(j, int) else j
+            chans = img[jnp.arange(dim) * g * g + gi * g + gj]  # (dim,H,W)
+            return (chans * mask[None]).sum(axis=(1, 2)) / cnt
+
+        rows = []
+        for i in range(k):
+            cols = [bin_val(i, j) for j in range(k)]
+            rows.append(jnp.stack(cols, axis=-1))  # (dim, k)
+        return jnp.stack(rows, axis=-2)  # (dim, k, k)
+
+    return jax.vmap(per_roi)(rois)
+
+
+def _bilinear_sample(img, y, x):
+    """img (C, H, W); y/x arbitrary same-shaped index arrays → (C, *idx).
+    Zero padding outside (ref: deformable im2col bilinear)."""
+    C, H, W = img.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+    out = 0.0
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yy = y0 + dy
+            xx = x0 + dx
+            inb = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            v = img[:, yi, xi]  # (C, *idx)
+            out = out + v * (wy * wx * inb.astype(img.dtype))[None]
+    return out
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=("DeformableConvolution",), input_names=["data", "offset",
+                                                           "weight", "bias"])
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                            stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                            num_filter=1, num_group=1,
+                            num_deformable_group=1, no_bias=False,
+                            layout="NCHW", **_):
+    """Deformable conv v1 (ref: contrib/deformable_convolution.cc DCN).
+
+    Sampling grid = regular conv taps + learned per-position offsets;
+    bilinear-sample an im2col patch tensor then contract with the weight
+    on the MXU (einsum) — the reference's deformable_im2col restated as
+    dense gather + matmul."""
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    B, C, H, W = data.shape
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = int(num_deformable_group)
+    G = int(num_group)
+    F = int(num_filter)
+
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    base_y = oy[:, None, None, None] + (jnp.arange(kh) * dh)[None, None, :,
+                                                            None]
+    base_x = ox[None, :, None, None] + (jnp.arange(kw) * dw)[None, None,
+                                                             None, :]
+    base_y = jnp.broadcast_to(base_y, (Ho, Wo, kh, kw)).astype(data.dtype)
+    base_x = jnp.broadcast_to(base_x, (Ho, Wo, kh, kw)).astype(data.dtype)
+
+    def per_image(img, off):
+        # off (2*dg*kh*kw, Ho, Wo) ordered [dg, kh, kw, (y, x)]
+        off = off.reshape(dg, kh * kw * 2, Ho, Wo)
+
+        def per_dg(d):
+            o = off[d].reshape(kh, kw, 2, Ho, Wo)
+            oy_ = o[:, :, 0].transpose(2, 3, 0, 1)  # (Ho, Wo, kh, kw)
+            ox_ = o[:, :, 1].transpose(2, 3, 0, 1)
+            y = base_y + oy_
+            x = base_x + ox_
+            cpg = C // dg
+            chans = img[d * cpg:(d + 1) * cpg]
+            return _bilinear_sample(chans, y, x)  # (cpg, Ho, Wo, kh, kw)
+
+        cols = jnp.concatenate([per_dg(d) for d in range(dg)], axis=0)
+        return cols  # (C, Ho, Wo, kh, kw)
+
+    cols = jax.vmap(per_image)(data, offset)  # (B, C, Ho, Wo, kh, kw)
+    w = weight.reshape(G, F // G, C // G, kh, kw)
+    cols_g = cols.reshape(B, G, C // G, Ho, Wo, kh, kw)
+    out = jnp.einsum("bgchwij,gfcij->bgfhw", cols_g, w)
+    out = out.reshape(B, F, Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, F, 1, 1)
+    return out
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=("DeformablePSROIPooling",),
+          input_names=["data", "rois", "trans"])
+def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                              output_dim=1, group_size=1, pooled_size=1,
+                              part_size=0, sample_per_part=1,
+                              trans_std=0.0, no_trans=False, **_):
+    """Deformable position-sensitive ROI pooling (ref:
+    contrib/deformable_psroi_pooling.cc). Bins are shifted by learned
+    normalized offsets `trans` (R, 2*cls, part, part) scaled by
+    trans_std; each bin averages sample_per_part² bilinear samples."""
+    B, C, H, W = data.shape
+    k = int(pooled_size)
+    g = int(group_size)
+    dim = int(output_dim)
+    part = int(part_size) if part_size else k
+    sp = int(sample_per_part)
+
+    def per_roi(roi, tr):
+        bi = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale - 0.5
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / k
+        bin_h = rh / k
+        img = data[bi]
+        sub_w = bin_w / sp
+        sub_h = bin_h / sp
+
+        out = jnp.zeros((dim, k, k), data.dtype)
+        for i in range(k):
+            for j in range(k):
+                pi = min(int(i * part / k), part - 1)
+                pj = min(int(j * part / k), part - 1)
+                if no_trans or tr is None:
+                    dy = 0.0
+                    dx = 0.0
+                else:
+                    # class-agnostic offsets (cls dim broadcast over dim)
+                    dy = tr[0, pi, pj] * trans_std * rh
+                    dx = tr[1, pi, pj] * trans_std * rw
+                gi = min(int(i * g / k), g - 1)
+                gj = min(int(j * g / k), g - 1)
+                chans = img[jnp.arange(dim) * g * g + gi * g + gj]
+                acc = 0.0
+                for si in range(sp):
+                    for sj in range(sp):
+                        y = y1 + i * bin_h + (si + 0.5) * sub_h + dy
+                        x = x1 + j * bin_w + (sj + 0.5) * sub_w + dx
+                        acc = acc + _bilinear_sample(
+                            chans, jnp.asarray(y)[None],
+                            jnp.asarray(x)[None])[:, 0]
+                out = out.at[:, i, j].set(acc / (sp * sp))
+        return out
+
+    if trans is None or no_trans:
+        ztr = jnp.zeros((rois.shape[0], 2, part, part), data.dtype)
+        return jax.vmap(per_roi)(rois, ztr)
+    return jax.vmap(per_roi)(rois, trans)
+
+
+# --------------------------------------------------------------------- #
+# CTC loss (ref: contrib/ctc_loss.cc — warp-ctc embedded kernels)
+# --------------------------------------------------------------------- #
+
+@register("_contrib_CTCLoss", aliases=("ctc_loss", "CTCLoss"),
+          input_names=["data", "label", "data_lengths", "label_lengths"])
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first", **_):
+    """Connectionist temporal classification loss
+    (ref: contrib/ctc_loss.cc:~200, embedded warp-ctc).
+
+    data (T, B, A) pre-softmax activations; label (B, L) padded with 0
+    (blank_label='first') or -1 ('last'). Returns per-example loss (B,).
+    The alpha recursion runs as a `lax.scan` over time — log-space DP,
+    differentiable end-to-end so `backward` is jax autodiff rather than
+    warp-ctc's hand-written gradient.
+    """
+    T, B, A = data.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+    neg_inf = jnp.asarray(-1e30, data.dtype)
+
+    if blank_label == "first":
+        blank = 0
+        pad = 0
+        lab = label.astype(jnp.int32)  # classes already 1..A-1
+    else:
+        blank = A - 1
+        pad = -1
+        lab = label.astype(jnp.int32)
+
+    logp = jax.nn.log_softmax(data, axis=-1)  # (T, B, A)
+
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum(lab != pad, axis=1).astype(jnp.int32)
+    if use_data_lengths and data_lengths is not None:
+        dat_len = data_lengths.astype(jnp.int32)
+    else:
+        dat_len = jnp.full((B,), T, jnp.int32)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank  (length S)
+    pos = jnp.arange(S)
+    lab_idx = jnp.clip((pos - 1) // 2, 0, L - 1)
+    taken = jnp.take_along_axis(
+        lab, jnp.broadcast_to(lab_idx[None], (B, S)), axis=1)  # (B, S)
+    ext = jnp.where((pos % 2 == 0)[None, :], blank, taken)  # (B, S)
+    in_range = pos[None, :] < (2 * lab_len[:, None] + 1)
+    # skip-transition allowed when symbol differs from the one 2 back
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -2, jnp.int32),
+                              ext[:, :-2]], axis=1)
+    can_skip = (pos[None, :] % 2 == 1) & (ext != ext_m2)
+
+    def step(alpha, t_logp):
+        # t_logp (B, A); alpha (B, S) log-probs
+        p = jnp.take_along_axis(t_logp, ext, axis=1)  # (B, S)
+        a0 = alpha
+        a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]],
+                             axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]],
+                             axis=1)
+        a2 = jnp.where(can_skip, a2, neg_inf)
+        new = jnp.logaddexp(jnp.logaddexp(a0, a1), a2) + p
+        new = jnp.where(in_range, new, neg_inf)
+        return new, new
+
+    init = jnp.full((B, S), neg_inf)
+    init = init.at[:, 0].set(jnp.take_along_axis(
+        logp[0], ext[:, 0:1], axis=1)[:, 0])
+    has1 = lab_len > 0
+    init = init.at[:, 1].set(jnp.where(
+        has1, jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0],
+        neg_inf))
+
+    def scan_body(carry, t_logp):
+        alpha, t = carry
+        new = step(alpha, t_logp)[0]
+        # freeze each example's alpha once its data length is consumed:
+        # input element at carry time t is frame t (t starts at 1)
+        active = t < dat_len[:, None]
+        keep = jnp.where(active, new, alpha)
+        return (keep, t + 1), None
+
+    (alpha, _), _ = jax.lax.scan(scan_body, (init, jnp.asarray(1)),
+                                 logp[1:])
+    end1 = jnp.take_along_axis(alpha, (2 * lab_len)[:, None], axis=1)[:, 0]
+    end2 = jnp.take_along_axis(alpha,
+                               jnp.maximum(2 * lab_len - 1, 0)[:, None],
+                               axis=1)[:, 0]
+    end2 = jnp.where(lab_len > 0, end2, neg_inf)
+    loss = -jnp.logaddexp(end1, end2)
+    return loss.astype(data.dtype)
+
+
+# --------------------------------------------------------------------- #
+# FFT (ref: contrib/fft.cc — cuFFT wrappers)
+# --------------------------------------------------------------------- #
+
+@register("_contrib_fft", aliases=("fft",))
+def _fft(data, compute_size=128, **_):
+    """Real→complex FFT along the last axis, output interleaved
+    [re0, im0, re1, im1, ...] (ref: contrib/fft-inl.h:53 — cuFFT
+    layout; compute_size is the reference's batching knob, a no-op
+    here since XLA tiles the batch itself)."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(
+        data.dtype)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def _ifft(data, compute_size=128, **_):
+    """Complex→real inverse FFT, input interleaved, **unnormalized**
+    like cuFFT (ifft(fft(x)) == n*x; ref: contrib/fft-inl.h inverse
+    plan has no scaling)."""
+    n = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (n, 2))
+    z = c[..., 0] + 1j * c[..., 1]
+    out = jnp.fft.ifft(z, axis=-1) * n
+    return out.real.astype(data.dtype)

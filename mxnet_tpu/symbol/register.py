@@ -24,3 +24,6 @@ def populate(module_dict: Dict[str, Any]) -> None:
         op = _registry._REGISTRY[reg_name]
         if reg_name not in module_dict:
             module_dict[reg_name] = _make_wrapper(op)
+    from ..ndarray.register import _populate_contrib
+
+    _populate_contrib(module_dict, _make_wrapper)

@@ -417,6 +417,8 @@ def _static_num_outputs(op: _op_registry.Op, params: Dict[str, Any]) -> int:
         return 3 if params.get("output_mean_var") else 1
     if op.name == "topk":
         return 2 if params.get("ret_typ") == "both" else 1
+    if op.name in ("_contrib_Proposal", "_contrib_MultiProposal"):
+        return 2 if params.get("output_score") else 1
     if op.name == "RNN":
         if not params.get("state_outputs"):
             return 1

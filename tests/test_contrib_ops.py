@@ -1,0 +1,316 @@
+"""Contrib detection/research op tests (models: reference
+tests/python/unittest/test_operator.py multibox/proposal/ctc sections,
+test_contrib_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_multibox_target_basic():
+    # one anchor overlapping the gt box, one far away
+    anchors = nd.array(np.array(
+        [[[0.1, 0.1, 0.5, 0.5], [0.7, 0.7, 0.9, 0.9]]], np.float32))
+    # one gt: class 2 at [0.1, 0.1, 0.5, 0.5] (exact match with anchor 0)
+    label = nd.array(np.array(
+        [[[2, 0.1, 0.1, 0.5, 0.5], [-1, -1, -1, -1, -1]]], np.float32))
+    cls_pred = nd.zeros((1, 4, 2))
+    loc_t, loc_m, cls_t = nd.MultiBoxTarget(anchors, label, cls_pred)
+    ct = cls_t.asnumpy()
+    assert ct.shape == (1, 2)
+    assert ct[0, 0] == 3.0  # class 2 + 1
+    assert ct[0, 1] == 0.0  # background
+    lm = loc_m.asnumpy().reshape(1, 2, 4)
+    assert (lm[0, 0] == 1).all()
+    assert (lm[0, 1] == 0).all()
+    lt = loc_t.asnumpy().reshape(1, 2, 4)
+    np.testing.assert_allclose(lt[0, 0], 0.0, atol=1e-5)  # exact match
+
+
+def test_multibox_target_threshold_matching():
+    anchors = nd.array(np.array(
+        [[[0.0, 0.0, 0.4, 0.4], [0.05, 0.05, 0.45, 0.45],
+          [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    label = nd.array(np.array(
+        [[[0, 0.0, 0.0, 0.4, 0.4]]], np.float32))
+    cls_pred = nd.zeros((1, 2, 3))
+    _, _, cls_t = nd.MultiBoxTarget(anchors, label, cls_pred,
+                                    overlap_threshold=0.5)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 1.0  # bipartite best match
+    assert ct[1] == 1.0  # IoU > 0.5 threshold match
+    assert ct[2] == 0.0
+
+
+def test_multibox_target_negative_mining():
+    # anchor 0 matches; anchor 1 is a confident (hard) negative; anchors
+    # 2-3 are easy negatives → with ratio=1 only the hard one trains as
+    # background, the easy ones are ignored
+    anchors = nd.array(np.array(
+        [[[0.1, 0.1, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9],
+          [0.0, 0.6, 0.3, 0.9], [0.6, 0.0, 0.9, 0.3]]], np.float32))
+    label = nd.array(np.array([[[0, 0.1, 0.1, 0.5, 0.5]]], np.float32))
+    cls_pred = np.full((1, 3, 4), 0.1, np.float32)
+    cls_pred[0, 1, 1] = 0.9  # anchor 1 confidently predicts class 0
+    _, _, cls_t = nd.MultiBoxTarget(
+        anchors, label, nd.array(cls_pred), negative_mining_ratio=1.0,
+        negative_mining_thresh=0.5, ignore_label=-1)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 1.0
+    assert ct[1] == 0.0  # mined hard negative
+    assert ct[2] == -1.0 and ct[3] == -1.0  # ignored
+
+
+def test_contrib_namespace_aliases():
+    assert hasattr(mx.nd.contrib, "ctc_loss")
+    assert hasattr(mx.nd.contrib, "box_nms")
+    assert hasattr(mx.sym.contrib, "ctc_loss")
+    assert hasattr(mx.nd.contrib, "CTCLoss")
+
+
+def test_proposal_rejects_batch():
+    with pytest.raises(Exception):
+        nd.Proposal(nd.zeros((2, 24, 3, 3)), nd.zeros((2, 48, 3, 3)),
+                    nd.zeros((2, 3)))
+
+
+def test_multibox_detection_roundtrip():
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5], [0.5, 0.5, 0.9, 0.9]]],
+                       np.float32)
+    # anchor 0 strongly class 1; anchor 1 background
+    cls_prob = np.array([[[0.1, 0.9], [0.8, 0.05], [0.1, 0.05]]],
+                        np.float32)
+    loc_pred = np.zeros((1, 8), np.float32)
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                               nd.array(anchors), threshold=0.5)
+    o = out.asnumpy()
+    assert o.shape == (1, 2, 6)
+    kept = o[0][o[0, :, 0] >= 0]
+    assert len(kept) == 1
+    assert kept[0, 0] == 0.0  # class 0 (background removed from ids)
+    np.testing.assert_allclose(kept[0, 1], 0.8, rtol=1e-5)
+    np.testing.assert_allclose(kept[0, 2:], [0.1, 0.1, 0.5, 0.5],
+                               atol=1e-5)
+
+
+def test_multibox_detection_decode():
+    anchors = np.array([[[0.2, 0.2, 0.6, 0.6]]], np.float32)
+    cls_prob = np.array([[[0.1], [0.9]]], np.float32)
+    # shift center by +0.1 in x: dx = 0.1 / 0.4 / 0.1 = 2.5
+    loc_pred = np.array([[2.5, 0.0, 0.0, 0.0]], np.float32)
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                               nd.array(anchors)).asnumpy()
+    np.testing.assert_allclose(out[0, 0, 2:], [0.3, 0.2, 0.7, 0.6],
+                               atol=1e-5)
+
+
+def test_proposal_shapes_and_clip():
+    H = W = 4
+    A = 3 * 4  # ratios x scales defaults
+    rng = np.random.RandomState(0)
+    cls_prob = rng.rand(1, 2 * A, H, W).astype(np.float32)
+    bbox_pred = (rng.rand(1, 4 * A, H, W).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = nd.Proposal(nd.array(cls_prob), nd.array(bbox_pred),
+                       nd.array(im_info), rpn_pre_nms_top_n=50,
+                       rpn_post_nms_top_n=10, rpn_min_size=1)
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1:] >= 0).all()
+    assert (r[:, [1, 3]] <= 63).all() and (r[:, [2, 4]] <= 63).all()
+    # with scores
+    rois, scores = nd.Proposal(nd.array(cls_prob), nd.array(bbox_pred),
+                               nd.array(im_info), rpn_pre_nms_top_n=50,
+                               rpn_post_nms_top_n=10, rpn_min_size=1,
+                               output_score=True)
+    assert scores.shape == (10, 1)
+    s = scores.asnumpy().ravel()
+    # score-ordered, except where the output pads by cycling back to the
+    # top kept proposal
+    rising = np.where(np.diff(s) > 1e-6)[0]
+    assert all(abs(s[i + 1] - s[0]) < 1e-6 for i in rising)
+
+
+def test_multi_proposal_batched():
+    H = W = 3
+    A = 12
+    rng = np.random.RandomState(1)
+    cls_prob = rng.rand(2, 2 * A, H, W).astype(np.float32)
+    bbox_pred = np.zeros((2, 4 * A, H, W), np.float32)
+    im_info = np.array([[48, 48, 1.0], [48, 48, 1.0]], np.float32)
+    rois = nd.MultiProposal(nd.array(cls_prob), nd.array(bbox_pred),
+                            nd.array(im_info), rpn_pre_nms_top_n=30,
+                            rpn_post_nms_top_n=5, rpn_min_size=1)
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    assert (r[:5, 0] == 0).all() and (r[5:, 0] == 1).all()
+
+
+def test_psroi_pooling():
+    # data where channel c is constant c → each output bin picks its
+    # dedicated channel: out[r, d, i, j] = d*g*g + i*g + j
+    dim, g = 2, 2
+    B, H, W = 1, 8, 8
+    C = dim * g * g
+    data = np.zeros((B, C, H, W), np.float32)
+    for c in range(C):
+        data[:, c] = c
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.PSROIPooling(nd.array(data), nd.array(rois),
+                          spatial_scale=1.0, output_dim=dim,
+                          pooled_size=2, group_size=2)
+    o = out.asnumpy()
+    assert o.shape == (1, dim, 2, 2)
+    for d in range(dim):
+        for i in range(2):
+            for j in range(2):
+                assert o[0, d, i, j] == d * 4 + i * 2 + j
+
+
+def test_psroi_pooling_grad_flows():
+    data = nd.array(np.random.rand(1, 4, 6, 6).astype(np.float32))
+    rois = nd.array(np.array([[0, 1, 1, 4, 4]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.PSROIPooling(data, rois, spatial_scale=1.0,
+                              output_dim=1, pooled_size=2)
+        loss = out.sum()
+    loss.backward()
+    assert float(nd.abs(data.grad).sum().asnumpy()) > 0
+
+
+def test_deformable_convolution_zero_offset_matches_conv():
+    rng = np.random.RandomState(2)
+    x = rng.rand(1, 3, 6, 6).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    offset = np.zeros((1, 2 * 3 * 3, 4, 4), np.float32)
+    out = nd.DeformableConvolution(nd.array(x), nd.array(offset),
+                                   nd.array(w), nd.array(b),
+                                   kernel=(3, 3), num_filter=4)
+    ref = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=4)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_convolution_integer_shift():
+    # offset of exactly (0, +1) shifts sampling one pixel right
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    offset = np.zeros((1, 2, 6, 6), np.float32)
+    offset[:, 1] = 1.0  # x-offset
+    out = nd.DeformableConvolution(nd.array(x), nd.array(offset),
+                                   nd.array(w), kernel=(1, 1),
+                                   num_filter=1, no_bias=True)
+    o = out.asnumpy()[0, 0]
+    np.testing.assert_allclose(o[:, :-1], x[0, 0, :, 1:], atol=1e-5)
+    np.testing.assert_allclose(o[:, -1], 0.0, atol=1e-5)  # zero pad
+
+
+def test_deformable_psroi_pooling_no_trans_matches_psroi():
+    rng = np.random.RandomState(3)
+    data = rng.rand(1, 4, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0,
+        output_dim=1, group_size=2, pooled_size=2, no_trans=True,
+        sample_per_part=2)
+    assert out.shape == (1, 1, 2, 2)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def _np_ctc_loss(logits, labels, blank=0):
+    """Brute-force CTC by enumerating alignments (tiny T only)."""
+    import itertools
+
+    T, A = logits.shape
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    total = 0.0
+    for path in itertools.product(range(A), repeat=T):
+        # collapse
+        out = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                out.append(s)
+            prev = s
+        if out == list(labels):
+            prob = 1.0
+            for t, s in enumerate(path):
+                prob *= p[t, s]
+            total += prob
+    return -np.log(max(total, 1e-300))
+
+
+def test_ctc_loss_vs_bruteforce():
+    rng = np.random.RandomState(4)
+    T, B, A = 4, 2, 3  # alphabet: blank=0, classes 1..2
+    data = rng.randn(T, B, A).astype(np.float32)
+    label = np.array([[1, 2], [1, 0]], np.float32)  # second: len 1
+    loss = nd.ctc_loss(nd.array(data), nd.array(label))
+    got = loss.asnumpy()
+    want0 = _np_ctc_loss(data[:, 0], [1, 2])
+    want1 = _np_ctc_loss(data[:, 1], [1])
+    np.testing.assert_allclose(got, [want0, want1], rtol=1e-4)
+
+
+def test_ctc_loss_lengths_and_grad():
+    rng = np.random.RandomState(5)
+    T, B, A = 5, 2, 4
+    data = nd.array(rng.randn(T, B, A).astype(np.float32))
+    label = nd.array(np.array([[1, 2, 3], [2, 1, 0]], np.float32))
+    dlen = nd.array(np.array([5, 4], np.float32))
+    llen = nd.array(np.array([3, 2], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        loss = nd.ctc_loss(data, label, dlen, llen,
+                           use_data_lengths=True, use_label_lengths=True)
+        total = loss.sum()
+    total.backward()
+    got = loss.asnumpy()
+    want0 = _np_ctc_loss(data.asnumpy()[:, 0], [1, 2, 3])
+    want1 = _np_ctc_loss(data.asnumpy()[:4, 1], [2, 1])
+    np.testing.assert_allclose(got, [want0, want1], rtol=1e-4)
+    g = data.grad.asnumpy()
+    assert np.abs(g).sum() > 0
+    # frames past data_length get no gradient
+    assert np.abs(g[4, 1]).sum() < 1e-6
+
+
+def test_ctc_loss_blank_last():
+    rng = np.random.RandomState(6)
+    T, A = 4, 3  # blank = 2
+    data = rng.randn(T, 1, A).astype(np.float32)
+    label = np.array([[0, 1]], np.float32)
+    loss = nd.ctc_loss(nd.array(data), nd.array(label),
+                       blank_label="last")
+    want = _np_ctc_loss(data[:, 0], [0, 1], blank=2)
+    np.testing.assert_allclose(loss.asnumpy(), [want], rtol=1e-4)
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(7)
+    x = rng.rand(3, 8).astype(np.float32)
+    f = nd.contrib.fft(nd.array(x))
+    assert f.shape == (3, 16)
+    ref = np.fft.fft(x, axis=-1)
+    got = f.asnumpy().reshape(3, 8, 2)
+    np.testing.assert_allclose(got[..., 0], ref.real, atol=1e-4)
+    np.testing.assert_allclose(got[..., 1], ref.imag, atol=1e-4)
+    # cuFFT-style unnormalized inverse: ifft(fft(x)) == n * x
+    inv = nd.contrib.ifft(f)
+    np.testing.assert_allclose(inv.asnumpy(), 8 * x, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_contrib_symbolic_use():
+    # detection ops compose in symbols (SSD head shape flow)
+    data = mx.sym.Variable("data")
+    anchors = mx.sym.contrib.MultiBoxPrior(data, sizes=(0.5,),
+                                           ratios=(1.0,))
+    arg_shapes, out_shapes, _ = anchors.infer_shape(data=(1, 3, 4, 4))
+    assert out_shapes[0] == (1, 16, 4)

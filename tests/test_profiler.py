@@ -134,6 +134,21 @@ def test_monitor_fires_in_module_fit():
     assert "fc1_output" in seen
 
 
+def test_monitor_single_fire_manual_forward_backward():
+    """Manual forward()+backward() must fire each stat exactly once."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc1", num_hidden=2)
+    exe = fc.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    mon = mx.Monitor(interval=1, pattern="fc1_output")
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=True)
+    exe.backward(out_grads=mx.nd.ones((2, 2)))
+    rows = mon.toc()
+    names = [k for _, k, _ in rows]
+    assert names.count("fc1_output") == 1
+
+
 def test_custom_op_sees_is_train():
     import mxnet_tpu.operator as mxop
 
