@@ -1,0 +1,182 @@
+"""Parameter-server transport + cluster bootstrap.
+
+ref: the ps-lite layer the reference builds kvstore_dist on
+(src/kvstore/kvstore_dist.h:54-58 ps::StartAsync/Postoffice::Barrier,
+include/mxnet/kvstore.h:254-306 DMLC_ROLE/DMLC_PS_ROOT_URI bootstrap).
+
+TPU-native stance (SURVEY.md §5 "Distributed communication backend"):
+gradient exchange *inside* a slice rides XLA collectives over ICI; this
+module is the API-compat **host-side** PS used by `dist_sync`/
+`dist_async` — cross-process key/value traffic over TCP, exactly the
+role ps-lite's Van plays, with the scheduler doing rank assignment and
+barriers the way ps-lite's Postoffice does.
+
+Protocol: length-prefixed pickled dicts over TCP. Roles from env:
+  DMLC_ROLE           scheduler | server | worker
+  DMLC_PS_ROOT_URI    scheduler host
+  DMLC_PS_ROOT_PORT   scheduler port
+  DMLC_NUM_SERVER     server count
+  DMLC_NUM_WORKER     worker count
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+_LEN = struct.Struct("<Q")
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def env_role() -> Optional[str]:
+    return os.environ.get("DMLC_ROLE")
+
+
+def env_cluster() -> Tuple[str, int, int, int]:
+    return (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")),
+            int(os.environ.get("DMLC_NUM_SERVER", "1")),
+            int(os.environ.get("DMLC_NUM_WORKER", "1")))
+
+
+class Scheduler:
+    """Rendezvous + barrier service (the Postoffice scheduler role).
+
+    Servers register with their listen address; workers register and
+    receive the full server table + their rank. Runs until every node
+    sends a `finalize` (ref: ps-lite scheduler lifecycle)."""
+
+    def __init__(self, port: int, num_servers: int, num_workers: int):
+        self.num_servers = num_servers
+        self.num_workers = num_workers
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", port))
+        self.sock.listen(128)
+        self.lock = threading.Condition()
+        self.servers: List[Tuple[str, int]] = []
+        self.worker_ranks = 0
+        self.barrier_count: Dict[int, int] = {}
+        self.barrier_gen: Dict[int, int] = {}
+        self.done = 0
+
+    def run(self):
+        threads = []
+        total = self.num_servers + self.num_workers
+        conns = []
+        for _ in range(total):
+            conn, _ = self.sock.accept()
+            conns.append(conn)
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        self.sock.close()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = recv_msg(conn)
+                if msg is None:
+                    return
+                op = msg["op"]
+                if op == "register_server":
+                    with self.lock:
+                        rank = len(self.servers)
+                        self.servers.append(tuple(msg["addr"]))
+                        self.lock.notify_all()
+                    send_msg(conn, {"rank": rank})
+                elif op == "register_worker":
+                    with self.lock:
+                        while len(self.servers) < self.num_servers:
+                            self.lock.wait()
+                        rank = self.worker_ranks
+                        self.worker_ranks += 1
+                    send_msg(conn, {"rank": rank,
+                                    "servers": list(self.servers)})
+                elif op == "barrier":
+                    gid = msg.get("group", 0)
+                    with self.lock:
+                        gen = self.barrier_gen.setdefault(gid, 0)
+                        self.barrier_count[gid] = \
+                            self.barrier_count.get(gid, 0) + 1
+                        if self.barrier_count[gid] >= self.num_workers:
+                            self.barrier_count[gid] = 0
+                            self.barrier_gen[gid] = gen + 1
+                            self.lock.notify_all()
+                        else:
+                            while self.barrier_gen[gid] == gen:
+                                self.lock.wait()
+                    send_msg(conn, {"ok": True})
+                elif op == "finalize":
+                    send_msg(conn, {"ok": True})
+                    return
+        finally:
+            conn.close()
+
+
+class Client:
+    """One TCP connection with request/response framing + a lock so
+    multiple frontend threads can share it."""
+
+    def __init__(self, addr: Tuple[str, int]):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.connect(tuple(addr))
+        self.lock = threading.Lock()
+
+    def request(self, msg: Any) -> Any:
+        with self.lock:
+            send_msg(self.sock, msg)
+            return recv_msg(self.sock)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def connect_scheduler(retries: int = 200, delay: float = 0.05) -> Client:
+    import time
+
+    host, port, _, _ = env_cluster()
+    last = None
+    for _ in range(retries):
+        try:
+            return Client((host, port))
+        except OSError as e:
+            last = e
+            time.sleep(delay)
+    raise ConnectionError("cannot reach scheduler at %s:%d: %s"
+                          % (host, port, last))

@@ -1,0 +1,76 @@
+"""2-bit stochastic gradient compression with error feedback.
+
+ref: src/kvstore/gradient_compression.h:37-52 (CompressionType::kTwoBit,
+threshold param :43-47) and the quantize/dequantize kernels in
+gradient_compression-inl.h.
+
+Scheme (matches the reference semantics): values >= threshold encode as
++threshold (code 1), values <= -threshold as -threshold (code 2), the
+rest as 0 (code 0); the quantization error (residual) is kept locally
+and added to the next gradient before encoding — so small gradients
+accumulate until they cross the threshold. Codes pack 4-per-byte
+(the reference packs 16 per float32 word).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+__all__ = ["GradientCompression"]
+
+
+class GradientCompression:
+    def __init__(self, type: str = "2bit", threshold: float = 0.5):
+        if type != "2bit":
+            raise ValueError("unsupported compression type %r "
+                             "(reference supports 2bit)" % type)
+        if threshold <= 0:
+            raise ValueError("threshold must be positive "
+                             "(ref: gradient_compression.h:43 range check)")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residual: Dict = {}
+
+    def get_params(self) -> Dict[str, str]:
+        return {"type": self.type, "threshold": str(self.threshold)}
+
+    def compress(self, key, grad: np.ndarray) -> Tuple[bytes, tuple]:
+        """grad (+ carried residual) → packed 2-bit codes. Returns
+        (codes_bytes, shape)."""
+        grad = np.asarray(grad, dtype=np.float32)
+        res = self._residual.get(key)
+        if res is None:
+            res = np.zeros_like(grad)
+        work = grad + res
+        codes = np.zeros(work.size, dtype=np.uint8)
+        flat = work.ravel()
+        pos = flat >= self.threshold
+        neg = flat <= -self.threshold
+        codes[pos] = 1
+        codes[neg] = 2
+        decoded = np.zeros_like(flat)
+        decoded[pos] = self.threshold
+        decoded[neg] = -self.threshold
+        self._residual[key] = (flat - decoded).reshape(grad.shape)
+        # pack 4 codes per byte
+        pad = (-codes.size) % 4
+        if pad:
+            codes = np.concatenate([codes, np.zeros(pad, np.uint8)])
+        packed = (codes[0::4] | (codes[1::4] << 2) | (codes[2::4] << 4)
+                  | (codes[3::4] << 6))
+        return packed.tobytes(), tuple(grad.shape)
+
+    def decompress(self, codes: bytes, shape: tuple) -> np.ndarray:
+        packed = np.frombuffer(codes, dtype=np.uint8)
+        n = int(np.prod(shape)) if shape else 1
+        codes4 = np.empty(packed.size * 4, np.uint8)
+        codes4[0::4] = packed & 0x3
+        codes4[1::4] = (packed >> 2) & 0x3
+        codes4[2::4] = (packed >> 4) & 0x3
+        codes4[3::4] = (packed >> 6) & 0x3
+        codes4 = codes4[:n]
+        out = np.zeros(n, np.float32)
+        out[codes4 == 1] = self.threshold
+        out[codes4 == 2] = -self.threshold
+        return out.reshape(shape)

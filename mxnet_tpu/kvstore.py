@@ -212,12 +212,257 @@ def _key_value(key, value):
     return [key], [value]
 
 
+class KVStoreDist(KVStore):
+    """Multi-process parameter-server worker
+    (ref: src/kvstore/kvstore_dist.h:49 KVStoreDist).
+
+    Keys shard across servers by crc32 (the EncodeDefaultKey analogue,
+    kvstore_dist.h:229). ``dist_sync``: servers aggregate each key until
+    all workers contributed, then apply the (server-side) optimizer —
+    a worker's pull after its push blocks until that round is applied.
+    ``dist_async``: every push applies immediately
+    (kvstore_dist_server.h:266)."""
+
+    def __init__(self, kind: str):
+        super().__init__(kind)
+        from . import _ps
+
+        self._ps = _ps
+        self._sync = "async" not in kind
+        sched = _ps.connect_scheduler()
+        resp = sched.request({"op": "register_worker"})
+        self._rank = resp["rank"]
+        self._server_clients = [_ps.Client(a) for a in resp["servers"]]
+        self._sched = sched
+        _, _, _, nw = _ps.env_cluster()
+        self._nw = nw
+        self._push_rounds: Dict[Any, int] = {}
+        self._gc = None
+        self._closed = False
+        if not self._sync and self._rank == 0:
+            for c in self._server_clients:
+                c.request({"op": "set_sync", "sync": False})
+        import atexit
+
+        atexit.register(self.close)
+
+    # -- identity ------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._nw
+
+    def _server_for(self, key):
+        import zlib
+
+        return self._server_clients[
+            zlib.crc32(str(key).encode()) % len(self._server_clients)]
+
+    @staticmethod
+    def _req(client, msg):
+        """Request + error check (failed server commands must not be
+        silently swallowed)."""
+        resp = client.request(msg)
+        if resp is None:
+            raise MXNetError("server connection lost during %r"
+                             % msg.get("op"))
+        if resp.get("error") or resp.get("ok") is False:
+            raise MXNetError("server rejected %r: %s"
+                             % (msg.get("op"),
+                                resp.get("error", "unknown error")))
+        return resp
+
+    def _fanout(self, work):
+        """Run per-key request thunks concurrently — keys shard across
+        servers, so independent requests overlap instead of paying one
+        RTT each (the reference pipelines via async ZPush/ZPull)."""
+        if len(work) <= 1:
+            return [w() for w in work]
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(len(work), 16)) as pool:
+            return list(pool.map(lambda w: w(), work))
+
+    # -- core API ------------------------------------------------------
+    def init(self, key, value) -> None:
+        keys, values = _key_value(key, value)
+        self._fanout([
+            (lambda k=k, v=v: self._req(
+                self._server_for(k),
+                {"op": "init", "key": k, "data": _as_list(v)[0].asnumpy()}))
+            for k, v in zip(keys, values)])
+        self.barrier()
+
+    def _merge(self, vlist):
+        vs = _as_list(vlist)
+        acc = vs[0]._data
+        for v in vs[1:]:
+            acc = acc + v._data
+        return NDArray.from_raw(acc, vs[0].context)
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys, values = _key_value(key, value)
+
+        def one(k, vlist):
+            merged = self._merge(vlist)
+            msg = {"op": "push", "key": k, "worker": self._rank}
+            if self._gc is not None:
+                codes, shape = self._gc.compress(k, merged.asnumpy())
+                msg.update(compressed=True, data=codes, shape=shape)
+            else:
+                msg["data"] = merged.asnumpy()
+            self._req(self._server_for(k), msg)
+
+        self._fanout([
+            (lambda k=k, v=v: one(k, v)) for k, v in zip(keys, values)])
+        for k in keys:
+            self._push_rounds[k] = self._push_rounds.get(k, 0) + 1
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True) -> None:
+        keys, outs = _key_value(key, out)
+
+        def one(k, olist):
+            resp = self._req(self._server_for(k),
+                             {"op": "pull", "key": k,
+                              "round": self._push_rounds.get(k, 0)})
+            src = _np.asarray(resp["data"])
+            for o in _as_list(olist):
+                o[:] = src.astype(o.dtype, copy=False)
+
+        self._fanout([
+            (lambda k=k, o=o: one(k, o)) for k, o in zip(keys, outs)])
+
+    def row_sparse_pull(self, key, out=None, priority=0,
+                        row_ids=None) -> None:
+        from .ndarray import sparse as _sp
+
+        if row_ids is None or out is None:
+            raise MXNetError("row_sparse_pull requires out and row_ids")
+        keys, outs = _key_value(key, out)
+        rids = _as_list(row_ids)
+        if len(rids) == 1 and len(keys) > 1:
+            rids = rids * len(keys)
+        for k, olist, rid in zip(keys, outs, rids):
+            rows = _np.unique(
+                (rid.asnumpy() if isinstance(rid, NDArray)
+                 else _np.asarray(rid)).astype(_np.int64).ravel())
+            resp = self._req(self._server_for(k),
+                             {"op": "pull_rows", "key": k, "rows": rows,
+                              "round": self._push_rounds.get(k, 0)})
+            import jax.numpy as jnp
+
+            for o in _as_list(olist):
+                if isinstance(o, _sp.RowSparseNDArray):
+                    data = _np.asarray(resp["data"]).astype(o.dtype,
+                                                            copy=False)
+                    pulled = _sp.RowSparseNDArray._make(
+                        o.shape, o.dtype,
+                        {"data": jnp.asarray(data),
+                         "indices": jnp.asarray(resp["rows"])}, o.context)
+                    pulled.copyto(o)
+                else:
+                    dense = _np.zeros(o.shape, o.dtype)
+                    dense[resp["rows"]] = resp["data"]
+                    o[:] = dense
+
+    # -- optimizer travels to the servers ------------------------------
+    def set_optimizer(self, optimizer: _opt.Optimizer) -> None:
+        """ref: kvstore.py set_optimizer — pickle the optimizer and ship
+        it via the server command channel (SendCommandToServers)."""
+        if self._rank == 0:
+            payload = pickle.dumps(optimizer)
+            for c in self._server_clients:
+                self._req(c, {"op": "set_optimizer", "payload": payload})
+        self.barrier()
+
+    def set_gradient_compression(self, compression_params) -> None:
+        from .gradient_compression import GradientCompression
+
+        params = dict(compression_params or {})
+        self._gc = GradientCompression(
+            type=params.get("type", "2bit"),
+            threshold=float(params.get("threshold", 0.5)))
+        if self._rank == 0:
+            for c in self._server_clients:
+                self._req(c, {"op": "set_compression",
+                              "type": self._gc.type,
+                              "threshold": self._gc.threshold})
+        self.barrier()
+
+    def save_optimizer_states(self, fname: str,
+                              dump_optimizer: bool = False) -> None:
+        """Gather every server shard's optimizer state — keys shard by
+        crc32, so each server holds state only for its own keys
+        (ref: Trainer.save_states round-tripping the server updater)."""
+        blobs = {}
+        for i, c in enumerate(self._server_clients):
+            resp = self._req(c, {"op": "save_optimizer_states",
+                                 "dump_optimizer": dump_optimizer})
+            blobs[i] = resp["data"]
+        with open(fname, "wb") as f:
+            f.write(pickle.dumps({"num_servers": len(blobs),
+                                  "shards": blobs}))
+
+    def load_optimizer_states(self, fname: str) -> None:
+        with open(fname, "rb") as f:
+            payload = pickle.loads(f.read())
+        if payload["num_servers"] != len(self._server_clients):
+            raise MXNetError(
+                "optimizer states saved with %d servers, cluster has %d"
+                % (payload["num_servers"], len(self._server_clients)))
+        for i, c in enumerate(self._server_clients):
+            self._req(c, {"op": "load_optimizer_states",
+                          "data": payload["shards"][i]})
+
+    # -- cluster control -----------------------------------------------
+    def barrier(self) -> None:
+        """ref: Postoffice::Barrier via the scheduler."""
+        self._sched.request({"op": "barrier"})
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for c in self._server_clients:
+            try:
+                c.request({"op": "stop"})
+                c.close()
+            except OSError:
+                pass
+        try:
+            self._sched.request({"op": "finalize"})
+            self._sched.close()
+        except OSError:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 _VALID = {"local", "device", "tpu", "nccl", "dist_sync", "dist_async",
           "dist_device_sync", "dist"}
 
 
 def create(name: str = "local") -> KVStore:
-    """ref: src/kvstore/kvstore.cc:38 KVStore::Create."""
+    """ref: src/kvstore/kvstore.cc:38 KVStore::Create. ``dist_*`` with
+    DMLC_* cluster env present returns the parameter-server worker; with
+    no cluster env it degrades to the single-process store (rank 0 of 1)
+    so launcher-less scripts still run."""
     if not isinstance(name, str) or name not in _VALID:
         raise MXNetError("unknown kvstore type %r" % (name,))
+    if name.startswith("dist"):
+        import os
+
+        from . import kvstore_server
+
+        kvstore_server.init()  # blocks forever in scheduler/server roles
+        if os.environ.get("DMLC_PS_ROOT_URI"):
+            return KVStoreDist(name)
     return KVStore(name)

@@ -1,0 +1,241 @@
+"""Parameter-server node: server request handling + role bootstrap.
+
+ref: src/kvstore/kvstore_dist_server.h (KVStoreDistServer: DataHandleEx
+dispatch :173, sync aggregation waiting for NumWorkers parts
+ApplyUpdates :187-189, row-sparse handler :223, compressed handler :392,
+sync-mode command :154-159, single-thread serialized optimizer Executor
+:54-98) and python/mxnet/kvstore_server.py:28-73 (bootstrap by
+DMLC_ROLE).
+
+The server applies optimizer updates under one lock — the reference's
+serialized `Executor` loop — so sync aggregation is deterministic:
+every worker's pull after its push observes the round's applied update.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import _ps
+from .gradient_compression import GradientCompression
+
+__all__ = ["KVStoreServer", "run_scheduler", "run_server", "init"]
+
+
+class _KeyState:
+    __slots__ = ("agg", "workers", "applied")
+
+    def __init__(self):
+        self.agg: Optional[np.ndarray] = None
+        self.workers = set()
+        self.applied = 0  # completed aggregation rounds
+
+
+class KVStoreServer:
+    """One PS shard (ref: KVStoreDistServer, kvstore_dist_server.h:113)."""
+
+    def __init__(self):
+        host, port, num_servers, num_workers = _ps.env_cluster()
+        self.num_workers = num_workers
+        self.sync_mode = True
+        self.store: Dict[Any, np.ndarray] = {}
+        self.state: Dict[Any, _KeyState] = {}
+        self.updater = None
+        self.gc: Optional[GradientCompression] = None
+        self.lock = threading.Condition()
+        self.stopped_workers = 0
+        self.listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listen.bind(("0.0.0.0", 0))
+        self.listen.listen(128)
+        self.addr = (socket.gethostbyname(socket.gethostname())
+                     if host not in ("127.0.0.1", "localhost")
+                     else "127.0.0.1", self.listen.getsockname()[1])
+        sched = _ps.connect_scheduler()
+        resp = sched.request({"op": "register_server", "addr": self.addr})
+        self.rank = resp["rank"]
+        self.sched = sched
+
+    def run(self):
+        """Accept one connection per worker and serve until every worker
+        says stop."""
+        threads = []
+        while True:
+            with self.lock:
+                if self.stopped_workers >= self.num_workers:
+                    break
+            self.listen.settimeout(0.2)
+            try:
+                conn, _ = self.listen.accept()
+            except socket.timeout:
+                continue
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=5)
+        self.sched.request({"op": "finalize"})
+        self.sched.close()
+        self.listen.close()
+
+    # -- request dispatch (ref: DataHandleEx, kvstore_dist_server.h:173)
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _ps.recv_msg(conn)
+                if msg is None:
+                    return
+                op = msg["op"]
+                if op == "init":
+                    with self.lock:
+                        if msg["key"] not in self.store or msg.get("force"):
+                            self.store[msg["key"]] = \
+                                np.array(msg["data"], copy=True)
+                            self.state.setdefault(msg["key"], _KeyState())
+                    _ps.send_msg(conn, {"ok": True})
+                elif op == "push":
+                    self._handle_push(msg)
+                    _ps.send_msg(conn, {"ok": True})
+                elif op == "pull":
+                    _ps.send_msg(conn, {"data": self._handle_pull(msg)})
+                elif op == "pull_rows":
+                    # ref: row-sparse handler, kvstore_dist_server.h:223
+                    data = self._handle_pull(msg)
+                    rows = np.asarray(msg["rows"], dtype=np.int64)
+                    _ps.send_msg(conn, {"data": data[rows], "rows": rows})
+                elif op == "set_optimizer":
+                    # ref: server cmd channel (kvstore_dist.h:102) +
+                    # python set_optimizer pickling the optimizer over
+                    with self.lock:
+                        from . import optimizer as _opt
+
+                        optimizer = pickle.loads(msg["payload"])
+                        self.updater = _opt.get_updater(optimizer)
+                    _ps.send_msg(conn, {"ok": True})
+                elif op == "set_sync":
+                    # ref: sync-mode command, kvstore_dist_server.h:154
+                    with self.lock:
+                        self.sync_mode = bool(msg["sync"])
+                    _ps.send_msg(conn, {"ok": True})
+                elif op == "set_compression":
+                    with self.lock:
+                        self.gc = GradientCompression(
+                            type=msg["type"],
+                            threshold=float(msg["threshold"]))
+                    _ps.send_msg(conn, {"ok": True})
+                elif op == "save_optimizer_states":
+                    with self.lock:
+                        blob = (self.updater.get_states(msg.get(
+                            "dump_optimizer", False))
+                            if self.updater else b"")
+                    _ps.send_msg(conn, {"data": blob})
+                elif op == "load_optimizer_states":
+                    with self.lock:
+                        if self.updater is None:
+                            _ps.send_msg(conn, {"ok": False,
+                                                "error": "no optimizer"})
+                        else:
+                            self.updater.set_states(msg["data"])
+                            _ps.send_msg(conn, {"ok": True})
+                elif op == "stop":
+                    with self.lock:
+                        self.stopped_workers += 1
+                        self.lock.notify_all()
+                    _ps.send_msg(conn, {"ok": True})
+                    return
+                else:
+                    _ps.send_msg(conn, {"error": "bad op %r" % op})
+        finally:
+            conn.close()
+
+    def _handle_push(self, msg):
+        key = msg["key"]
+        if msg.get("compressed"):
+            grad = self.gc.decompress(msg["data"], msg["shape"]) \
+                if self.gc else None
+            if grad is None:
+                raise RuntimeError("compressed push without "
+                                   "set_compression")
+        else:
+            grad = np.asarray(msg["data"])
+        with self.lock:
+            st = self.state.setdefault(key, _KeyState())
+            if not self.sync_mode:
+                # ref: dist_async — apply immediately, no barrier
+                # (kvstore_dist_server.h:266)
+                self._apply(key, grad)
+                st.applied += 1
+                self.lock.notify_all()
+                return
+            if st.agg is None:
+                st.agg = grad.astype(np.float32).copy()
+            else:
+                st.agg = st.agg + grad
+            st.workers.add(msg["worker"])
+            if len(st.workers) >= self.num_workers:
+                # ref: ApplyUpdates once NumWorkers parts arrived
+                # (kvstore_dist_server.h:187-189)
+                self._apply(key, st.agg)
+                st.agg = None
+                st.workers = set()
+                st.applied += 1
+                self.lock.notify_all()
+
+    def _apply(self, key, merged):
+        if self.updater is not None:
+            if key not in self.store:
+                raise RuntimeError("push before init on %r" % key)
+            stored = self.store[key]
+            self.updater_np(key, merged, stored)
+        else:
+            # no optimizer installed: store the aggregate
+            # (ref: merged.CopyTo(stored))
+            self.store[key] = np.asarray(merged, dtype=np.float32)
+
+    def updater_np(self, key, grad, stored):
+        """Run the python Updater over numpy views via NDArray wrappers."""
+        from .ndarray import NDArray, array
+
+        g = array(grad)
+        w = array(stored)
+        self.updater(int(key) if str(key).isdigit() else key, g, w)
+        self.store[key] = w.asnumpy()
+
+    def _handle_pull(self, msg):
+        key = msg["key"]
+        want = int(msg.get("round", 0))
+        with self.lock:
+            st = self.state.setdefault(key, _KeyState())
+            while self.sync_mode and st.applied < want:
+                self.lock.wait(timeout=30)
+            if key not in self.store:
+                raise RuntimeError("pull before init on %r" % key)
+            return self.store[key]
+
+
+def run_scheduler():
+    _, port, ns, nw = _ps.env_cluster()
+    _ps.Scheduler(port, ns, nw).run()
+
+
+def run_server():
+    KVStoreServer().run()
+
+
+def init():
+    """Role-based bootstrap: blocks forever in scheduler/server roles,
+    returns immediately for workers (ref: kvstore_server.py:28-73 —
+    importing mxnet in a server process runs the server loop)."""
+    role = _ps.env_role()
+    if role == "scheduler":
+        run_scheduler()
+        raise SystemExit(0)
+    if role == "server":
+        run_server()
+        raise SystemExit(0)

@@ -1,0 +1,127 @@
+"""Worker script for distributed kvstore tests — exact arithmetic
+identities on pushed/pulled values (model: tests/nightly/
+dist_sync_kvstore.py:29-60 in the reference). Launched by
+tools/launch.py via test_dist_kvstore.py; asserts crash the worker →
+nonzero exit → test failure."""
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_sync_push_pull(kv):
+    rank, nw = kv.rank, kv.num_workers
+    # each worker pushes rank+1; aggregate = sum(1..nw)
+    kv.init("a", nd.zeros((4, 4)))
+    kv.push("a", nd.ones((4, 4)) * (rank + 1))
+    out = nd.zeros((4, 4))
+    kv.pull("a", out=out)
+    want = sum(range(1, nw + 1))
+    np.testing.assert_allclose(out.asnumpy(), want)
+    # second round accumulates on the stored aggregate? no — without an
+    # optimizer the server *replaces* with each round's aggregate
+    kv.push("a", nd.ones((4, 4)) * 2 * (rank + 1))
+    kv.pull("a", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2 * want)
+
+
+def test_sync_optimizer(kv):
+    rank, nw = kv.rank, kv.num_workers
+    kv.init("w", nd.ones((2, 2)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                      rescale_grad=1.0 / nw))
+    # every worker pushes gradient nw → merged = nw*nw, rescaled = nw;
+    # sgd: w -= 0.1 * nw
+    kv.push("w", nd.ones((2, 2)) * nw)
+    out = nd.zeros((2, 2))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.1 * nw, rtol=1e-5)
+
+
+def test_optimizer_state_roundtrip(kv):
+    """Momentum must survive save/load across ALL server shards."""
+    import os
+    import tempfile
+
+    rank, nw = kv.rank, kv.num_workers
+    # several keys so that with 2 servers both shards hold state
+    for k in ("m0", "m1", "m2", "m3"):
+        kv.init(k, nd.ones((2,)))
+        kv.push(k, nd.ones((2,)) * nw)  # builds momentum state
+        out = nd.zeros((2,))
+        kv.pull(k, out=out)
+    kv.barrier()
+    if rank == 0:
+        fd, fname = tempfile.mkstemp()
+        os.close(fd)
+        kv.save_optimizer_states(fname)
+        kv.load_optimizer_states(fname)
+        os.unlink(fname)
+    kv.barrier()
+
+
+def test_row_sparse_pull(kv):
+    rank, nw = kv.rank, kv.num_workers
+    table = np.arange(20, dtype=np.float32).reshape(10, 2)
+    kv.init("emb", nd.array(table))
+    rows = np.array([1, 4, 7])
+    from mxnet_tpu.ndarray import sparse as sp
+    out = sp.zeros("row_sparse", (10, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array(rows))
+    dense = out.todense().asnumpy()
+    want = np.zeros_like(table)
+    want[rows] = table[rows]
+    np.testing.assert_allclose(dense, want)
+
+
+def test_gradient_compression(kv):
+    """Runs after set_optimizer, so the server-side SGD applies to the
+    decompressed aggregate (server updater is store-wide, like the
+    reference's)."""
+    rank, nw = kv.rank, kv.num_workers
+    kv.init("g", nd.zeros((8,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    # push 0.6: below threshold → round 1 decompresses to 0 everywhere,
+    # sgd leaves w at 0; residual 0.6 carries
+    kv.push("g", nd.ones((8,)) * 0.6)
+    out = nd.zeros((8,))
+    kv.pull("g", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.0, atol=1e-6)
+    # round 2: 0.6+0.6 ≥ 1.0 → each worker contributes +1.0; merged nw,
+    # rescale_grad=1/nw → grad 1.0 → w = 0 - 0.1
+    kv.push("g", nd.ones((8,)) * 0.6)
+    kv.pull("g", out=out)
+    np.testing.assert_allclose(out.asnumpy(), -0.1, atol=1e-6)
+
+
+def test_barrier(kv):
+    kv.barrier()
+    kv.barrier()
+
+
+def main():
+    kind = sys.argv[1] if len(sys.argv) > 1 else "dist_sync"
+    kv = mx.kv.create(kind)
+    assert kv.num_workers >= 1
+    if kind == "dist_sync":
+        test_sync_push_pull(kv)
+        test_sync_optimizer(kv)
+        test_optimizer_state_roundtrip(kv)
+        test_row_sparse_pull(kv)
+        test_gradient_compression(kv)
+        test_barrier(kv)
+    else:  # dist_async: eventual values — just check apply-immediately
+        kv.init("x", nd.zeros((2,)))
+        kv.push("x", nd.ones((2,)))
+        out = nd.zeros((2,))
+        kv.barrier()
+        kv.pull("x", out=out)
+        assert out.asnumpy().sum() > 0
+    kv.close()
+    print("worker %d OK" % kv.rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Cluster launcher for dist_sync/dist_async training.
+
+ref: tools/launch.py:30-80 (delegates to the dmlc-core tracker; the
+local launcher spawns scheduler+servers+workers as processes on one
+host — the mode tests/nightly/test_all.sh:55 uses). ssh/mpi/yarn modes
+are out of scope for the TPU build: multi-host TPU jobs launch through
+jax.distributed; this launcher covers the PS-compat path.
+
+Usage:
+    python tools/launch.py -n 2 [-s 1] python train.py --kv-store dist_sync
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(num_workers: int, num_servers: int, cmd, env=None,
+                 quiet_servers: bool = False):
+    """Spawn scheduler + servers + workers locally; returns the worker
+    exit codes. Server/scheduler processes are killed once all workers
+    exit (they block in their serve loops otherwise)."""
+    base_env = dict(os.environ)
+    if env:
+        base_env.update(env)
+    base_env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(_free_port()),
+        "DMLC_NUM_SERVER": str(num_servers),
+        "DMLC_NUM_WORKER": str(num_workers),
+    })
+
+    procs = []
+    daemon = []
+
+    def spawn(role, extra=None, keep_output=True):
+        e = dict(base_env)
+        e["DMLC_ROLE"] = role
+        e.update(extra or {})
+        if role == "worker":
+            argv = list(cmd)
+        else:
+            # scheduler/server: run the PS node loop, not the user script
+            argv = [sys.executable, "-c",
+                    "import mxnet_tpu.kvstore_server as s; s.init()"]
+        out = None if keep_output or not quiet_servers else \
+            subprocess.DEVNULL
+        return subprocess.Popen(argv, env=e, stdout=out, stderr=out)
+
+    daemon.append(spawn("scheduler", keep_output=False))
+    for _ in range(num_servers):
+        daemon.append(spawn("server", keep_output=False))
+    for i in range(num_workers):
+        procs.append(spawn("worker", {"DMLC_WORKER_ID": str(i)}))
+
+    codes = [p.wait() for p in procs]
+    for p in daemon:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.send_signal(signal.SIGTERM)
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return codes
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=None,
+                    help="defaults to num-workers (like the reference)")
+    ap.add_argument("--launcher", choices=["local"], default="local")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    ns = args.num_servers if args.num_servers is not None \
+        else args.num_workers
+    codes = launch_local(args.num_workers, ns, args.command)
+    sys.exit(max(codes) if codes else 0)
+
+
+if __name__ == "__main__":
+    main()
