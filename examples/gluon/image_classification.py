@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Gluon imperative training on the model zoo
+(ref: example/gluon/image_classification.py).
+
+    python examples/gluon/image_classification.py --model resnet18_v1 \
+        --dataset cifar10-synthetic --epochs 2
+"""
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def synthetic_loader(batch_size, num_classes=10, size=32, n=512):
+    """Class-colored blobs: learnable but download-free."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 3, size, size).astype(np.float32) * 0.1
+    y = rng.randint(0, num_classes, n)
+    for i in range(n):
+        c = y[i]
+        x[i, c % 3, (c // 3) * 8:(c // 3) * 8 + 8] += 0.8
+    ds = gluon.data.ArrayDataset(nd.array(x), nd.array(y.astype(np.float32)))
+    return gluon.data.DataLoader(ds, batch_size=batch_size, shuffle=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--hybridize", action="store_true", default=True)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = vision.get_model(args.model, classes=10)
+    net.initialize(mx.init.Xavier())
+    if args.hybridize:
+        net.hybridize()
+    loader = synthetic_loader(args.batch_size)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        n = 0
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+            n += data.shape[0]
+        name, acc = metric.get()
+        logging.info("epoch %d: %s=%.4f (%.1f samples/s)", epoch, name,
+                     acc, n / (time.time() - tic))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Inference throughput over the model zoo — the reference's speed table
+generator (ref: example/image-classification/benchmark_score.py, whose
+numbers are the README.md:149-156 baseline table).
+
+    python examples/image_classification/benchmark_score.py \
+        --network resnet18_v1 --batch-sizes 1,32
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def score(network, batch_size, num_batches=20, warmup=3):
+    net = vision.get_model(network)
+    net.initialize()
+    data = nd.random.uniform(shape=(batch_size, 3, 224, 224))
+    net.hybridize()
+    for _ in range(warmup):
+        net(data).wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        net(data).wait_to_read()
+    dt = time.time() - tic
+    return num_batches * batch_size / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet18_v1,resnet50_v1")
+    ap.add_argument("--batch-sizes", default="1,32")
+    ap.add_argument("--num-batches", type=int, default=20)
+    args = ap.parse_args()
+    for network in args.network.split(","):
+        for bs in (int(b) for b in args.batch_sizes.split(",")):
+            ips = score(network, bs, args.num_batches)
+            print("network: %s, batch %d: %.1f images/sec"
+                  % (network, bs, ips))
+
+
+if __name__ == "__main__":
+    main()

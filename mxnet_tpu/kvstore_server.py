@@ -28,11 +28,12 @@ __all__ = ["KVStoreServer", "run_scheduler", "run_server", "init"]
 
 
 class _KeyState:
-    __slots__ = ("agg", "workers", "applied")
+    __slots__ = ("agg", "parts", "pushed_by", "applied")
 
     def __init__(self):
         self.agg: Optional[np.ndarray] = None
-        self.workers = set()
+        self.parts = 0  # parts buffered toward the current round
+        self.pushed_by: Dict[int, int] = {}  # worker → total pushes
         self.applied = 0  # completed aggregation rounds
 
 
@@ -91,68 +92,81 @@ class KVStoreServer:
                 msg = _ps.recv_msg(conn)
                 if msg is None:
                     return
-                op = msg["op"]
-                if op == "init":
-                    with self.lock:
-                        if msg["key"] not in self.store or msg.get("force"):
-                            self.store[msg["key"]] = \
-                                np.array(msg["data"], copy=True)
-                            self.state.setdefault(msg["key"], _KeyState())
-                    _ps.send_msg(conn, {"ok": True})
-                elif op == "push":
-                    self._handle_push(msg)
-                    _ps.send_msg(conn, {"ok": True})
-                elif op == "pull":
-                    _ps.send_msg(conn, {"data": self._handle_pull(msg)})
-                elif op == "pull_rows":
-                    # ref: row-sparse handler, kvstore_dist_server.h:223
-                    data = self._handle_pull(msg)
-                    rows = np.asarray(msg["rows"], dtype=np.int64)
-                    _ps.send_msg(conn, {"data": data[rows], "rows": rows})
-                elif op == "set_optimizer":
-                    # ref: server cmd channel (kvstore_dist.h:102) +
-                    # python set_optimizer pickling the optimizer over
-                    with self.lock:
-                        from . import optimizer as _opt
-
-                        optimizer = pickle.loads(msg["payload"])
-                        self.updater = _opt.get_updater(optimizer)
-                    _ps.send_msg(conn, {"ok": True})
-                elif op == "set_sync":
-                    # ref: sync-mode command, kvstore_dist_server.h:154
-                    with self.lock:
-                        self.sync_mode = bool(msg["sync"])
-                    _ps.send_msg(conn, {"ok": True})
-                elif op == "set_compression":
-                    with self.lock:
-                        self.gc = GradientCompression(
-                            type=msg["type"],
-                            threshold=float(msg["threshold"]))
-                    _ps.send_msg(conn, {"ok": True})
-                elif op == "save_optimizer_states":
-                    with self.lock:
-                        blob = (self.updater.get_states(msg.get(
-                            "dump_optimizer", False))
-                            if self.updater else b"")
-                    _ps.send_msg(conn, {"data": blob})
-                elif op == "load_optimizer_states":
-                    with self.lock:
-                        if self.updater is None:
-                            _ps.send_msg(conn, {"ok": False,
-                                                "error": "no optimizer"})
-                        else:
-                            self.updater.set_states(msg["data"])
-                            _ps.send_msg(conn, {"ok": True})
-                elif op == "stop":
-                    with self.lock:
-                        self.stopped_workers += 1
-                        self.lock.notify_all()
-                    _ps.send_msg(conn, {"ok": True})
-                    return
-                else:
-                    _ps.send_msg(conn, {"error": "bad op %r" % op})
+                try:
+                    if self._dispatch(conn, msg):
+                        return
+                except (RuntimeError, ValueError, KeyError) as e:
+                    # handler errors go back as error frames; the
+                    # connection stays usable (a closed socket would
+                    # surface as an opaque 'connection lost' worker-side)
+                    _ps.send_msg(conn, {"error": "%s: %s"
+                                        % (type(e).__name__, e)})
         finally:
             conn.close()
+
+    def _dispatch(self, conn, msg) -> bool:
+        """Handle one request; returns True when the connection should
+        close (worker said stop)."""
+        op = msg["op"]
+        if op == "init":
+            with self.lock:
+                if msg["key"] not in self.store or msg.get("force"):
+                    self.store[msg["key"]] = np.array(msg["data"],
+                                                      copy=True)
+                    self.state.setdefault(msg["key"], _KeyState())
+            _ps.send_msg(conn, {"ok": True})
+        elif op == "push":
+            self._handle_push(msg)
+            _ps.send_msg(conn, {"ok": True})
+        elif op == "pull":
+            _ps.send_msg(conn, {"data": self._handle_pull(msg)})
+        elif op == "pull_rows":
+            # ref: row-sparse handler, kvstore_dist_server.h:223
+            data = self._handle_pull(msg)
+            rows = np.asarray(msg["rows"], dtype=np.int64)
+            _ps.send_msg(conn, {"data": data[rows], "rows": rows})
+        elif op == "set_optimizer":
+            # ref: server cmd channel (kvstore_dist.h:102) + python
+            # set_optimizer pickling the optimizer over
+            with self.lock:
+                from . import optimizer as _opt
+
+                optimizer = pickle.loads(msg["payload"])
+                self.updater = _opt.get_updater(optimizer)
+            _ps.send_msg(conn, {"ok": True})
+        elif op == "set_sync":
+            # ref: sync-mode command, kvstore_dist_server.h:154
+            with self.lock:
+                self.sync_mode = bool(msg["sync"])
+            _ps.send_msg(conn, {"ok": True})
+        elif op == "set_compression":
+            with self.lock:
+                self.gc = GradientCompression(
+                    type=msg["type"], threshold=float(msg["threshold"]))
+            _ps.send_msg(conn, {"ok": True})
+        elif op == "save_optimizer_states":
+            with self.lock:
+                blob = (self.updater.get_states(
+                    msg.get("dump_optimizer", False))
+                    if self.updater else b"")
+            _ps.send_msg(conn, {"data": blob})
+        elif op == "load_optimizer_states":
+            with self.lock:
+                if self.updater is None:
+                    _ps.send_msg(conn, {"ok": False,
+                                        "error": "no optimizer"})
+                else:
+                    self.updater.set_states(msg["data"])
+                    _ps.send_msg(conn, {"ok": True})
+        elif op == "stop":
+            with self.lock:
+                self.stopped_workers += 1
+                self.lock.notify_all()
+            _ps.send_msg(conn, {"ok": True})
+            return True
+        else:
+            _ps.send_msg(conn, {"error": "bad op %r" % op})
+        return False
 
     def _handle_push(self, msg):
         key = msg["key"]
@@ -162,10 +176,17 @@ class KVStoreServer:
             if grad is None:
                 raise RuntimeError("compressed push without "
                                    "set_compression")
+        elif msg.get("sparse"):
+            # row-sparse wire format: only touched rows travel
+            # (ref: EncodeRowSparseKey push, kvstore_dist.h:444)
+            grad = np.zeros(msg["shape"], np.float32)
+            grad[np.asarray(msg["rows"], np.int64)] = msg["data"]
         else:
             grad = np.asarray(msg["data"])
         with self.lock:
             st = self.state.setdefault(key, _KeyState())
+            w = int(msg["worker"])
+            st.pushed_by[w] = st.pushed_by.get(w, 0) + 1
             if not self.sync_mode:
                 # ref: dist_async — apply immediately, no barrier
                 # (kvstore_dist_server.h:266)
@@ -177,13 +198,15 @@ class KVStoreServer:
                 st.agg = grad.astype(np.float32).copy()
             else:
                 st.agg = st.agg + grad
-            st.workers.add(msg["worker"])
-            if len(st.workers) >= self.num_workers:
+            st.parts += 1
+            if st.parts >= self.num_workers:
                 # ref: ApplyUpdates once NumWorkers parts arrived
-                # (kvstore_dist_server.h:187-189)
+                # (kvstore_dist_server.h:187-189 — parts, not distinct
+                # workers, so an over-pushing worker rolls into the next
+                # round instead of double-counting inside one)
                 self._apply(key, st.agg)
                 st.agg = None
-                st.workers = set()
+                st.parts -= self.num_workers
                 st.applied += 1
                 self.lock.notify_all()
 
@@ -208,12 +231,22 @@ class KVStoreServer:
         self.store[key] = w.asnumpy()
 
     def _handle_pull(self, msg):
+        """Sync mode: a worker's pull blocks until every push it made has
+        been folded into an applied round — the ordering guarantee of the
+        reference's timestamped ZPush/ZPull (pull after push observes the
+        round's update)."""
         key = msg["key"]
-        want = int(msg.get("round", 0))
+        w = msg.get("worker")
         with self.lock:
             st = self.state.setdefault(key, _KeyState())
-            while self.sync_mode and st.applied < want:
-                self.lock.wait(timeout=30)
+            if self.sync_mode and w is not None:
+                want = st.pushed_by.get(int(w), 0)
+                while st.applied < want:
+                    if not self.lock.wait(timeout=60):
+                        raise RuntimeError(
+                            "sync pull timed out: key %r waits for round "
+                            "%d, applied %d (did every worker push?)"
+                            % (key, want, st.applied))
             if key not in self.store:
                 raise RuntimeError("pull before init on %r" % key)
             return self.store[key]

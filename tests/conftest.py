@@ -35,3 +35,8 @@ def _seed():
     np.random.seed(0)
     mx.random.seed(0)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: example-script smoke tests (subprocess, slower)")

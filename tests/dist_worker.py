@@ -76,6 +76,27 @@ def test_row_sparse_pull(kv):
     np.testing.assert_allclose(dense, want)
 
 
+def test_sparse_push(kv):
+    """Row-sparse gradients travel as rows, aggregate dense server-side
+    (runs after set_optimizer: SGD applies to the scattered rows)."""
+    from mxnet_tpu.ndarray import sparse as sp
+
+    rank, nw = kv.rank, kv.num_workers
+    kv.init("rs", nd.zeros((6, 2)))
+    rows = np.array([1, 4])
+    vals = np.full((2, 2), float(nw), np.float32)
+    grad = sp.row_sparse_array((nd.array(vals), nd.array(rows)),
+                               shape=(6, 2))
+    kv.push("rs", grad)
+    out = nd.zeros((6, 2))
+    kv.pull("rs", out=out)
+    o = out.asnumpy()
+    # merged = nw*nw on rows {1,4}, rescale 1/nw → grad nw... wait:
+    # each worker pushes nw → merged nw*nw → rescaled nw → w -= 0.1*nw
+    np.testing.assert_allclose(o[[1, 4]], -0.1 * nw, rtol=1e-5)
+    np.testing.assert_allclose(o[[0, 2, 3, 5]], 0.0, atol=1e-7)
+
+
 def test_gradient_compression(kv):
     """Runs after set_optimizer, so the server-side SGD applies to the
     decompressed aggregate (server updater is store-wide, like the
@@ -110,6 +131,7 @@ def main():
         test_sync_optimizer(kv)
         test_optimizer_state_roundtrip(kv)
         test_row_sparse_pull(kv)
+        test_sparse_push(kv)
         test_gradient_compression(kv)
         test_barrier(kv)
     else:  # dist_async: eventual values — just check apply-immediately

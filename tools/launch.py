@@ -66,7 +66,25 @@ def launch_local(num_workers: int, num_servers: int, cmd, env=None,
     for i in range(num_workers):
         procs.append(spawn("worker", {"DMLC_WORKER_ID": str(i)}))
 
-    codes = [p.wait() for p in procs]
+    # poll instead of blocking wait: one crashed worker would leave the
+    # others stuck in a barrier forever, hanging the launcher
+    import time
+
+    grace_until = None
+    while True:
+        codes = [p.poll() for p in procs]
+        if all(c is not None for c in codes):
+            break
+        if any(c is not None and c != 0 for c in codes):
+            if grace_until is None:
+                grace_until = time.time() + 15  # let healthy workers end
+            elif time.time() > grace_until:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                codes = [p.wait() for p in procs]
+                break
+        time.sleep(0.1)
     for p in daemon:
         try:
             p.wait(timeout=10)
