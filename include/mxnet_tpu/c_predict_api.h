@@ -1,0 +1,93 @@
+/*!
+ * C predict API — the inference-only deployment ABI.
+ *
+ * Function-for-function equivalent of the reference's
+ * include/mxnet/c_predict_api.h (MXPredCreate/MXPredForward/... flat C
+ * surface used by cpp-package and the amalgamation mobile builds).
+ * The TPU build backs it with an embedded CPython running the
+ * mxnet_tpu.cabi support module; handles are opaque PyObject pointers.
+ *
+ * All functions return 0 on success, -1 on failure; call
+ * MXGetLastError() for the message (thread-local, like the reference's
+ * error ring in src/c_api/c_api_error.cc).
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stdint.h>
+
+#ifndef MXNET_DLL
+#define MXNET_DLL
+#endif
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+/*! \brief Get the last error message (thread-local). */
+MXNET_DLL const char *MXGetLastError();
+
+/*!
+ * \brief Create a predictor from a symbol JSON and a parameter blob
+ *        (the prefix-0000.params container format).
+ * \param symbol_json_str   null-terminated symbol JSON
+ * \param param_bytes       parameter container bytes (may be NULL)
+ * \param param_size        byte length of param_bytes
+ * \param dev_type          1 = cpu, 2 = accelerator (tpu here)
+ * \param dev_id            device ordinal
+ * \param num_input_nodes   number of input keys
+ * \param input_keys        input names (e.g. {"data"})
+ * \param input_shape_indptr  CSR-style offsets into input_shape_data,
+ *                            length num_input_nodes + 1
+ * \param input_shape_data  concatenated input shapes
+ * \param out               resulting handle
+ */
+MXNET_DLL int MXPredCreate(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           PredictorHandle *out);
+
+/*! \brief MXPredCreate restricted to selected internal outputs. */
+MXNET_DLL int MXPredCreatePartialOut(const char *symbol_json_str,
+                                     const void *param_bytes,
+                                     int param_size, int dev_type,
+                                     int dev_id, mx_uint num_input_nodes,
+                                     const char **input_keys,
+                                     const mx_uint *input_shape_indptr,
+                                     const mx_uint *input_shape_data,
+                                     mx_uint num_output_nodes,
+                                     const char **output_keys,
+                                     PredictorHandle *out);
+
+/*! \brief Shape of output `index`; pointer valid until next call. */
+MXNET_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint **shape_data,
+                                   mx_uint *shape_ndim);
+
+/*! \brief Copy `size` floats into input `key`. */
+MXNET_DLL int MXPredSetInput(PredictorHandle handle, const char *key,
+                             const mx_float *data, mx_uint size);
+
+/*! \brief Run the forward pass. */
+MXNET_DLL int MXPredForward(PredictorHandle handle);
+
+/*! \brief Copy output `index` into `data` (`size` floats). */
+MXNET_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              mx_float *data, mx_uint size);
+
+/*! \brief Free the predictor. */
+MXNET_DLL int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

@@ -1,0 +1,125 @@
+"""Python support layer for the embedded C predict ABI.
+
+ref: src/c_api/c_predict_api.cc (the inference-only deployment surface,
+include/mxnet/c_predict_api.h). The C shim (native/c_predict_api.cc)
+embeds CPython and calls into this module; everything stateful lives
+here so the C side is a thin marshalling layer.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .base import MXNetError
+from .context import cpu, tpu, num_tpus
+from .executor import Executor
+from .ndarray import NDArray
+from .ndarray.utils import load_frombuffer
+from .symbol import load_json
+
+__all__ = ["Predictor", "create_predictor"]
+
+
+def _device(dev_type: int, dev_id: int):
+    # reference dev_type codes: 1 = cpu, 2 = gpu (c_predict_api.h);
+    # the TPU build maps 2 → tpu when one is attached
+    if dev_type == 2 and num_tpus() > 0:
+        return tpu(dev_id)
+    return cpu(dev_id)
+
+
+class Predictor:
+    """One bound inference executor (ref: c_predict_api.cc PredictorObj:
+    symbol + executor + per-key input/output arrays)."""
+
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 dev_type: int, dev_id: int,
+                 input_shapes: Dict[str, tuple],
+                 output_keys: Optional[List[str]] = None):
+        self.symbol = load_json(symbol_json)
+        if output_keys:
+            outs = self.symbol.get_internals()
+            names = outs.list_outputs()
+            picked = []
+            for k in output_keys:
+                want = k if k.endswith("_output") else k + "_output"
+                if want not in names:
+                    raise MXNetError("output %r not found" % k)
+                picked.append(outs[names.index(want)])
+            from .symbol.symbol import Group
+
+            self.symbol = Group(picked) if len(picked) > 1 else picked[0]
+        arg_params: Dict[str, NDArray] = {}
+        aux_params: Dict[str, NDArray] = {}
+        if param_bytes:
+            from .model import split_param_dict
+
+            arg_params, aux_params = split_param_dict(
+                load_frombuffer(bytes(param_bytes)))
+        self.ctx = _device(dev_type, dev_id)
+        shapes = {k: tuple(int(d) for d in v)
+                  for k, v in input_shapes.items()}
+        self.input_names = list(shapes)
+        exe = Executor.simple_bind(self.symbol, ctx=self.ctx,
+                                   grad_req="null", **shapes)
+        for name, arr in arg_params.items():
+            if name in exe.arg_dict:
+                arr.copyto(exe.arg_dict[name])
+        for name, arr in aux_params.items():
+            if name in exe.aux_dict:
+                arr.copyto(exe.aux_dict[name])
+        # label arguments (SoftmaxOutput et al.) are not parameters:
+        # inference leaves them zero, like the reference predictor
+        missing = [n for n in exe.arg_dict
+                   if n not in arg_params and n not in shapes
+                   and not n.endswith("label")]
+        if param_bytes and missing:
+            raise MXNetError("missing parameters in param blob: %s"
+                             % missing)
+        self.exe = exe
+        self.outputs: List[np.ndarray] = []
+
+    def set_input(self, key: str, data: np.ndarray) -> None:
+        """ref: MXPredSetInput — copies a float32 buffer in."""
+        if key not in self.exe.arg_dict:
+            raise MXNetError("unknown input %r" % key)
+        dst = self.exe.arg_dict[key]
+        src = np.asarray(data, dtype=np.float32).reshape(dst.shape)
+        dst[:] = src
+
+    def forward(self) -> None:
+        """ref: MXPredForward."""
+        self.outputs = [o.asnumpy() for o in self.exe.forward()]
+
+    def get_output_shape(self, index: int) -> tuple:
+        """ref: MXPredGetOutputShape (works pre-forward via inference)."""
+        if self.outputs:
+            return tuple(self.outputs[index].shape)
+        from .symbol.infer import infer_shape
+
+        shapes = {k: self.exe.arg_dict[k].shape for k in self.input_names}
+        _, out_shapes, _ = infer_shape(self.symbol, **shapes)
+        return tuple(out_shapes[index])
+
+    def get_output(self, index: int) -> np.ndarray:
+        """ref: MXPredGetOutput — float32 copy out."""
+        if not self.outputs:
+            raise MXNetError("call forward before get_output")
+        return np.ascontiguousarray(self.outputs[index],
+                                    dtype=np.float32)
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self.symbol.list_outputs())
+
+
+def create_predictor(symbol_json, param_bytes, dev_type, dev_id,
+                     keys, indptr, shape_data, output_keys=None):
+    """Flat-argument constructor matching the C calling convention
+    (ref: MXPredCreate's input_shape_indptr/input_shape_data layout)."""
+    shapes = {}
+    for i, key in enumerate(keys):
+        shapes[key] = tuple(shape_data[indptr[i]:indptr[i + 1]])
+    return Predictor(symbol_json, param_bytes, dev_type, dev_id, shapes,
+                     output_keys=output_keys)

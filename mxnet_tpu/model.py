@@ -29,12 +29,9 @@ def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict[str, NDArr
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
-def load_checkpoint(prefix: str, epoch: int):
-    """ref: model.py:396 load_checkpoint."""
-    from .symbol import load as sym_load
-
-    symbol = sym_load("%s-symbol.json" % prefix)
-    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+def split_param_dict(save_dict):
+    """Split a params-container dict on the ``arg:``/``aux:`` key prefix
+    convention (the prefix-####.params format) → (arg, aux) dicts."""
     arg_params, aux_params = {}, {}
     for k, v in save_dict.items():
         tp, name = k.split(":", 1)
@@ -42,6 +39,16 @@ def load_checkpoint(prefix: str, epoch: int):
             arg_params[name] = v
         elif tp == "aux":
             aux_params[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """ref: model.py:396 load_checkpoint."""
+    from .symbol import load as sym_load
+
+    symbol = sym_load("%s-symbol.json" % prefix)
+    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = split_param_dict(save_dict)
     return symbol, arg_params, aux_params
 
 

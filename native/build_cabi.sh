@@ -1,0 +1,10 @@
+#!/bin/sh
+# Build libmxnet_tpu.so — the embedded-python C predict ABI
+# (ref: the reference ships these entry points inside libmxnet.so).
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -shared -fPIC -std=c++17 c_predict_api.cc \
+    $(python3-config --includes) \
+    $(python3-config --ldflags --embed) \
+    -o libmxnet_tpu.so
+echo "built $(pwd)/libmxnet_tpu.so"

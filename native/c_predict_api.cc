@@ -1,0 +1,258 @@
+/* C predict ABI over an embedded CPython.
+ *
+ * ref: src/c_api/c_predict_api.cc — the reference backs these entry
+ * points with its C++ executor; here the TPU runtime is jax, so the
+ * shim embeds the interpreter once, imports mxnet_tpu.cabi, and
+ * marshals buffers across. Handles are PyObject* to cabi.Predictor.
+ * Error handling mirrors src/c_api/c_api_error.cc: thread-local string
+ * + MXGetLastError.
+ *
+ * Build (see native/build_cabi.sh):
+ *   g++ -shared -fPIC c_predict_api.cc $(python3-config --includes)
+ *       $(python3-config --ldflags --embed) -o libmxnet_tpu.so
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+#define MXNET_DLL __attribute__((visibility("default")))
+
+static thread_local std::string g_last_error;
+static thread_local std::vector<mx_uint> g_shape_buf;
+
+extern "C" MXNET_DLL const char *MXGetLastError() {
+  return g_last_error.c_str();
+}
+
+namespace {
+
+std::once_flag g_py_once;
+
+void EnsurePython() {
+  std::call_once(g_py_once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by Py_Initialize so PyGILState works
+      // from any caller thread; the interpreter lives until process
+      // exit (finalizing would invalidate outstanding handles)
+      PyEval_SaveThread();
+    }
+  });
+}
+
+// RAII GIL acquisition for every entry point
+struct Gil {
+  PyGILState_STATE st;
+  Gil() {
+    EnsurePython();
+    st = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+int Fail(const char *where) {
+  std::string msg = where;
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) {
+        msg += ": ";
+        msg += c;
+      } else {
+        PyErr_Clear();  // undecodable message: don't leave it pending
+        msg += ": <unprintable python error>";
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  g_last_error = msg;
+  return -1;
+}
+
+PyObject *CabiModule() {
+  return PyImport_ImportModule("mxnet_tpu.cabi");
+}
+
+int CreateImpl(const char *symbol_json_str, const void *param_bytes,
+               int param_size, int dev_type, int dev_id,
+               mx_uint num_input_nodes, const char **input_keys,
+               const mx_uint *input_shape_indptr,
+               const mx_uint *input_shape_data, mx_uint num_output_nodes,
+               const char **output_keys, PredictorHandle *out) {
+  Gil gil;
+  PyObject *mod = CabiModule();
+  if (!mod) return Fail("import mxnet_tpu.cabi");
+  PyObject *fn = PyObject_GetAttrString(mod, "create_predictor");
+  Py_DECREF(mod);
+  if (!fn) return Fail("create_predictor missing");
+
+  PyObject *keys = PyList_New(num_input_nodes);
+  PyObject *indptr = PyList_New(num_input_nodes + 1);
+  for (mx_uint i = 0; i < num_input_nodes; ++i)
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+  for (mx_uint i = 0; i <= num_input_nodes; ++i)
+    PyList_SetItem(indptr, i,
+                   PyLong_FromUnsignedLong(input_shape_indptr[i]));
+  mx_uint ndata = input_shape_indptr[num_input_nodes];
+  PyObject *shapes = PyList_New(ndata);
+  for (mx_uint i = 0; i < ndata; ++i)
+    PyList_SetItem(shapes, i,
+                   PyLong_FromUnsignedLong(input_shape_data[i]));
+  PyObject *params =
+      PyBytes_FromStringAndSize(static_cast<const char *>(param_bytes),
+                                param_bytes ? param_size : 0);
+  PyObject *outs = Py_None;
+  Py_INCREF(Py_None);
+  if (num_output_nodes > 0) {
+    Py_DECREF(outs);
+    outs = PyList_New(num_output_nodes);
+    for (mx_uint i = 0; i < num_output_nodes; ++i)
+      PyList_SetItem(outs, i, PyUnicode_FromString(output_keys[i]));
+  }
+
+  PyObject *pred = PyObject_CallFunction(
+      fn, "sOiiOOOO", symbol_json_str, params, dev_type, dev_id, keys,
+      indptr, shapes, outs);
+  Py_DECREF(fn);
+  Py_DECREF(keys);
+  Py_DECREF(indptr);
+  Py_DECREF(shapes);
+  Py_DECREF(params);
+  Py_DECREF(outs);
+  if (!pred) return Fail("MXPredCreate");
+  *out = pred;  // ownership transferred to the handle
+  return 0;
+}
+
+}  // namespace
+
+extern "C" MXNET_DLL int MXPredCreate(
+    const char *symbol_json_str, const void *param_bytes, int param_size,
+    int dev_type, int dev_id, mx_uint num_input_nodes,
+    const char **input_keys, const mx_uint *input_shape_indptr,
+    const mx_uint *input_shape_data, PredictorHandle *out) {
+  return CreateImpl(symbol_json_str, param_bytes, param_size, dev_type,
+                    dev_id, num_input_nodes, input_keys,
+                    input_shape_indptr, input_shape_data, 0, nullptr,
+                    out);
+}
+
+extern "C" MXNET_DLL int MXPredCreatePartialOut(
+    const char *symbol_json_str, const void *param_bytes, int param_size,
+    int dev_type, int dev_id, mx_uint num_input_nodes,
+    const char **input_keys, const mx_uint *input_shape_indptr,
+    const mx_uint *input_shape_data, mx_uint num_output_nodes,
+    const char **output_keys, PredictorHandle *out) {
+  return CreateImpl(symbol_json_str, param_bytes, param_size, dev_type,
+                    dev_id, num_input_nodes, input_keys,
+                    input_shape_indptr, input_shape_data,
+                    num_output_nodes, output_keys, out);
+}
+
+extern "C" MXNET_DLL int MXPredGetOutputShape(PredictorHandle handle,
+                                              mx_uint index,
+                                              mx_uint **shape_data,
+                                              mx_uint *shape_ndim) {
+  Gil gil;
+  PyObject *pred = static_cast<PyObject *>(handle);
+  PyObject *shape = PyObject_CallMethod(pred, "get_output_shape", "I",
+                                        index);
+  if (!shape) return Fail("MXPredGetOutputShape");
+  Py_ssize_t n = PyTuple_Size(shape);
+  g_shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    g_shape_buf[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(shape, i)));
+  Py_DECREF(shape);
+  *shape_data = g_shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+extern "C" MXNET_DLL int MXPredSetInput(PredictorHandle handle,
+                                        const char *key,
+                                        const mx_float *data,
+                                        mx_uint size) {
+  Gil gil;
+  PyObject *pred = static_cast<PyObject *>(handle);
+  // zero-copy view: set_input copies into the executor array before this
+  // call returns, so the caller's buffer lifetime suffices
+  PyObject *view = PyMemoryView_FromMemory(
+      const_cast<char *>(reinterpret_cast<const char *>(data)),
+      static_cast<Py_ssize_t>(size) * sizeof(mx_float), PyBUF_READ);
+  if (!view) return Fail("MXPredSetInput view");
+  PyObject *np = PyImport_ImportModule("numpy");
+  PyObject *arr = nullptr;
+  if (np) {
+    PyObject *frombuffer = PyObject_GetAttrString(np, "frombuffer");
+    if (frombuffer) {
+      arr = PyObject_CallFunction(frombuffer, "Os", view, "float32");
+      Py_DECREF(frombuffer);
+    }
+    Py_DECREF(np);
+  }
+  Py_DECREF(view);
+  if (!arr) return Fail("MXPredSetInput frombuffer");
+  PyObject *r = PyObject_CallMethod(pred, "set_input", "sO", key, arr);
+  Py_DECREF(arr);
+  if (!r) return Fail("MXPredSetInput");
+  Py_DECREF(r);
+  return 0;
+}
+
+extern "C" MXNET_DLL int MXPredForward(PredictorHandle handle) {
+  Gil gil;
+  PyObject *pred = static_cast<PyObject *>(handle);
+  PyObject *r = PyObject_CallMethod(pred, "forward", nullptr);
+  if (!r) return Fail("MXPredForward");
+  Py_DECREF(r);
+  return 0;
+}
+
+extern "C" MXNET_DLL int MXPredGetOutput(PredictorHandle handle,
+                                         mx_uint index, mx_float *data,
+                                         mx_uint size) {
+  Gil gil;
+  PyObject *pred = static_cast<PyObject *>(handle);
+  PyObject *arr = PyObject_CallMethod(pred, "get_output", "I", index);
+  if (!arr) return Fail("MXPredGetOutput");
+  PyObject *tobytes = PyObject_CallMethod(arr, "tobytes", nullptr);
+  Py_DECREF(arr);
+  if (!tobytes) return Fail("MXPredGetOutput tobytes");
+  char *buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(tobytes, &buf, &n) != 0) {
+    Py_DECREF(tobytes);
+    return Fail("MXPredGetOutput buffer");
+  }
+  if (static_cast<size_t>(n) != size * sizeof(mx_float)) {
+    Py_DECREF(tobytes);
+    g_last_error = "MXPredGetOutput: size mismatch (got " +
+                   std::to_string(n / sizeof(mx_float)) + " floats, want " +
+                   std::to_string(size) + ")";
+    return -1;
+  }
+  std::memcpy(data, buf, n);
+  Py_DECREF(tobytes);
+  return 0;
+}
+
+extern "C" MXNET_DLL int MXPredFree(PredictorHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}

@@ -1,0 +1,104 @@
+/* End-to-end C client of the predict ABI: loads a checkpoint written by
+ * the python side, runs a forward pass, prints the outputs.
+ * Mirrors the reference's image-classification/predict-cpp usage of
+ * c_predict_api.h. Driven by tests/test_cabi.py. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../include/mxnet_tpu/c_predict_api.h"
+
+static char *read_file(const char *path, long *size_out) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(n + 1);
+  if (fread(buf, 1, n, f) != (size_t)n) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  fclose(f);
+  buf[n] = 0;
+  if (size_out) *size_out = n;
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s symbol.json params.bin input.bin\n",
+            argv[0]);
+    return 2;
+  }
+  long param_size = 0, input_size = 0;
+  char *symbol_json = read_file(argv[1], NULL);
+  char *params = read_file(argv[2], &param_size);
+  char *input = read_file(argv[3], &input_size);
+  if (!symbol_json || !params || !input) {
+    fprintf(stderr, "cannot read inputs\n");
+    return 2;
+  }
+  mx_uint n_floats = (mx_uint)(input_size / sizeof(mx_float));
+
+  const char *input_keys[] = {"data"};
+  /* batch of 4 vectors of dim n_floats/4 */
+  mx_uint indptr[] = {0, 2};
+  mx_uint shape[] = {4, n_floats / 4};
+
+  PredictorHandle pred = NULL;
+  if (MXPredCreate(symbol_json, params, (int)param_size, 1, 0, 1,
+                   input_keys, indptr, shape, &pred) != 0) {
+    fprintf(stderr, "MXPredCreate failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint *oshape = NULL, ondim = 0;
+  if (MXPredGetOutputShape(pred, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "GetOutputShape failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint osize = 1;
+  printf("output shape: ");
+  for (mx_uint i = 0; i < ondim; ++i) {
+    printf("%u ", oshape[i]);
+    osize *= oshape[i];
+  }
+  printf("\n");
+
+  if (MXPredSetInput(pred, "data", (mx_float *)input, n_floats) != 0) {
+    fprintf(stderr, "SetInput failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  if (MXPredForward(pred) != 0) {
+    fprintf(stderr, "Forward failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_float *out = (mx_float *)malloc(osize * sizeof(mx_float));
+  if (MXPredGetOutput(pred, 0, out, osize) != 0) {
+    fprintf(stderr, "GetOutput failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  printf("output:");
+  for (mx_uint i = 0; i < osize && i < 16; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+
+  /* error path must report, not crash */
+  if (MXPredSetInput(pred, "not_an_input", (mx_float *)input, 1) == 0) {
+    fprintf(stderr, "expected failure on bad input key\n");
+    return 1;
+  }
+  if (strlen(MXGetLastError()) == 0) {
+    fprintf(stderr, "empty error message\n");
+    return 1;
+  }
+
+  MXPredFree(pred);
+  free(out);
+  free(symbol_json);
+  free(params);
+  free(input);
+  printf("C ABI OK\n");
+  return 0;
+}
