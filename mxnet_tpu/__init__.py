@@ -52,6 +52,14 @@ from . import module as mod
 from . import gluon
 from . import rnn
 from . import operator
+from . import name
+from . import engine
+from . import rtc
+from . import text
+from . import contrib
+from . import test_utils
+# mx.torch (pytorch interop) stays import-on-demand: importing torch is
+# slow and most sessions never touch the bridge
 from .initializer import Xavier, Uniform, Normal
 from .model import save_checkpoint, load_checkpoint, FeedForward
 

@@ -1,0 +1,9 @@
+"""mx.contrib — experimental namespaces
+(ref: python/mxnet/contrib/__init__.py: autograd, ndarray, symbol,
+tensorboard)."""
+from . import autograd  # noqa: F401
+from . import tensorboard  # noqa: F401
+
+# contrib op namespaces are the generated sub-namespaces on nd/sym
+from ..ndarray import contrib as ndarray  # noqa: F401
+from ..symbol import contrib as symbol  # noqa: F401

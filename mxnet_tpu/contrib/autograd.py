@@ -1,0 +1,85 @@
+"""Legacy v1 autograd API (ref: python/mxnet/contrib/autograd.py —
+the pre-`mx.autograd` surface kept for old scripts). Thin forwarders
+over the modern tape."""
+from __future__ import annotations
+
+from .. import autograd as _ag
+from ..ndarray import NDArray
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient", "grad",
+           "grad_and_loss"]
+
+
+def set_is_training(is_train: bool):
+    """ref: contrib/autograd.py set_is_training — returns previous."""
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    _ag.set_recording(is_train)
+    return prev
+
+
+class train_section:
+    """`with train_section():` (ref: contrib/autograd.py TrainingStateScope)."""
+
+    def __enter__(self):
+        self._scope = _ag.record()
+        return self._scope.__enter__()
+
+    def __exit__(self, *exc):
+        return self._scope.__exit__(*exc)
+
+
+class test_section:
+    def __enter__(self):
+        self._scope = _ag.pause()
+        return self._scope.__enter__()
+
+    def __exit__(self, *exc):
+        return self._scope.__exit__(*exc)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    _ag.backward(outputs, head_grads=out_grads,
+                 retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """ref: contrib/autograd.py compute_gradient."""
+    backward(outputs)
+    return [getattr(o, "grad", None) for o in outputs]
+
+
+def grad_and_loss(func, argnum=None):
+    """Return fn computing (gradients, loss) (ref:
+    contrib/autograd.py grad_and_loss)."""
+
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            idx = argnum if isinstance(argnum, list) else [argnum]
+            variables = [args[i] for i in idx]
+        for x in variables:
+            assert isinstance(x, NDArray)
+            x.attach_grad()
+        with _ag.record():
+            outputs = func(*args)
+        _ag.backward([outputs] if isinstance(outputs, NDArray)
+                     else outputs)
+        return [x.grad for x in variables], outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """ref: contrib/autograd.py grad."""
+    fn = grad_and_loss(func, argnum)
+
+    def only_grad(*args):
+        return fn(*args)[0]
+
+    return only_grad

@@ -1,0 +1,46 @@
+"""TensorBoard logging bridge (ref: python/mxnet/contrib/tensorboard.py
+LogMetricsCallback — pushes fit-loop metrics into a SummaryWriter).
+
+Gated: works with any module exposing the SummaryWriter API
+(tensorboardX / torch.utils.tensorboard); raises a clear error if
+neither is installed.
+"""
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+def _summary_writer(logging_dir):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:
+        from tensorboardX import SummaryWriter
+
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        raise ImportError(
+            "LogMetricsCallback requires a SummaryWriter provider "
+            "(torch.utils.tensorboard or tensorboardX)")
+
+
+class LogMetricsCallback(object):
+    """Batch-end callback streaming eval metrics to TensorBoard
+    (ref: contrib/tensorboard.py LogMetricsCallback)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _summary_writer(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

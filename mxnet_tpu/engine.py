@@ -1,0 +1,35 @@
+"""Engine control API compat (ref: python/mxnet/engine.py set_bulk_size:26,
+bulk context manager).
+
+The reference's dependency engine batches small ops into bulk segments
+(MXNET_EXEC_BULK_EXEC_*, threaded_engine.h:386-458). Under XLA every
+jitted program is already one fused "bulk segment", so these knobs are
+accepted and recorded but change nothing — kept so reference tuning
+code runs unmodified.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+__all__ = ["set_bulk_size", "bulk"]
+
+_bulk_size = 15  # the reference default
+
+
+def set_bulk_size(size: int) -> int:
+    """Set the bulk-execution segment limit; returns the previous value
+    (ref: engine.py:26). No-op on XLA — fusion is the compiler's job."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = int(size)
+    return prev
+
+
+@contextmanager
+def bulk(size: int):
+    """Scope form (ref: engine.py bulk)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,0 +1,58 @@
+"""Automatic symbol naming (ref: python/mxnet/name.py NameManager:22,
+Prefix:74). `with mx.name.Prefix("net_"):` prefixes every auto-generated
+op name inside the scope."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["NameManager", "Prefix"]
+
+_local = threading.local()
+
+
+class NameManager:
+    """Scope manager assigning default names to symbols
+    (ref: name.py:22)."""
+
+    def __init__(self):
+        self._counter: Dict[str, int] = {}
+        self._old: Optional["NameManager"] = None
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        if name is not None:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self) -> "NameManager":
+        self._old = current()
+        _local.manager = self
+        return self
+
+    def __exit__(self, *exc):
+        _local.manager = self._old
+        return False
+
+
+class Prefix(NameManager):
+    """Prepend a prefix to every auto name (ref: name.py:74)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+def current() -> NameManager:
+    mgr = getattr(_local, "manager", None)
+    if mgr is None:
+        mgr = NameManager()
+        _local.manager = mgr
+    return mgr

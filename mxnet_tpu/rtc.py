@@ -1,0 +1,85 @@
+"""Runtime kernel compilation — the TPU answer to mx.rtc.
+
+ref: python/mxnet/rtc.py CudaModule:42 (NVRTC-compiled CUDA strings,
+get_kernel(name, signature).launch(args, ctx, grid, block)). On TPU the
+user-supplied kernel is a **Pallas** function instead of CUDA C: the
+same register-then-launch workflow, compiled by Mosaic onto the
+MXU/VPU rather than by NVRTC onto SMs (see
+/opt/skills/guides/pallas_guide.md for the kernel model).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["PallasModule", "CudaModule"]
+
+
+class PallasKernel:
+    """One launchable kernel (ref: rtc.py CudaKernel)."""
+
+    def __init__(self, jitted: Callable, name: str):
+        self._jitted = jitted
+        self._name = name
+
+    def launch(self, args: Sequence, ctx=None):
+        """Run the kernel on NDArray/scalar args → list of NDArrays.
+
+        Unlike the CUDA launch there are no grid/block dims here: the
+        Pallas grid and block specs live inside the kernel function
+        itself (static shapes let Mosaic tile for the hardware), and
+        jax.jit caches one executable per argument signature."""
+        from .context import current_context
+
+        raw = [a._data if isinstance(a, NDArray) else a for a in args]
+        outs = self._jitted(*raw)
+        ctx = ctx or current_context()
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return [NDArray.from_raw(o, ctx) for o in outs]
+
+
+class PallasModule:
+    """Register Pallas kernels by name and launch them on NDArrays —
+    the CudaModule workflow with Mosaic as the compiler
+    (ref: rtc.py:42)."""
+
+    def __init__(self, kernels=None, exports=()):
+        self._kernels = {}
+        self.exports = []
+        for name, fn in dict(kernels or {}).items():
+            self.add_kernel(name, fn)
+        if exports:
+            self.exports = list(exports)
+
+    def add_kernel(self, name: str, fn: Callable) -> None:
+        import jax
+
+        # one jitted callable per registered kernel; jit handles the
+        # per-signature executable cache
+        self._kernels[name] = PallasKernel(jax.jit(fn), name)
+        if name not in self.exports:
+            self.exports.append(name)
+
+    def get_kernel(self, name: str, signature: str = "") -> PallasKernel:
+        """`signature` is accepted for API parity with CudaModule but
+        unused: Pallas kernels are typed by their traced arguments."""
+        if name not in self._kernels:
+            raise MXNetError("kernel %r not found (have: %s)"
+                             % (name, sorted(self._kernels)))
+        return self._kernels[name]
+
+
+class CudaModule:
+    """CUDA strings do not compile for TPUs. Kept so reference code
+    importing mx.rtc fails with a clear message pointing at the
+    PallasModule equivalent (ref: rtc.py:42)."""
+
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(
+            "CudaModule (NVRTC) is CUDA-only; this is the TPU build. "
+            "Write the kernel as a Pallas function and use "
+            "mx.rtc.PallasModule — same register/get_kernel/launch "
+            "workflow, compiled by Mosaic for the MXU/VPU.")

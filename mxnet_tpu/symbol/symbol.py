@@ -23,17 +23,6 @@ from ..ops import registry as _op_registry
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
 
-_NAME_LOCK = threading.Lock()
-_NAME_COUNTERS: Dict[str, int] = {}
-
-
-def _auto_name(prefix: str) -> str:
-    with _NAME_LOCK:
-        idx = _NAME_COUNTERS.get(prefix, 0)
-        _NAME_COUNTERS[prefix] = idx + 1
-    return "%s%d" % (prefix, idx)
-
-
 class AttrScope:
     """``with mx.AttrScope(ctx_group='dev1'):`` — attribute injection used by
     model parallelism (ref: python/mxnet/attribute.py; PlaceDevice pass
@@ -345,7 +334,14 @@ def create(op_name: str, *args, name: Optional[str] = None, **kwargs) -> Symbol:
         attrs.update({"__" + k + "__" if not k.startswith("__") else k: v
                       for k, v in scope_attrs.items()})
 
-    base = name or _auto_name(op.name.lower().lstrip("_") + "")
+    from .. import name as _name_mod
+
+    # all naming (auto and explicit) routes through the active
+    # NameManager: a fresh `with NameManager():` scope restarts the
+    # counters, and Prefix prefixes explicit names too (ref: name.py:22
+    # NameManager.get / :74 Prefix.get semantics)
+    hint = op.name.lower().lstrip("_")
+    base = _name_mod.current().get(name, hint)
 
     # positional symbol inputs
     pos_syms = [a for a in args if isinstance(a, Symbol)]

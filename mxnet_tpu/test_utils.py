@@ -123,3 +123,127 @@ def check_consistency(
 
 def same(a, b) -> bool:
     return np.array_equal(_as_np(a), _as_np(b))
+
+
+def _locations_to_dict(sym, location):
+    names = sym.list_arguments()
+    if isinstance(location, dict):
+        return dict(location)
+    return dict(zip(names, location))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None) -> List[NDArray]:
+    """Bind the symbol, run forward, compare each output against golden
+    numpy arrays (ref: test_utils.py:926 check_symbolic_forward)."""
+    ctx = ctx or current_context()
+    loc = _locations_to_dict(sym, location)
+    shapes = {k: np.asarray(v).shape for k, v in loc.items()}
+    exe = sym.simple_bind(ctx=ctx, grad_req="null", **shapes)
+    for k, v in loc.items():
+        exe.arg_dict[k][:] = np.asarray(v)
+    if aux_states:
+        for k, v in aux_states.items():
+            exe.aux_dict[k][:] = np.asarray(v)
+    outputs = exe.forward(is_train=False)
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    for out, want in zip(outputs, expected):
+        assert_almost_equal(out, want, rtol=rtol, atol=atol,
+                            names=("forward", "expected"))
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected,
+                            rtol=1e-5, atol=None, aux_states=None,
+                            grad_req="write", ctx=None) -> Dict[str, NDArray]:
+    """Bind, run forward+backward with the given output cotangents,
+    compare input gradients against golden numpy arrays
+    (ref: test_utils.py:1000 check_symbolic_backward)."""
+    ctx = ctx or current_context()
+    loc = _locations_to_dict(sym, location)
+    shapes = {k: np.asarray(v).shape for k, v in loc.items()}
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    req = {k: (grad_req if isinstance(grad_req, str)
+               else grad_req.get(k, "write")) for k in shapes}
+    for k in req:
+        if k not in expected:
+            req[k] = "null"
+    exe = sym.simple_bind(ctx=ctx, grad_req=req, **shapes)
+    for k, v in loc.items():
+        exe.arg_dict[k][:] = np.asarray(v)
+    if aux_states:
+        for k, v in aux_states.items():
+            exe.aux_dict[k][:] = np.asarray(v)
+    exe.forward(is_train=True)
+    if not isinstance(out_grads, (list, tuple)):
+        out_grads = [out_grads]
+    exe.backward(out_grads=[nd.array(np.asarray(g), ctx=ctx)
+                            for g in out_grads])
+    for k, want in expected.items():
+        assert_almost_equal(exe.grad_dict[k], want, rtol=rtol, atol=atol,
+                            names=("grad[%s]" % k, "expected"))
+    return exe.grad_dict
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    """ref: test_utils.py rand_shape_2d."""
+    return (np.random.randint(1, dim0 + 1),
+            np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1),
+            np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        ctx=None, data_init=None,
+                        modifier_func=None):
+    """Random sparse NDArray + its dense numpy mirror
+    (ref: test_utils.py:259 rand_sparse_ndarray → (arr, dense_np))."""
+    from .ndarray import sparse as _sp
+
+    density = np.random.rand() if density is None else density
+    dtype = np.float32 if dtype is None else dtype
+    dense = np.zeros(shape, dtype=dtype)
+    if stype == "row_sparse":
+        nrows = max(1, int(round(shape[0] * density)))
+        rows = np.sort(np.random.choice(shape[0], size=nrows,
+                                        replace=False))
+        vals = np.random.rand(nrows, *shape[1:]).astype(dtype)
+        if data_init is not None:
+            vals[:] = data_init
+        if modifier_func is not None:
+            vals = np.vectorize(modifier_func)(vals).astype(dtype)
+        dense[rows] = vals
+        arr = _sp.row_sparse_array((nd.array(vals), nd.array(rows)),
+                                   shape=shape, ctx=ctx, dtype=dtype)
+        return arr, dense
+    if stype == "csr":
+        assert len(shape) == 2
+        mask = np.random.rand(*shape) < density
+        if not mask.any():
+            mask[np.random.randint(shape[0]),
+                 np.random.randint(shape[1])] = True
+        vals = np.random.rand(*shape).astype(dtype) * mask
+        if data_init is not None:
+            vals = np.where(mask, dtype(data_init)
+                            if callable(dtype) else data_init, 0) \
+                .astype(dtype)
+        if modifier_func is not None:
+            vals = np.where(mask, np.vectorize(modifier_func)(vals), 0) \
+                .astype(dtype)
+        dense[:] = vals
+        arr = _sp.csr_matrix(nd.array(dense, ctx=ctx), ctx=ctx)
+        return arr, dense
+    raise ValueError("unknown stype %r" % stype)
+
+
+def create_2d_tensor(rows, columns, dtype=np.int64):
+    """ref: test_utils.py create_2d_tensor."""
+    a = np.arange(0, rows).reshape(rows, 1)
+    b = np.broadcast_to(a, shape=(a.shape[0], columns))
+    return nd.array(b, dtype=dtype)

@@ -1,0 +1,6 @@
+"""mx.text — vocabulary indexing + pretrained token embeddings
+(ref: python/mxnet/text/: indexer.py, embedding.py, glossary.py)."""
+from . import embedding, glossary, indexer, utils  # noqa: F401
+from .embedding import CustomEmbedding, FastText, GloVe, TokenEmbedding  # noqa: F401
+from .glossary import Glossary  # noqa: F401
+from .indexer import TokenIndexer  # noqa: F401

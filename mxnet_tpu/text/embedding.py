@@ -1,0 +1,190 @@
+"""Pretrained token embeddings (ref: python/mxnet/text/embedding.py
+TokenEmbedding:39, GloVe:442, FastText:542, CustomEmbedding:628).
+
+No downloads in this environment: GloVe/FastText load their standard
+text formats from a local `pretrained_file_path`; the reference's
+auto-download of named archives raises a clear error instead.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+from typing import Callable, Dict, List, Optional
+
+import numpy as _np
+
+from ..ndarray import NDArray, array
+from .indexer import TokenIndexer
+
+__all__ = ["TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "get_pretrained_file_names", "register", "create"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    """Register a TokenEmbedding subclass under its lowercase name
+    (ref: embedding.py register)."""
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name: str, **kwargs) -> "TokenEmbedding":
+    """ref: embedding.py create."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError("unknown embedding %r (have %s)"
+                       % (embedding_name, sorted(_REGISTRY)))
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name: Optional[str] = None):
+    """ref: embedding.py get_pretrained_file_names — the reference lists
+    downloadable archives; here the choice of file is the user's (local
+    paths), so the registry of formats is returned instead."""
+    if embedding_name is None:
+        return {k: ["<any local file in %s format>" % k]
+                for k in _REGISTRY}
+    return ["<any local file in %s format>" % embedding_name.lower()]
+
+
+class TokenEmbedding(TokenIndexer):
+    """Indexer + embedding matrix (ref: embedding.py:39). Subclasses
+    load vectors in `_load_embedding`; tokens absent from the
+    pretrained file get `init_unknown_vec`."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec: Optional[NDArray] = None
+
+    # -- loading -------------------------------------------------------
+    def _load_embedding_txt(self, path: str, elem_delim: str = " ",
+                            encoding: str = "utf8"):
+        """Parse 'token v1 v2 ...' lines (GloVe/fastText .vec format;
+        a leading 'count dim' header line is skipped)."""
+        if not os.path.exists(path):
+            raise OSError(
+                "pretrained file %r not found. This build has no "
+                "network egress — download the archive elsewhere and "
+                "point pretrained_file_path at the extracted file."
+                % path)
+        tokens: List[str] = []
+        vecs: List[_np.ndarray] = []
+        with io.open(path, "r", encoding=encoding) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if lineno == 0 and len(parts) == 2 and \
+                        all(p.isdigit() for p in parts):
+                    continue  # fastText header
+                if len(parts) < 2:
+                    continue
+                token = parts[0]
+                try:
+                    vec = _np.asarray([float(x) for x in parts[1:]],
+                                      dtype=_np.float32)
+                except ValueError:
+                    logging.warning("skipping unparsable line %d in %s",
+                                    lineno, path)
+                    continue
+                if self._vec_len == 0:
+                    self._vec_len = vec.size
+                elif vec.size != self._vec_len:
+                    logging.warning("line %d: dim %d != %d, skipped",
+                                    lineno, vec.size, self._vec_len)
+                    continue
+                tokens.append(token)
+                vecs.append(vec)
+        self._build_matrix(tokens, vecs,
+                           init_unknown_vec=getattr(
+                               self, "_init_unknown_vec", _np.zeros))
+
+    def _build_matrix(self, tokens, vecs,
+                      init_unknown_vec: Callable = _np.zeros):
+        loaded = dict(zip(tokens, vecs))
+        # extend the index with pretrained tokens not already present
+        for t in tokens:
+            if t not in self._token_to_idx:
+                self._token_to_idx[t] = len(self._idx_to_token)
+                self._idx_to_token.append(t)
+        unk = init_unknown_vec(self._vec_len).astype(_np.float32)
+        mat = _np.stack([loaded.get(t, unk)
+                         for t in self._idx_to_token])
+        self._idx_to_vec = array(mat)
+
+    # -- lookup (ref: embedding.py get_vecs_by_tokens / update) --------
+    @property
+    def vec_len(self) -> int:
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self) -> Optional[NDArray]:
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        if lower_case_backup:
+            idx = [self._token_to_idx.get(
+                t, self._token_to_idx.get(t.lower(), 0)) for t in tokens]
+        else:
+            idx = [self._token_to_idx.get(t, 0) for t in tokens]
+        vecs = self._idx_to_vec.asnumpy()[idx]
+        out = array(vecs[0] if single else vecs)
+        return out
+
+    def update_token_vectors(self, tokens, new_vectors) -> None:
+        """ref: embedding.py update_token_vectors."""
+        if isinstance(tokens, str):
+            tokens = [tokens]
+        if isinstance(new_vectors, NDArray):
+            new_vectors = new_vectors.asnumpy()
+        new_vectors = _np.atleast_2d(_np.asarray(new_vectors,
+                                                 _np.float32))
+        mat = _np.array(self._idx_to_vec.asnumpy())  # writable copy
+        for t, v in zip(tokens, new_vectors):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r not in the vocabulary" % t)
+            mat[self._token_to_idx[t]] = v
+        self._idx_to_vec = array(mat)
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe text format: 'token v1 ... vD' per line
+    (ref: embedding.py:442)."""
+
+    def __init__(self, pretrained_file_path: str,
+                 init_unknown_vec: Callable = _np.zeros, **kwargs):
+        super().__init__(**kwargs)
+        self._init_unknown_vec = init_unknown_vec
+        self._load_embedding_txt(pretrained_file_path)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText .vec format (header line 'count dim')
+    (ref: embedding.py:542)."""
+
+    def __init__(self, pretrained_file_path: str,
+                 init_unknown_vec: Callable = _np.zeros, **kwargs):
+        super().__init__(**kwargs)
+        self._init_unknown_vec = init_unknown_vec
+        self._load_embedding_txt(pretrained_file_path)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """User-format embedding file with a custom delimiter
+    (ref: embedding.py:628)."""
+
+    def __init__(self, pretrained_file_path: str, elem_delim: str = " ",
+                 encoding: str = "utf8",
+                 init_unknown_vec: Callable = _np.zeros, **kwargs):
+        super().__init__(**kwargs)
+        self._init_unknown_vec = init_unknown_vec
+        self._load_embedding_txt(pretrained_file_path,
+                                 elem_delim=elem_delim,
+                                 encoding=encoding)

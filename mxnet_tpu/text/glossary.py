@@ -1,0 +1,32 @@
+"""Glossary: vocabulary from a counter + vectors from one or more
+TokenEmbeddings (ref: python/mxnet/text/glossary.py Glossary:28)."""
+from __future__ import annotations
+
+from typing import List, Union
+
+import numpy as _np
+
+from ..ndarray import array
+from .embedding import TokenEmbedding
+
+__all__ = ["Glossary"]
+
+
+class Glossary(TokenEmbedding):
+    def __init__(self, counter, token_embeddings: Union[TokenEmbedding,
+                                                        List],
+                 most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        super().__init__(counter=counter, most_freq_count=most_freq_count,
+                         min_freq=min_freq, unknown_token=unknown_token,
+                         reserved_tokens=reserved_tokens)
+        self._vec_len = sum(e.vec_len for e in token_embeddings)
+        mat = _np.zeros((len(self), self._vec_len), _np.float32)
+        col = 0
+        for emb in token_embeddings:
+            sub = emb.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+            mat[:, col:col + emb.vec_len] = sub
+            col += emb.vec_len
+        self._idx_to_vec = array(mat)

@@ -1,0 +1,96 @@
+"""Token→index mapping (ref: python/mxnet/text/indexer.py
+TokenIndexer:30)."""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+__all__ = ["TokenIndexer"]
+
+
+class TokenIndexer:
+    """Index tokens by frequency from a Counter
+    (ref: indexer.py:30,89). Index 0 is the unknown token; reserved
+    tokens follow, then counter keys in descending frequency
+    (ties broken alphabetically, like the reference's sort)."""
+
+    def __init__(self, counter: Optional[collections.Counter] = None,
+                 most_freq_count: Optional[int] = None, min_freq: int = 1,
+                 unknown_token: str = "<unk>",
+                 reserved_tokens: Optional[List[str]] = None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            if unknown_token in reserved_tokens or \
+                    len(set(reserved_tokens)) != len(reserved_tokens):
+                raise ValueError("reserved tokens must be unique and "
+                                 "exclude the unknown token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens else None)
+        self._idx_to_token = [unknown_token] + (self._reserved_tokens
+                                               or [])
+        self._token_to_idx: Dict[str, int] = {
+            t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, unknown_token,
+                                     self._reserved_tokens or [],
+                                     most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, unknown_token, reserved,
+                            most_freq_count, min_freq):
+        # descending frequency, alphabetical tiebreak (ref:
+        # indexer.py:125 sorts by __getitem__ then frequency)
+        pairs = sorted(counter.items())
+        pairs.sort(key=lambda x: x[1], reverse=True)
+        skip = set(reserved) | {unknown_token}
+        budget = most_freq_count if most_freq_count is not None else None
+        taken = 0
+        for token, freq in pairs:
+            if freq < min_freq or (budget is not None and taken >= budget):
+                break
+            if token in skip:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            taken += 1
+
+    def __len__(self) -> int:
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self) -> Dict[str, int]:
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self) -> str:
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self) -> Optional[List[str]]:
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) → index/indices; unknown maps to 0
+        (ref: indexer.py:173)."""
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        out = [self._token_to_idx.get(t, 0) for t in tokens]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        """Index/indices → token(s) (ref: indexer.py:200)."""
+        single = isinstance(indices, int)
+        if single:
+            indices = [indices]
+        out = []
+        for i in indices:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("index %d out of vocabulary range" % i)
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

@@ -1,0 +1,45 @@
+"""PyTorch interop bridge (ref: the reference's torch plugin —
+plugin/torch + python/mxnet/torch.py bridged Lua Torch tensors; the
+modern equivalent is PyTorch tensor exchange).
+
+Zero-copy where possible via dlpack; falls back to numpy copies.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["to_torch", "from_torch"]
+
+
+def _torch():
+    try:
+        import torch
+
+        return torch
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("pytorch is not installed") from e
+
+
+def to_torch(arr: NDArray):
+    """NDArray → torch.Tensor (dlpack when the layouts allow,
+    else a host copy)."""
+    torch = _torch()
+    try:
+        return torch.from_dlpack(arr._data)
+    except Exception:
+        return torch.from_numpy(arr.asnumpy())
+
+
+def from_torch(tensor) -> NDArray:
+    """torch.Tensor → NDArray."""
+    _torch()
+    from .context import current_context
+
+    try:
+        import jax.dlpack as jdl
+
+        return NDArray.from_raw(jdl.from_dlpack(tensor),
+                                current_context())
+    except Exception:
+        return array(tensor.detach().cpu().numpy())

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""KVStore bandwidth benchmark (ref: tools/bandwidth/measure.py —
+measures push+pull throughput over a kvstore backend with model-sized
+gradient arrays).
+
+    python tools/bandwidth/measure.py --kv-store local --num-layers 10
+    python tools/launch.py -n 2 python tools/bandwidth/measure.py \
+        --kv-store dist_sync
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--num-layers", type=int, default=10)
+    ap.add_argument("--size", type=int, default=1 << 20,
+                    help="floats per layer (default 1M ≈ 4MB)")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--optimizer", default=None,
+                    help="e.g. sgd — enables update_on_kvstore path")
+    args = ap.parse_args()
+
+    kv = mx.kv.create(args.kv_store)
+    shapes = [(args.size,)] * args.num_layers
+    grads = [nd.ones(s) for s in shapes]
+    outs = [nd.zeros(s) for s in shapes]
+    keys = list(range(args.num_layers))
+    for k, g in zip(keys, grads):
+        kv.init(k, nd.zeros(g.shape))
+    if args.optimizer:
+        kv.set_optimizer(mx.optimizer.create(args.optimizer))
+
+    def one_round():
+        kv.push(keys, grads)
+        kv.pull(keys, out=outs)
+        for o in outs:
+            o.wait_to_read()
+
+    for _ in range(args.warmup):
+        one_round()
+    tic = time.time()
+    for _ in range(args.iters):
+        one_round()
+    dt = time.time() - tic
+    nbytes = args.num_layers * args.size * 4
+    # push + pull both move the full model per round
+    gbps = 2 * nbytes * args.iters / dt / 1e9
+    print("kvstore=%s rank=%d/%d: %.3f GB/s (%.1f ms/round, %d x %.1f MB)"
+          % (args.kv_store, kv.rank, kv.num_workers, gbps,
+             1e3 * dt / args.iters, args.num_layers, nbytes /
+             args.num_layers / 1e6))
+    if hasattr(kv, "close"):
+        kv.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Parse training logs into per-epoch tables
+(ref: tools/parse_log.py — same log grammar: the fit loop's
+"Epoch[N] Batch [M] Speed: S samples/sec metric=V" lines from
+Speedometer, plus Train-/Validation- metric and Time cost lines).
+
+    python tools/parse_log.py train.log
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+# metric values can be negative, exponent-formatted, or nan/inf
+_VAL_PAT = r"([-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?|[-+]?(?:nan|inf))"
+_BATCH = re.compile(
+    r"Epoch\[(\d+)\].*?Batch \[(\d+)\]\s*Speed:\s*([\d.]+) samples/sec"
+    r"\s*(\w[\w-]*)=" + _VAL_PAT)
+_TRAIN = re.compile(r"Epoch\[(\d+)\] Train-(\w[\w-]*)=" + _VAL_PAT)
+_VAL = re.compile(r"Epoch\[(\d+)\] Validation-(\w[\w-]*)=" + _VAL_PAT)
+_TIME = re.compile(r"Epoch\[(\d+)\] Time cost=([\d.]+)")
+
+
+def parse(lines):
+    """→ dict epoch → {speed: [..], train: {m: v}, val: {m: v},
+    time: s}."""
+    epochs = {}
+
+    def ep(i):
+        return epochs.setdefault(int(i), {"speed": [], "train": {},
+                                          "val": {}, "time": None})
+
+    for line in lines:
+        m = _BATCH.search(line)
+        if m:
+            ep(m.group(1))["speed"].append(float(m.group(3)))
+            continue
+        m = _TRAIN.search(line)
+        if m:
+            ep(m.group(1))["train"][m.group(2)] = float(m.group(3))
+            continue
+        m = _VAL.search(line)
+        if m:
+            ep(m.group(1))["val"][m.group(2)] = float(m.group(3))
+            continue
+        m = _TIME.search(line)
+        if m:
+            ep(m.group(1))["time"] = float(m.group(2))
+    return epochs
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", choices=["markdown", "csv"],
+                    default="markdown")
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        epochs = parse(f)
+    if not epochs:
+        print("no epochs found", file=sys.stderr)
+        sys.exit(1)
+    metrics = sorted({m for e in epochs.values()
+                      for m in list(e["train"]) + list(e["val"])})
+    header = ["epoch", "speed(avg)"] + \
+        ["train-" + m for m in metrics] + \
+        ["val-" + m for m in metrics] + ["time(s)"]
+    sep = "," if args.format == "csv" else " | "
+    print(sep.join(header))
+    if args.format == "markdown":
+        print(sep.join("---" for _ in header))
+    for i in sorted(epochs):
+        e = epochs[i]
+        speed = (sum(e["speed"]) / len(e["speed"])) if e["speed"] else 0.0
+        row = [str(i), "%.1f" % speed]
+        row += ["%.5f" % e["train"][m] if m in e["train"] else ""
+                for m in metrics]
+        row += ["%.5f" % e["val"][m] if m in e["val"] else ""
+                for m in metrics]
+        row.append("%.1f" % e["time"] if e["time"] is not None else "")
+        print(sep.join(row))
+
+
+if __name__ == "__main__":
+    main()
