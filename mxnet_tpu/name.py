@@ -13,20 +13,22 @@ _local = threading.local()
 
 class NameManager:
     """Scope manager assigning default names to symbols
-    (ref: name.py:22)."""
+    (ref: name.py:22). Counter access is locked so the process-global
+    default manager stays collision-free across threads (the behavior
+    the reference gets from its module-level counter)."""
 
     def __init__(self):
         self._counter: Dict[str, int] = {}
         self._old: Optional["NameManager"] = None
+        self._lock = threading.Lock()
 
     def get(self, name: Optional[str], hint: str) -> str:
         if name is not None:
             return name
-        if hint not in self._counter:
-            self._counter[hint] = 0
-        name = "%s%d" % (hint, self._counter[hint])
-        self._counter[hint] += 1
-        return name
+        with self._lock:
+            idx = self._counter.get(hint, 0)
+            self._counter[hint] = idx + 1
+        return "%s%d" % (hint, idx)
 
     def __enter__(self) -> "NameManager":
         self._old = current()
@@ -50,9 +52,9 @@ class Prefix(NameManager):
         return self._prefix + name
 
 
+_default = NameManager()  # one process-global default: auto names stay
+# unique even when threads build symbols concurrently
+
+
 def current() -> NameManager:
-    mgr = getattr(_local, "manager", None)
-    if mgr is None:
-        mgr = NameManager()
-        _local.manager = mgr
-    return mgr
+    return getattr(_local, "manager", None) or _default

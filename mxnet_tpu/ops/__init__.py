@@ -19,3 +19,4 @@ from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import linalg  # noqa: F401
+from . import extra  # noqa: F401

@@ -11,7 +11,8 @@ def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
                           to_lower=False, counter_to_update=None):
     """Count whitespace/delimiter-separated tokens into a Counter
     (ref: text/utils.py count_tokens_from_str)."""
-    source_str = re.sub(r"(%s)+" % seq_delim, token_delim, source_str)
+    source_str = re.sub(r"(%s)+" % re.escape(seq_delim), token_delim,
+                        source_str)
     if to_lower:
         source_str = source_str.lower()
     counter = (counter_to_update if counter_to_update is not None

@@ -1,0 +1,197 @@
+"""Tests for the op-registry tail (SVMOutput, Correlation,
+softmax_cross_entropy, bipartite matching, slice assign, KL sparse reg,
+mp_sgd_mom_update, aliases)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_svm_output_forward_identity():
+    x = nd.array(np.random.randn(4, 3).astype(np.float32))
+    y = nd.array(np.array([0, 1, 2, 1], np.float32))
+    out = nd.SVMOutput(x, y)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+
+
+def _svm_ref_grad(x, lab, margin, reg, use_linear):
+    g = np.zeros_like(x)
+    for y in range(x.shape[0]):
+        k = int(lab[y])
+        for j in range(x.shape[1]):
+            if j == k:
+                if use_linear:
+                    g[y, k] = -float(margin > x[y, k]) * reg
+                else:
+                    g[y, k] = (2 * (margin - x[y, k])
+                               if margin > x[y, k] else 0.0) * -reg
+            else:
+                if use_linear:
+                    g[y, j] = float(margin > -x[y, j]) * reg
+                else:
+                    g[y, j] = (-2 * (margin + x[y, j])
+                               if margin > -x[y, j] else 0.0) * -reg
+    return g
+
+
+@pytest.mark.parametrize("use_linear", [False, True])
+def test_svm_output_gradient_matches_reference_math(use_linear):
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(5, 4).astype(np.float32)
+    lab_np = rng.randint(0, 4, 5).astype(np.float32)
+    x = nd.array(x_np)
+    lab = nd.array(lab_np)
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(x, lab, margin=1.0,
+                           regularization_coefficient=0.5,
+                           use_linear=use_linear)
+    out.backward()
+    want = _svm_ref_grad(x_np, lab_np, 1.0, 0.5, use_linear)
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-5)
+
+
+def test_softmax_cross_entropy():
+    rng = np.random.RandomState(1)
+    x = rng.randn(6, 5).astype(np.float32)
+    lab = rng.randint(0, 5, 6).astype(np.float32)
+    out = nd.softmax_cross_entropy(nd.array(x), nd.array(lab))
+    p = np.exp(x - x.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    want = -np.log(p[np.arange(6), lab.astype(int)]).sum()
+    assert out.shape == (1,)
+    np.testing.assert_allclose(out.asnumpy()[0], want, rtol=1e-5)
+
+
+def test_correlation_identical_inputs():
+    rng = np.random.RandomState(2)
+    x = rng.rand(1, 3, 6, 6).astype(np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1)
+    # D = 3 → 9 channels; centre channel (index 4) is mean over C of x²
+    assert out.shape == (1, 9, 6, 6)
+    centre = out.asnumpy()[0, 4]
+    want = (x[0] ** 2).mean(axis=0)
+    np.testing.assert_allclose(centre, want, rtol=1e-5)
+
+
+def test_correlation_displacement_picks_shift():
+    # data2 shifted right by 1: sampling data2 one pixel to the right of
+    # the centre (displacement (0, +1)) recovers the self-correlation
+    x = np.random.RandomState(3).rand(1, 1, 5, 5).astype(np.float32)
+    x2 = np.roll(x, 1, axis=3)
+    out = nd.Correlation(nd.array(x), nd.array(x2), max_displacement=1,
+                         pad_size=1).asnumpy()
+    self_corr = nd.Correlation(nd.array(x), nd.array(x),
+                               max_displacement=1,
+                               pad_size=1).asnumpy()
+    # channel index for (dy=0, dx=+1) = 1*3 + 2 = 5; wrap column excluded
+    np.testing.assert_allclose(out[0, 5, :, :4],
+                               self_corr[0, 4, :, :4], rtol=1e-5)
+
+
+def test_correlation_subtract_mode():
+    x = np.ones((1, 2, 4, 4), np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x * 3.0),
+                         max_displacement=0, is_multiply=False)
+    np.testing.assert_allclose(out.asnumpy(), 2.0, rtol=1e-6)
+
+
+def test_bipartite_matching():
+    score = nd.array(np.array([[0.5, 0.9], [0.8, 0.2]], np.float32))
+    rm, cm = nd.contrib.bipartite_matching(score, threshold=0.1)
+    # greedy: (0,1)=0.9 first, then (1,0)=0.8
+    np.testing.assert_array_equal(rm.asnumpy(), [1, 0])
+    np.testing.assert_array_equal(cm.asnumpy(), [1, 0])
+    # threshold excludes weak pairs
+    rm, cm = nd.contrib.bipartite_matching(score, threshold=0.85)
+    np.testing.assert_array_equal(rm.asnumpy(), [1, -1])
+    np.testing.assert_array_equal(cm.asnumpy(), [-1, 0])
+    # ascending: smallest first
+    rm, _ = nd.contrib.bipartite_matching(score, is_ascend=True,
+                                          threshold=1.0)
+    np.testing.assert_array_equal(rm.asnumpy(), [0, 1])
+
+
+def test_slice_assign():
+    x = nd.zeros((4, 4))
+    y = nd.ones((2, 2))
+    out = nd._slice_assign(x, y, begin=(1, 1), end=(3, 3))
+    want = np.zeros((4, 4))
+    want[1:3, 1:3] = 1
+    np.testing.assert_array_equal(out.asnumpy(), want)
+    out = nd._slice_assign_scalar(x, scalar=7.0, begin=(0, 2),
+                                  end=(4, 4))
+    assert (out.asnumpy()[:, 2:] == 7).all()
+    assert (out.asnumpy()[:, :2] == 0).all()
+    # negative step: reference defaults begin/end to the reversed range
+    xr = nd.array(np.zeros(4, np.float32))
+    yr = nd.array(np.array([1, 2, 3, 4], np.float32))
+    out = nd._slice_assign(xr, yr, begin=(None,), end=(None,),
+                           step=(-1,))
+    np.testing.assert_array_equal(out.asnumpy(), [4, 3, 2, 1])
+    with pytest.raises(Exception):
+        nd._slice_assign(xr, yr, begin=(0,), end=(4,), step=(0,))
+
+
+def test_auto_names_unique_across_threads():
+    import threading
+
+    names = []
+
+    def build():
+        d = mx.sym.Variable("data")
+        names.append(mx.sym.FullyConnected(d, num_hidden=2).name)
+
+    ts = [threading.Thread(target=build) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(set(names)) == 4, names
+
+
+def test_identity_attach_kl_sparse_reg():
+    rng = np.random.RandomState(4)
+    x = nd.array(rng.rand(8, 5).astype(np.float32))
+    avg = nd.full((5,), 0.1)
+    x.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(x, avg,
+                                           sparseness_target=0.1,
+                                           penalty=0.01)
+        loss = out.sum()
+    loss.backward()
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    # moving average aux updated toward batch mean
+    batch_rho = x.asnumpy().mean(axis=0)
+    want_avg = 0.9 * 0.1 + 0.1 * batch_rho
+    np.testing.assert_allclose(avg.asnumpy(), want_avg, rtol=1e-5)
+    # gradient = ones + per-sample undivided KL term (reference kernel)
+    kl = 0.01 * (-0.1 / want_avg + 0.9 / (1.0 - want_avg))
+    want_grad = np.broadcast_to(1.0 + kl[None, :], x.shape)
+    np.testing.assert_allclose(x.grad.asnumpy(), want_grad, rtol=1e-4)
+
+
+def test_mp_sgd_mom_update():
+    w = nd.ones((4,)).astype("float16")
+    g = nd.ones((4,)).astype("float16")
+    mom = nd.zeros((4,))
+    w32 = nd.ones((4,))
+    out = nd.mp_sgd_mom_update(w, g, mom, w32, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(out.asnumpy(), 0.9, rtol=1e-3)
+    np.testing.assert_allclose(mom.asnumpy(), -0.1, rtol=1e-6)
+    np.testing.assert_allclose(w32.asnumpy(), 0.9, rtol=1e-6)
+    assert out.dtype == np.float16
+
+
+def test_aliases_present():
+    for name in ("MakeLoss", "CuDNNBatchNorm", "_square_sum",
+                 "_CrossDeviceCopy", "_contrib_SparseEmbedding",
+                 "_scatter_minus_scalar", "_scatter_plus_scalar"):
+        assert hasattr(nd, name) or name in dir(nd), name
+    # symbol layer too
+    s = mx.sym.MakeLoss(mx.sym.Variable("x"))
+    assert s.infer_shape(x=(2, 2))[1] == [(2, 2)]

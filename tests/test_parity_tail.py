@@ -86,6 +86,9 @@ def test_embedding_missing_file():
 def test_count_tokens_from_str():
     c = mx.text.utils.count_tokens_from_str("a b b\nc a", to_lower=True)
     assert c == collections.Counter({"a": 2, "b": 2, "c": 1})
+    # regex-metacharacter delimiters must be escaped, not interpreted
+    c = mx.text.utils.count_tokens_from_str("a.b c", seq_delim=".")
+    assert c == collections.Counter({"a": 1, "b": 1, "c": 1})
 
 
 # ---------------------------------------------------------------- naming
