@@ -115,19 +115,25 @@ def _correlation(data1, data2, kernel_size=1, max_displacement=1,
 
 # ------------------------------------------- identity + KL sparseness reg
 @register("IdentityAttachKLSparseReg", mutate_aux=(1,),
-          input_names=["data", "moving_avg"])
+          input_names=["data", "moving_avg"], train_aware=True)
 def _identity_attach_kl_sparse_reg(data, moving_avg, sparseness_target=0.1,
-                                   penalty=0.001, momentum=0.9, **_):
+                                   penalty=0.001, momentum=0.9,
+                                   _training=True, **_):
     """Identity forward; backward adds the KL sparsity penalty gradient
     against the moving average activation (ref:
     src/operator/identity_attach_KL_sparse_reg-inl.h; aux state is the
-    per-unit moving average rho_hat)."""
+    per-unit moving average rho_hat, updated only during training — the
+    reference updates it in Backward, so inference passes must not
+    touch it)."""
     rho = float(sparseness_target)
     pen = float(penalty)
     mom = float(momentum)
 
-    batch_rho = data.mean(axis=0)
-    new_avg = mom * moving_avg + (1.0 - mom) * batch_rho
+    if _training:
+        batch_rho = data.mean(axis=0)
+        new_avg = mom * moving_avg + (1.0 - mom) * batch_rho
+    else:
+        new_avg = moving_avg
 
     @jax.custom_vjp
     def fwd(x, rho_hat):
@@ -167,7 +173,9 @@ def _bipartite_matching(data, threshold=None, is_ascend=False, topk=-1,
         N, M = mat.shape
         work = -mat if is_ascend else mat
         limit = (-threshold if is_ascend else threshold)
-        rounds = min(N, M) if topk <= 0 else min(topk, N, M)
+        # the reference's post-increment break yields topk+1 matches
+        # (bounding_box-inl.h count++ then count > topk)
+        rounds = min(N, M) if topk <= 0 else min(topk + 1, N, M)
 
         def body(_, st):
             w, rm, cm = st

@@ -144,7 +144,13 @@ class FusedTrainStep:
         momentum = self._momentum_cfg
         weight_decay = self._weight_decay
         with autograd.pause():
-            block(sample_data)  # settles deferred initialization
+            # settle deferred shapes with the params' current dtype —
+            # the user may hand a bf16 batch before the cast happens
+            settle = sample_data
+            if self._dtype is not None and \
+                    str(sample_data.dtype) != "float32":
+                settle = sample_data.astype("float32")
+            block(settle)  # settles deferred initialization
         if self._dtype is not None:
             # whole-model cast — the reference's dtype-training story
             # (example/image-classification --dtype float16); on TPU the
@@ -174,7 +180,10 @@ class FusedTrainStep:
         aux_idx = self._aux_idx
         lr, mom_c, wd = learning_rate, momentum, weight_decay
 
-        def step(param_vals, mom_vals, data, label, key):
+        def step(param_vals, mom_vals, data, label, key_root, ctr):
+            # fold the per-step counter inside the fused program: no
+            # separate host-side fold_in dispatch per step
+            key = jax.random.fold_in(key_root, ctr)
             diff = {i: v for i, v in enumerate(param_vals) if i not in aux_idx}
             aux = {i: v for i, v in enumerate(param_vals) if i in aux_idx}
 
@@ -212,14 +221,20 @@ class FusedTrainStep:
         donate = (0, 1)  # params + momenta buffers are donated: in-place update
         self._step = jax.jit(
             step,
-            in_shardings=(self._param_sh, self._param_sh, data_sh, data_sh, rep),
+            in_shardings=(self._param_sh, self._param_sh, data_sh, data_sh,
+                          rep, rep),
             out_shardings=(self._param_sh, self._param_sh, rep, data_sh),
             donate_argnums=donate,
         )
 
         import jax.numpy as jnp
 
+        from .. import random as _random
+
         self._moms = [jnp.zeros_like(p.data()._data) for p in self._cells]
+        self._key_root = jax.device_put(_random._next_key(), rep)
+        self._key_gen = _random._generation
+        self._key_ctr = 0
         self._placed = False
         self._built = True
 
@@ -229,12 +244,13 @@ class FusedTrainStep:
             p.data()._data = jax.device_put(p.data()._data, sh)
         self._moms = [jax.device_put(m, sh)
                       for m, sh in zip(self._moms, self._param_sh)]
+        self._param_vals = [p.data()._data for p in self._cells]
+        self._param_vt = [p.data()._vt for p in self._cells]
         self._placed = True
 
     def __call__(self, data, label):
         """Run one optimizer step; returns (loss, logits) NDArrays."""
         jax = _jax()
-        from .. import random as _random
 
         if not self._built:
             self._build(data if isinstance(data, NDArray) else NDArray(data))
@@ -246,13 +262,32 @@ class FusedTrainStep:
             raw_data = raw_data.astype(self._dtype)
         raw_data = jax.device_put(raw_data, self._data_sh)
         raw_label = jax.device_put(raw_label, self._data_sh)
-        params = [p.data()._data for p in self._cells]
-        key = _random._next_key()
+        # fast path: reuse last step's outputs as this step's inputs
+        # unless someone mutated a parameter cell in between (version
+        # token check — the NDArray cell's write-versioning contract)
+        params = self._param_vals
+        for i, p in enumerate(self._cells):
+            cell = p.data()
+            if cell._vt is not self._param_vt[i]:
+                params[i] = cell._data
+        from .. import random as _random
+
+        if self._key_gen != _random._generation:
+            # mx.random.seed() was called since build: honor it
+            self._key_root = jax.device_put(_random._next_key(),
+                                            self._rep)
+            self._key_gen = _random._generation
+            self._key_ctr = 0
+        self._key_ctr += 1
         new_params, self._moms, loss, logits = self._step(
-            params, self._moms, raw_data, raw_label, key
+            params, self._moms, raw_data, raw_label, self._key_root,
+            self._key_ctr
         )
-        for p, v in zip(self._cells, new_params):
+        self._param_vals = new_params
+        for i, (p, v) in enumerate(zip(self._cells, new_params)):
             cell = p.data()
             cell._data = v
-            cell._vt = object()
+            token = object()
+            cell._vt = token
+            self._param_vt[i] = token
         return NDArray.from_raw(loss), NDArray.from_raw(logits)

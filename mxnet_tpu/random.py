@@ -32,12 +32,17 @@ def _jax():
     return jax
 
 
+_generation = 0  # bumped on every seed(): long-lived compiled steps
+# (FusedTrainStep) watch it to refresh their captured root key
+
+
 def seed(seed_state: int, ctx=None) -> None:
     """ref: python/mxnet/random.py seed → MXRandomSeed."""
-    global _root_key, _counter
+    global _root_key, _counter, _generation
     with _lock:
         _root_key = _jax().random.PRNGKey(int(seed_state))
         _counter = 0
+        _generation += 1
 
 
 class trace_key_scope:

@@ -11,8 +11,10 @@ def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
                           to_lower=False, counter_to_update=None):
     """Count whitespace/delimiter-separated tokens into a Counter
     (ref: text/utils.py count_tokens_from_str)."""
-    source_str = re.sub(r"(%s)+" % re.escape(seq_delim), token_delim,
-                        source_str)
+    # lambda replacement: token_delim must not be parsed as a regex
+    # substitution template (backslashes, \g<...> refs)
+    source_str = re.sub(r"(%s)+" % re.escape(seq_delim),
+                        lambda _m: token_delim, source_str)
     if to_lower:
         source_str = source_str.lower()
     counter = (counter_to_update if counter_to_update is not None

@@ -113,6 +113,9 @@ def test_bipartite_matching():
     rm, _ = nd.contrib.bipartite_matching(score, is_ascend=True,
                                           threshold=1.0)
     np.testing.assert_array_equal(rm.asnumpy(), [0, 1])
+    # topk follows the reference's post-increment break: topk+1 matches
+    rm, _ = nd.contrib.bipartite_matching(score, threshold=0.1, topk=1)
+    np.testing.assert_array_equal(rm.asnumpy(), [1, 0])
 
 
 def test_slice_assign():
@@ -173,6 +176,16 @@ def test_identity_attach_kl_sparse_reg():
     kl = 0.01 * (-0.1 / want_avg + 0.9 / (1.0 - want_avg))
     want_grad = np.broadcast_to(1.0 + kl[None, :], x.shape)
     np.testing.assert_allclose(x.grad.asnumpy(), want_grad, rtol=1e-4)
+
+
+def test_kl_sparse_reg_inference_preserves_aux():
+    """Inference passes must not drift the training moving average
+    (reference updates it only in Backward)."""
+    x = nd.array(np.random.rand(4, 3).astype(np.float32))
+    avg = nd.full((3,), 0.1)
+    out = nd.IdentityAttachKLSparseReg(x, avg)  # outside record()
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    np.testing.assert_allclose(avg.asnumpy(), 0.1)
 
 
 def test_mp_sgd_mom_update():
