@@ -1,0 +1,57 @@
+/* C++ frontend example: checkpoint → Predictor → argmax, mirroring the
+ * reference's cpp-package image-classification predict flow. Driven by
+ * tests/test_cpp_package.py. */
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "mxnet_tpu_cpp/predictor.hpp"
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::fprintf(stderr, "usage: %s prefix epoch input.bin\n", argv[0]);
+    return 2;
+  }
+  try {
+    using mxnet_tpu::cpp::Predictor;
+    std::string raw = mxnet_tpu::cpp::ReadFile(argv[3]);
+    std::vector<float> input(
+        reinterpret_cast<const float *>(raw.data()),
+        reinterpret_cast<const float *>(raw.data() + raw.size()));
+    mx_uint batch = 4;
+    mx_uint dim = static_cast<mx_uint>(input.size()) / batch;
+
+    Predictor pred = Predictor::FromCheckpoint(
+        argv[1], std::atoi(argv[2]), {{"data", {batch, dim}}});
+    pred.SetInput("data", input);
+    pred.Forward();
+    auto shape = pred.GetOutputShape(0);
+    std::printf("output shape:");
+    for (auto d : shape) std::printf(" %u", d);
+    std::printf("\n");
+    std::vector<float> out = pred.GetOutput(0);
+    for (mx_uint b = 0; b < shape[0]; ++b) {
+      mx_uint best = 0;
+      for (mx_uint c = 1; c < shape[1]; ++c)
+        if (out[b * shape[1] + c] > out[b * shape[1] + best]) best = c;
+      std::printf("sample %u -> class %u (score %.4f)\n", b, best,
+                  out[b * shape[1] + best]);
+    }
+    // error handling surfaces as exceptions
+    bool threw = false;
+    try {
+      pred.SetInput("not_an_input", input);
+    } catch (const mxnet_tpu::cpp::Error &) {
+      threw = true;
+    }
+    if (!threw) {
+      std::fprintf(stderr, "expected Error for bad input key\n");
+      return 1;
+    }
+    std::printf("cpp-package OK\n");
+    return 0;
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "failed: %s\n", e.what());
+    return 1;
+  }
+}

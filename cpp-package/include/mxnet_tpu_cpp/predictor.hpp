@@ -1,0 +1,139 @@
+/*!
+ * Header-only C++ frontend for deployment inference.
+ *
+ * ref: cpp-package/include/mxnet-cpp/ — the reference ships a full C++
+ * frontend over its C API; the inference surface (the part the
+ * deployment examples use: load checkpoint → set input → forward →
+ * read output) is provided here over the TPU build's predict ABI
+ * (include/mxnet_tpu/c_predict_api.h, native/libmxnet_tpu.so).
+ *
+ * Usage:
+ *   mxnet_tpu::cpp::Predictor pred(symbol_json, param_blob,
+ *                                  {{"data", {1, 3, 224, 224}}});
+ *   pred.SetInput("data", pixels);
+ *   pred.Forward();
+ *   std::vector<float> out = pred.GetOutput(0);
+ */
+#ifndef MXNET_TPU_CPP_PREDICTOR_HPP_
+#define MXNET_TPU_CPP_PREDICTOR_HPP_
+
+#include <cstdint>
+#include <fstream>
+#include <map>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "mxnet_tpu/c_predict_api.h"
+
+namespace mxnet_tpu {
+namespace cpp {
+
+/*! \brief Thrown on any predict-API failure, carrying MXGetLastError. */
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string &what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc, const char *where) {
+  if (rc != 0) {
+    throw Error(std::string(where) + ": " + MXGetLastError());
+  }
+}
+
+/*! \brief Read a whole file (symbol json / params blob). */
+inline std::string ReadFile(const std::string &path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw Error("cannot open " + path);
+  return std::string(std::istreambuf_iterator<char>(f),
+                     std::istreambuf_iterator<char>());
+}
+
+class Predictor {
+ public:
+  using Shape = std::vector<mx_uint>;
+
+  /*!
+   * \param symbol_json  symbol JSON text (ReadFile("...-symbol.json"))
+   * \param param_blob   params container bytes ("...-0000.params");
+   *                     may be empty for param-less graphs
+   * \param input_shapes name → shape for every input
+   * \param dev_type     1 = cpu, 2 = accelerator (tpu)
+   */
+  Predictor(const std::string &symbol_json, const std::string &param_blob,
+            const std::map<std::string, Shape> &input_shapes,
+            int dev_type = 1, int dev_id = 0) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shape_data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) shape_data.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(shape_data.size()));
+    }
+    Check(MXPredCreate(symbol_json.c_str(), param_blob.data(),
+                       static_cast<int>(param_blob.size()), dev_type,
+                       dev_id, static_cast<mx_uint>(keys.size()),
+                       keys.data(), indptr.data(), shape_data.data(),
+                       &handle_),
+          "MXPredCreate");
+  }
+
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+  Predictor(Predictor &&other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+
+  ~Predictor() {
+    if (handle_) MXPredFree(handle_);
+  }
+
+  /*! \brief Load "prefix-symbol.json" + "prefix-%04d.params". */
+  static Predictor FromCheckpoint(
+      const std::string &prefix, int epoch,
+      const std::map<std::string, Shape> &input_shapes, int dev_type = 1,
+      int dev_id = 0) {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "-%04d.params", epoch);
+    return Predictor(ReadFile(prefix + "-symbol.json"),
+                     ReadFile(prefix + buf), input_shapes, dev_type,
+                     dev_id);
+  }
+
+  void SetInput(const std::string &key, const std::vector<float> &data) {
+    Check(MXPredSetInput(handle_, key.c_str(), data.data(),
+                         static_cast<mx_uint>(data.size())),
+          "MXPredSetInput");
+  }
+
+  void Forward() { Check(MXPredForward(handle_), "MXPredForward"); }
+
+  Shape GetOutputShape(mx_uint index) const {
+    mx_uint *data = nullptr;
+    mx_uint ndim = 0;
+    Check(MXPredGetOutputShape(handle_, index, &data, &ndim),
+          "MXPredGetOutputShape");
+    return Shape(data, data + ndim);
+  }
+
+  std::vector<float> GetOutput(mx_uint index) const {
+    Shape shape = GetOutputShape(index);
+    mx_uint size = std::accumulate(shape.begin(), shape.end(), mx_uint(1),
+                                   std::multiplies<mx_uint>());
+    std::vector<float> out(size);
+    Check(MXPredGetOutput(handle_, index, out.data(), size),
+          "MXPredGetOutput");
+    return out;
+  }
+
+ private:
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxnet_tpu
+
+#endif  // MXNET_TPU_CPP_PREDICTOR_HPP_

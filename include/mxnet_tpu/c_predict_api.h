@@ -87,6 +87,15 @@ MXNET_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
 /*! \brief Free the predictor. */
 MXNET_DLL int MXPredFree(PredictorHandle handle);
 
+/*! \brief List every registered operator name (ref: MXListAllOpNames
+ *  in the full C API). Pointers are valid until the next call on the
+ *  same thread. */
+MXNET_DLL int MXListAllOpNames(uint32_t *out_size,
+                               const char ***out_array);
+
+/*! \brief Library version as major*10000 + minor*100 + patch. */
+MXNET_DLL int MXGetVersion(int *out);
+
 #ifdef __cplusplus
 }
 #endif

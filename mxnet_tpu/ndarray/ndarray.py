@@ -174,6 +174,8 @@ class NDArray:
         other._data = _jax().device_put(self._data, other._ctx.jax_device()).astype(
             other._data.dtype
         )
+        other._vt = object()  # bump the write version: consumers that
+        # cache by version token (FusedTrainStep fast path) must observe
         return other
 
     def as_in_context(self, ctx: Context) -> "NDArray":

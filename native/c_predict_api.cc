@@ -256,3 +256,41 @@ extern "C" MXNET_DLL int MXPredFree(PredictorHandle handle) {
   Py_XDECREF(static_cast<PyObject *>(handle));
   return 0;
 }
+
+/* -- registry introspection (ref: MXListAllOpNames in c_api.cc) ------ */
+static thread_local std::vector<std::string> g_op_names_storage;
+static thread_local std::vector<const char *> g_op_names;
+
+extern "C" MXNET_DLL int MXListAllOpNames(uint32_t *out_size,
+                                          const char ***out_array) {
+  Gil gil;
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.ops.registry");
+  if (!mod) return Fail("import registry");
+  PyObject *names = PyObject_CallMethod(mod, "list_ops", nullptr);
+  Py_DECREF(mod);
+  if (!names) return Fail("list_ops");
+  PyObject *seq = PySequence_Fast(names, "list_ops result");
+  Py_DECREF(names);
+  if (!seq) return Fail("list_ops sequence");
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  g_op_names_storage.clear();
+  g_op_names.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *s = PyUnicode_AsUTF8(PySequence_Fast_GET_ITEM(seq, i));
+    if (!s) {
+      Py_DECREF(seq);
+      return Fail("MXListAllOpNames: undecodable op name");
+    }
+    g_op_names_storage.emplace_back(s);
+  }
+  Py_DECREF(seq);
+  for (const auto &s : g_op_names_storage) g_op_names.push_back(s.c_str());
+  *out_size = static_cast<uint32_t>(g_op_names.size());
+  *out_array = g_op_names.data();
+  return 0;
+}
+
+extern "C" MXNET_DLL int MXGetVersion(int *out) {
+  *out = 10000;  /* 1.0.0 parity surface */
+  return 0;
+}

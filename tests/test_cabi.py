@@ -78,6 +78,24 @@ def test_predictor_partial_out(tmp_path):
     assert pred.get_output(0).shape == (4, 16)
 
 
+def test_list_all_op_names_from_c():
+    """MXListAllOpNames through ctypes on the built .so (in-process:
+    jax already initialized, the shim must cope via PyGILState)."""
+    import ctypes
+
+    lib = ctypes.CDLL(_ensure_lib())
+    n = ctypes.c_uint32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    rc = lib.MXListAllOpNames(ctypes.byref(n), ctypes.byref(arr))
+    assert rc == 0
+    names = {arr[i].decode() for i in range(n.value)}
+    assert "FullyConnected" in names and "Convolution" in names
+    assert n.value > 200  # canonical names (aliases not included)
+    v = ctypes.c_int()
+    assert lib.MXGetVersion(ctypes.byref(v)) == 0
+    assert v.value >= 10000
+
+
 @pytest.mark.slow
 def test_c_program_end_to_end(tmp_path):
     """Compile and run the C client against libmxnet_tpu.so."""

@@ -1,0 +1,70 @@
+"""cpp-package test: train in python, infer through the header-only C++
+frontend compiled against libmxnet_tpu.so (model: the reference's
+cpp-package integration tests, Jenkinsfile:590-597)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_NATIVE = os.path.join(_ROOT, "native")
+
+
+def _ensure_lib():
+    lib = os.path.join(_NATIVE, "libmxnet_tpu.so")
+    if not os.path.exists(lib) or (
+            os.path.getmtime(lib) <
+            os.path.getmtime(os.path.join(_NATIVE, "c_predict_api.cc"))):
+        subprocess.run(["sh", os.path.join(_NATIVE, "build_cabi.sh")],
+                       check=True, capture_output=True)
+    return lib
+
+
+@pytest.mark.slow
+def test_cpp_predictor_end_to_end(tmp_path):
+    _ensure_lib()
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 8).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=2)
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=64)
+    mod.fit(it, num_epoch=8, optimizer_params={"learning_rate": 0.3})
+    prefix = str(tmp_path / "model")
+    arg, aux = mod.get_params()
+    mx.model.save_checkpoint(prefix, 3, net, arg, aux)
+    input_bin = str(tmp_path / "input.bin")
+    x[:4].tofile(input_bin)
+
+    exe = str(tmp_path / "predict_example")
+    subprocess.run(
+        ["g++", "-std=c++17",
+         os.path.join(_ROOT, "cpp-package", "example",
+                      "predict_example.cpp"),
+         "-I" + os.path.join(_ROOT, "cpp-package", "include"),
+         "-I" + os.path.join(_ROOT, "include"),
+         "-o", exe, "-L" + _NATIVE, "-lmxnet_tpu",
+         "-Wl,-rpath," + _NATIVE],
+        check=True, capture_output=True, text=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               PYTHONPATH=_ROOT)
+    out = subprocess.run([exe, prefix, "3", input_bin], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "cpp-package OK" in out.stdout
+    assert "output shape: 4 2" in out.stdout
+    # classes printed by C++ match python inference
+    mod_out = mod.predict(mx.io.NDArrayIter(
+        x[:4], np.zeros(4, np.float32), batch_size=4)).asnumpy()
+    want = mod_out.argmax(axis=1)
+    got = [int(line.split("class ")[1].split()[0])
+           for line in out.stdout.splitlines() if "-> class" in line]
+    np.testing.assert_array_equal(got, want)

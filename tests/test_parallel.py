@@ -148,3 +148,30 @@ def test_dryrun_entrypoints():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_fused_step_observes_set_data():
+    """Parameter.set_data (checkpoint load path) must be picked up by
+    the fused step's version-token fast path."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.parallel.dp import FusedTrainStep
+    from mxnet_tpu.parallel.mesh import make_mesh
+    import jax
+
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=make_mesh((1,), ("dp",),
+                                         jax.devices()[:1]),
+                          learning_rate=0.0, momentum=0.0)
+    x = nd.ones((2, 3))
+    y = nd.zeros((2,))
+    _, logits1 = step(x, y)
+    # overwrite the weight via the checkpoint-load path
+    net.weight.set_data(nd.zeros((2, 3)))
+    net.bias.set_data(nd.zeros((2,)))
+    _, logits2 = step(x, y)
+    np.testing.assert_allclose(logits2.asnumpy(), 0.0, atol=1e-6)
