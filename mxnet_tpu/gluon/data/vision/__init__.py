@@ -1,3 +1,4 @@
 """Vision data (ref: python/mxnet/gluon/data/vision/)."""
-from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageRecordDataset, ImageFolderDataset)
 from . import transforms

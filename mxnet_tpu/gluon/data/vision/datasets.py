@@ -14,9 +14,11 @@ from typing import Callable, Optional
 import numpy as _np
 
 from ....ndarray import array as nd_array
+from .. import dataset as _ds
 from ..dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100"]
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
 
 
 class _LabeledImageDataset(Dataset):
@@ -109,3 +111,64 @@ class CIFAR100(CIFAR10):
     def __init__(self, root="~/.mxnet/datasets/cifar100", train=True,
                  fine_label=False, transform=None):
         super().__init__(root, train, transform)
+
+
+class ImageRecordDataset(_ds.RecordFileDataset):
+    """Image dataset over a .rec packed by im2rec → (image HWC uint8
+    NDArray, label) (ref: gluon/data/vision.py ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import image as _image
+        from .... import recordio as _recordio
+
+        record = super().__getitem__(idx)
+        header, img_bytes = _recordio.unpack(record)
+        label = header.label
+        img = _image.imdecode(img_bytes, flag=self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(_ds.Dataset):
+    """root/category/*.jpg layout → (image, category index)
+    (ref: gluon/data/vision.py ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = {".jpg", ".jpeg", ".png"}
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                ext = os.path.splitext(filename)[1].lower()
+                if ext not in self._exts:
+                    continue
+                self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from .... import image as _image
+
+        img = _image.imread(self.items[idx][0], flag=self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

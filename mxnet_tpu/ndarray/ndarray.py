@@ -174,8 +174,10 @@ class NDArray:
         other._data = _jax().device_put(self._data, other._ctx.jax_device()).astype(
             other._data.dtype
         )
-        other._vt = object()  # bump the write version: consumers that
-        # cache by version token (FusedTrainStep fast path) must observe
+        # full version bump (token + stale producer node), same as every
+        # other in-place write path — version-token consumers
+        # (FusedTrainStep fast path) and autograd both must observe
+        other._bump_version()
         return other
 
     def as_in_context(self, ctx: Context) -> "NDArray":

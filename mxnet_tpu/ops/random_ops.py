@@ -102,6 +102,66 @@ def _sample_normal(key, mu, sigma, shape=(), dtype="float32", **_):
     )
 
 
+def _tail(shape):
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def _bcast(param, tail):
+    return param.reshape(param.shape + (1,) * len(tail))
+
+
+@register("_sample_gamma", rng=True, nondiff=True)
+def _sample_gamma(key, alpha, beta, shape=(), dtype="float32", **_):
+    tail = _tail(shape)
+    g = jax.random.gamma(key, _bcast(alpha, tail),
+                         alpha.shape + tail).astype(np_dtype(dtype))
+    return g * _bcast(beta, tail)
+
+
+@register("_sample_exponential", rng=True, nondiff=True)
+def _sample_exponential(key, lam, shape=(), dtype="float32", **_):
+    tail = _tail(shape)
+    e = jax.random.exponential(key, lam.shape + tail, np_dtype(dtype))
+    return e / _bcast(lam, tail)
+
+
+@register("_sample_poisson", rng=True, nondiff=True)
+def _sample_poisson(key, lam, shape=(), dtype="float32", **_):
+    tail = _tail(shape)
+    return jax.random.poisson(key, _bcast(lam, tail),
+                              lam.shape + tail).astype(np_dtype(dtype))
+
+
+@register("_sample_negative_binomial", rng=True, nondiff=True)
+def _sample_negative_binomial(key, k, p, shape=(), dtype="float32", **_):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p)) (same mixture the scalar
+    # _random_negative_binomial uses)
+    tail = _tail(shape)
+    kg, kp = jax.random.split(key)
+    kk = _bcast(k, tail)
+    pp = _bcast(p, tail)
+    rate = jax.random.gamma(kg, kk, k.shape + tail) * (1.0 - pp) / pp
+    return jax.random.poisson(kp, rate,
+                              k.shape + tail).astype(np_dtype(dtype))
+
+
+@register("_sample_generalized_negative_binomial", rng=True, nondiff=True)
+def _sample_generalized_negative_binomial(key, mu, alpha, shape=(),
+                                          dtype="float32", **_):
+    # GNB(mu, alpha): Poisson with Gamma(1/alpha, mu*alpha) rate
+    tail = _tail(shape)
+    kg, kp = jax.random.split(key)
+    mm = _bcast(mu, tail)
+    aa = _bcast(alpha, tail)
+    inv_a = 1.0 / jax.numpy.maximum(aa, 1e-12)
+    # divide by the same clamped quantity so alpha→0 degrades to
+    # Poisson(mu) (mean mu), matching the scalar sampler
+    rate = jax.random.gamma(kg, jax.numpy.broadcast_to(
+        inv_a, mu.shape + tail)) * mm / inv_a
+    return jax.random.poisson(kp, rate,
+                              mu.shape + tail).astype(np_dtype(dtype))
+
+
 @register("_shuffle", aliases=("shuffle",), rng=True, nondiff=True)
 def _shuffle(key, data, **_):
     return jax.random.permutation(key, data, axis=0)

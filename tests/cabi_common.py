@@ -1,0 +1,42 @@
+"""Shared helpers for the C-ABI / cpp-package tests: library build and
+the train-and-checkpoint fixture."""
+import os
+import subprocess
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+NATIVE = os.path.join(ROOT, "native")
+
+
+def ensure_lib() -> str:
+    """(Re)build libmxnet_tpu.so when the source is newer."""
+    lib = os.path.join(NATIVE, "libmxnet_tpu.so")
+    src = os.path.join(NATIVE, "c_predict_api.cc")
+    if not os.path.exists(lib) or \
+            os.path.getmtime(lib) < os.path.getmtime(src):
+        subprocess.run(["sh", os.path.join(NATIVE, "build_cabi.sh")],
+                       check=True, capture_output=True)
+    return lib
+
+
+def train_and_save(tmp_path, epoch=1):
+    """Train the canonical 8→16→2 MLP and checkpoint it; returns
+    (prefix, x, y, module)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 8).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=2)
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=64)
+    mod.fit(it, num_epoch=6, optimizer_params={"learning_rate": 0.3})
+    prefix = str(tmp_path / "model")
+    arg, aux = mod.get_params()
+    mx.model.save_checkpoint(prefix, epoch, net, arg, aux)
+    return prefix, x, y, mod

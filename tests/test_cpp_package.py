@@ -10,37 +10,15 @@ import pytest
 
 import mxnet_tpu as mx
 
-_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
-_NATIVE = os.path.join(_ROOT, "native")
-
-
-def _ensure_lib():
-    lib = os.path.join(_NATIVE, "libmxnet_tpu.so")
-    if not os.path.exists(lib) or (
-            os.path.getmtime(lib) <
-            os.path.getmtime(os.path.join(_NATIVE, "c_predict_api.cc"))):
-        subprocess.run(["sh", os.path.join(_NATIVE, "build_cabi.sh")],
-                       check=True, capture_output=True)
-    return lib
+from cabi_common import (NATIVE as _NATIVE, ROOT as _ROOT,
+                         ensure_lib as _ensure_lib,
+                         train_and_save as _train_and_save)
 
 
 @pytest.mark.slow
 def test_cpp_predictor_end_to_end(tmp_path):
     _ensure_lib()
-    rng = np.random.RandomState(0)
-    x = rng.randn(256, 8).astype(np.float32)
-    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
-    data = mx.sym.Variable("data")
-    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
-    act = mx.sym.Activation(fc1, name="relu1", act_type="relu")
-    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=2)
-    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
-    mod = mx.mod.Module(net, context=mx.cpu())
-    it = mx.io.NDArrayIter(x, y, batch_size=64)
-    mod.fit(it, num_epoch=8, optimizer_params={"learning_rate": 0.3})
-    prefix = str(tmp_path / "model")
-    arg, aux = mod.get_params()
-    mx.model.save_checkpoint(prefix, 3, net, arg, aux)
+    prefix, x, y, mod = _train_and_save(tmp_path, epoch=3)
     input_bin = str(tmp_path / "input.bin")
     x[:4].tofile(input_bin)
 

@@ -294,3 +294,55 @@ def test_resnet50_param_count():
     n = sum(int(np.prod(p.shape)) for p in net.collect_params().values())
     # torchvision/reference resnet50 ≈ 25.5M params
     assert 25_000_000 < n < 26_500_000, n
+
+
+def test_image_record_and_folder_datasets(tmp_path):
+    """RecordFileDataset / ImageRecordDataset / ImageFolderDataset
+    (ref: gluon/data/vision.py) feed DataLoader end-to-end."""
+    import io as _io
+
+    import numpy as np
+    from PIL import Image
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, recordio
+
+    def jpeg(seed):
+        yy, xx = np.mgrid[0:32, 0:32]
+        img = np.stack([(yy + seed * 9) % 256, (xx * 2) % 256,
+                        (yy + xx) % 256], axis=2).astype(np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG")
+        return buf.getvalue()
+
+    # .rec + .idx
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(8):
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 2), i, 0), jpeg(i)))
+    w.close()
+
+    ds = gluon.data.vision.ImageRecordDataset(rec)
+    assert len(ds) == 8
+    img, label = ds[3]
+    assert img.shape == (32, 32, 3)
+    assert label == 1.0
+    loader = gluon.data.DataLoader(
+        ds.transform(lambda im, lab: (im.astype("float32"), lab)),
+        batch_size=4)
+    batches = list(loader)
+    assert batches[0][0].shape == (4, 32, 32, 3)
+
+    # folder layout
+    for cls in ("cats", "dogs"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            (d / ("%d.jpg" % i)).write_bytes(jpeg(i))
+    fds = gluon.data.vision.ImageFolderDataset(str(tmp_path / "imgs"))
+    assert fds.synsets == ["cats", "dogs"]
+    assert len(fds) == 6
+    img, label = fds[5]
+    assert img.shape == (32, 32, 3) and label == 1

@@ -286,3 +286,37 @@ def test_upsampling_pad():
                  constant_value=9.0)
     assert out.shape == (1, 1, 4, 4)
     assert out.asnumpy()[0, 0, 0, 0] == 9.0
+
+
+def test_multisample_ops():
+    """Per-row parameterized samplers (ref: random/multisample_op.cc)."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    mx_alpha = nd.array(np.array([1.0, 10.0], np.float32))
+    mx_beta = nd.array(np.array([1.0, 2.0], np.float32))
+    g = nd._sample_gamma(mx_alpha, mx_beta, shape=(2000,))
+    assert g.shape == (2, 2000)
+    m = g.asnumpy().mean(axis=1)
+    np.testing.assert_allclose(m, [1.0, 20.0], rtol=0.15)  # E=αβ
+
+    lam = nd.array(np.array([1.0, 5.0], np.float32))
+    e = nd._sample_exponential(lam, shape=(2000,))
+    np.testing.assert_allclose(e.asnumpy().mean(axis=1), [1.0, 0.2],
+                               rtol=0.15)
+    p = nd._sample_poisson(lam, shape=(2000,))
+    np.testing.assert_allclose(p.asnumpy().mean(axis=1), [1.0, 5.0],
+                               rtol=0.15)
+
+    k = nd.array(np.array([4.0], np.float32))
+    pr = nd.array(np.array([0.5], np.float32))
+    nb = nd._sample_negative_binomial(k, pr, shape=(4000,))
+    # E = k(1-p)/p = 4
+    np.testing.assert_allclose(nb.asnumpy().mean(), 4.0, rtol=0.15)
+
+    mu = nd.array(np.array([3.0], np.float32))
+    alpha = nd.array(np.array([0.2], np.float32))
+    gnb = nd._sample_generalized_negative_binomial(mu, alpha,
+                                                   shape=(4000,))
+    np.testing.assert_allclose(gnb.asnumpy().mean(), 3.0, rtol=0.15)
