@@ -53,6 +53,7 @@ from . import gluon
 from . import rnn
 from . import operator
 from . import name
+from . import attribute
 from . import engine
 from . import rtc
 from . import text

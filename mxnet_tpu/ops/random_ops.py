@@ -86,20 +86,18 @@ def _multinomial(key, data, shape=(), get_prob=False, dtype="int32", **_):
 # per-row parameterised "multisample" ops (ref: multisample_op.cc)
 @register("_sample_uniform", rng=True, nondiff=True)
 def _sample_uniform(key, low, high, shape=(), dtype="float32", **_):
-    tail = (shape,) if isinstance(shape, int) else tuple(shape)
-    u = jax.random.uniform(key, low.shape + tail, np_dtype(dtype))
-    return low.reshape(low.shape + (1,) * len(tail)) + u * (high - low).reshape(
-        low.shape + (1,) * len(tail)
-    )
+    tail = _tail(shape)
+    u = jax.random.uniform(key, low.shape + tail)
+    return (_bcast(low, tail)
+            + u * _bcast(high - low, tail)).astype(np_dtype(dtype))
 
 
 @register("_sample_normal", rng=True, nondiff=True)
 def _sample_normal(key, mu, sigma, shape=(), dtype="float32", **_):
-    tail = (shape,) if isinstance(shape, int) else tuple(shape)
-    z = jax.random.normal(key, mu.shape + tail, np_dtype(dtype))
-    return mu.reshape(mu.shape + (1,) * len(tail)) + z * sigma.reshape(
-        sigma.shape + (1,) * len(tail)
-    )
+    tail = _tail(shape)
+    z = jax.random.normal(key, mu.shape + tail)
+    return (_bcast(mu, tail)
+            + z * _bcast(sigma, tail)).astype(np_dtype(dtype))
 
 
 def _tail(shape):
@@ -113,16 +111,17 @@ def _bcast(param, tail):
 @register("_sample_gamma", rng=True, nondiff=True)
 def _sample_gamma(key, alpha, beta, shape=(), dtype="float32", **_):
     tail = _tail(shape)
-    g = jax.random.gamma(key, _bcast(alpha, tail),
-                         alpha.shape + tail).astype(np_dtype(dtype))
-    return g * _bcast(beta, tail)
+    g = jax.random.gamma(key, _bcast(alpha, tail), alpha.shape + tail)
+    # arithmetic first, cast last: mixing with the fp32 params would
+    # silently promote a requested fp16 result back to fp32
+    return (g * _bcast(beta, tail)).astype(np_dtype(dtype))
 
 
 @register("_sample_exponential", rng=True, nondiff=True)
 def _sample_exponential(key, lam, shape=(), dtype="float32", **_):
     tail = _tail(shape)
-    e = jax.random.exponential(key, lam.shape + tail, np_dtype(dtype))
-    return e / _bcast(lam, tail)
+    e = jax.random.exponential(key, lam.shape + tail)
+    return (e / _bcast(lam, tail)).astype(np_dtype(dtype))
 
 
 @register("_sample_poisson", rng=True, nondiff=True)

@@ -1,0 +1,96 @@
+"""SequentialModule + PythonModule tests (models: reference
+tests/python/unittest/test_module.py sequential/python module cases)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    return x, y
+
+
+def test_sequential_module_fit():
+    """Two chained symbol modules train end-to-end through fit."""
+    net1 = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(net1, name="fc1", num_hidden=16)
+    net1 = mx.sym.Activation(net1, name="relu1", act_type="relu")
+
+    net2 = mx.sym.Variable("fc1relu")
+    net2 = mx.sym.FullyConnected(net2, name="fc2", num_hidden=2)
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+
+    mod1 = mx.mod.Module(net1, data_names=["data"], label_names=[],
+                         context=mx.cpu())
+    mod2 = mx.mod.Module(net2, data_names=["fc1relu"],
+                         label_names=["softmax_label"], context=mx.cpu())
+    seq = mx.mod.SequentialModule()
+    seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+
+    x, y = _data()
+    it = mx.io.NDArrayIter(x, y, batch_size=64)
+    seq.fit(it, num_epoch=20, optimizer_params={"learning_rate": 0.3})
+    score = seq.score(it, mx.metric.Accuracy())
+    assert score[0][1] > 0.9, score
+
+
+def test_sequential_module_properties():
+    net1 = mx.sym.FullyConnected(mx.sym.Variable("data"), name="fc1",
+                                 num_hidden=4)
+    mod1 = mx.mod.Module(net1, data_names=["data"], label_names=[],
+                         context=mx.cpu())
+    seq = mx.mod.SequentialModule().add(mod1)
+    assert seq.data_names == ["data"]
+    seq.bind(data_shapes=[("data", (2, 8))])
+    assert seq.output_shapes[0][1] == (2, 4)
+
+
+def test_python_loss_module_in_sequence():
+    """Symbol feature module + python loss head: the reference's
+    PythonLossModule workflow (python_module.py:240)."""
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, name="fc", num_hidden=2)
+    mod = mx.mod.Module(net, data_names=["data"], label_names=[],
+                        context=mx.cpu())
+    loss = mx.mod.PythonLossModule()
+    seq = mx.mod.SequentialModule()
+    seq.add(mod).add(loss, take_labels=True, auto_wiring=True)
+
+    x, y = _data()
+    it = mx.io.NDArrayIter(x, y, batch_size=64,
+                           label_name="softmax_label")
+    seq.fit(it, num_epoch=10, optimizer_params={"learning_rate": 0.2})
+    # predictions from the chained forward
+    it.reset()
+    batch = next(iter(it))
+    seq.forward(batch, is_train=False)
+    out = seq.get_outputs()[0].asnumpy()
+    acc = (out.argmax(axis=1) == batch.label[0].asnumpy()).mean()
+    assert acc > 0.9, acc
+
+
+def test_python_loss_module_custom_grad():
+    calls = []
+
+    def grad_func(scores, labels):
+        calls.append(1)
+        s = scores.asnumpy()
+        lab = labels.asnumpy().astype(int)
+        e = np.exp(s - s.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        p[np.arange(len(lab)), lab] -= 1
+        return p / len(lab)
+
+    loss = mx.mod.PythonLossModule(grad_func=grad_func)
+    loss.bind(data_shapes=[("data", (4, 2))],
+              label_shapes=[("softmax_label", (4,))])
+    loss.init_params()
+    batch = mx.io.DataBatch([nd.ones((4, 2))], [nd.zeros((4,))])
+    loss.forward(batch, is_train=True)
+    loss.backward()
+    g = loss.get_input_grads()[0].asnumpy()
+    assert calls and g.shape == (4, 2)
+    np.testing.assert_allclose(g.sum(), 0.0, atol=1e-6)
