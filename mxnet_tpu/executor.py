@@ -42,9 +42,53 @@ def _jax():
 
 
 # ---------------------------------------------------------------------------
+# PlaceDevice: ctx_group → per-node device assignment
+# ---------------------------------------------------------------------------
+def place_nodes(symbol, default_ctx: Context,
+                group2ctx: Optional[Dict[str, Context]]):
+    """The PlaceDevice pass (ref: src/executor/graph_executor.cc:406,
+    nnvm PlaceDevice): assign every graph node a Context.
+
+    Op nodes take their ``__ctx_group__`` attribute's mapped context;
+    variables inherit the context of their first consumer (the reference
+    allocates inputs on the consuming op's device); everything else gets
+    ``default_ctx``.  Returns ``None`` when placement is trivial (no group
+    maps away from the default) so callers keep the single-program jit
+    path."""
+    if not group2ctx:
+        return None
+    topo = symbol._topo()
+    placement: Dict[int, Context] = {}
+    nontrivial = False
+    for node in topo:
+        group = node.attrs.get("__ctx_group__", node.attrs.get("ctx_group"))
+        if node.is_variable and group is None:
+            continue  # un-grouped variables inherit a consumer below
+        ctx = group2ctx.get(str(group), default_ctx) if group else default_ctx
+        placement[id(node)] = ctx
+        if ctx != default_ctx:
+            nontrivial = True
+    if not nontrivial:
+        return None
+    # un-grouped variables inherit first consumer's placement
+    # (cross_device_copy boundaries then only appear between op groups,
+    # ref: src/operator/cross_device_copy.cc)
+    for node in topo:
+        if node.is_variable:
+            continue
+        for parent, _ in node.inputs:
+            if parent.is_variable and id(parent) not in placement:
+                placement[id(parent)] = placement[id(node)]
+    for node in topo:
+        placement.setdefault(id(node), default_ctx)
+    return placement
+
+
+# ---------------------------------------------------------------------------
 # pure graph evaluator
 # ---------------------------------------------------------------------------
-def build_graph_eval(symbol, collect_internals: bool = False) -> Callable:
+def build_graph_eval(symbol, collect_internals: bool = False,
+                     placement: Optional[Dict[int, Context]] = None) -> Callable:
     """Build fn(arg_vals, aux_vals, rng_key, training) ->
     (outputs: list, aux_updates: dict name→val).  Pure; jit-traceable.
 
@@ -52,7 +96,14 @@ def build_graph_eval(symbol, collect_internals: bool = False) -> Callable:
     dict name→val of every non-variable node's outputs (named
     ``<node>_output`` / ``<node>_output<k>`` like the reference's
     executor output naming) — the data source for Monitor taps
-    (ref: GraphExecutor::ExecuteMonCallback, graph_executor.cc:1418)."""
+    (ref: GraphExecutor::ExecuteMonCallback, graph_executor.cc:1418).
+
+    With ``placement`` (id(node) → Context, from :func:`place_nodes`) the
+    evaluator inserts a ``jax.device_put`` whenever a value crosses a
+    device boundary — the cross_device_copy analogue (ref:
+    src/operator/cross_device_copy.cc).  ``device_put`` is linear with a
+    transpose rule, so the vjp replays the copies in reverse exactly like
+    the reference's backward copy nodes."""
     import jax
 
     topo = symbol._topo()
@@ -81,6 +132,15 @@ def build_graph_eval(symbol, collect_internals: bool = False) -> Callable:
             if op.train_aware:
                 params["_training"] = training
             args = [env[id(p)][oi] for p, oi in node.inputs]
+            if placement is not None:
+                # pin every input to the node's device: cross-group edges
+                # get a real transfer, same-device edges a no-op.  Pinning
+                # unconditionally (rather than only on static group
+                # boundaries) also repairs buffers that drifted to the
+                # default device through host-side writes (initializers,
+                # set_params)
+                dev = placement[id(node)].jax_device()
+                args = [jax.device_put(a, dev) for a in args]
             if op.rng:
                 args = [jax.random.fold_in(rng_key, node_index[id(node)])] + args
             out = op.fn(*args, **params)
@@ -110,7 +170,8 @@ class Executor:
 
     def __init__(self, symbol, ctx: Context, arg_dict: Dict[str, NDArray],
                  grad_dict: Dict[str, Optional[NDArray]],
-                 aux_dict: Dict[str, NDArray], grad_req):
+                 aux_dict: Dict[str, NDArray], grad_req, group2ctx=None,
+                 placement=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
         self.arg_dict = arg_dict
@@ -125,15 +186,22 @@ class Executor:
             grad_req = dict(zip(self._arg_names, grad_req))
         self._grad_req = grad_req
         self._rng_counter = 0
+        self._group2ctx = dict(group2ctx) if group2ctx else None
+        self._placement = (placement if placement is not None else
+                           place_nodes(symbol, self._ctx, self._group2ctx))
 
-        eval_fn = build_graph_eval(symbol)
+        eval_fn = build_graph_eval(symbol, placement=self._placement)
         jax = _jax()
 
         def fwd(training):
             def f(arg_vals, aux_vals, key):
                 return eval_fn(arg_vals, aux_vals, key, training)
 
-            return jax.jit(f)
+            # model-parallel (placed) graphs execute op-by-op so every
+            # node really runs on its ctx_group device, matching the
+            # reference's per-device engine streams; the single-device
+            # path stays one fused XLA program
+            return f if self._placement is not None else jax.jit(f)
 
         self._fwd_eval = fwd(False)
         self._fwd_train = fwd(True)
@@ -152,7 +220,7 @@ class Executor:
     # -- binding entry points ------------------------------------------
     @staticmethod
     def simple_bind(symbol, ctx=None, grad_req="write", type_dict=None,
-                    shared_exec=None, **kwargs) -> "Executor":
+                    shared_exec=None, group2ctx=None, **kwargs) -> "Executor":
         from .symbol.infer import infer_shape, infer_type
 
         ctx = ctx or current_context()
@@ -161,6 +229,23 @@ class Executor:
         type_dict = type_dict or {}
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
+        # per-variable contexts from the PlaceDevice pass (reference
+        # allocates each input on its consumer's device,
+        # graph_executor.cc InitArguments)
+        placement = place_nodes(symbol, ctx, group2ctx)
+        var_ctx = {}
+        if placement is not None:
+            for node in symbol._topo():
+                if node.is_variable:
+                    var_ctx[node.name] = placement[id(node)]
+
+        jax = _jax()
+
+        def alloc(shape, actx, dt=_np.float32):
+            arr = _nd_mod.zeros(shape, ctx=actx, dtype=dt)
+            if actx is not ctx:  # placed variable: commit the buffer too
+                arr._data = jax.device_put(arr._data, actx.jax_device())
+            return arr
 
         arg_dict: Dict[str, NDArray] = {}
         grad_dict: Dict[str, Optional[NDArray]] = {}
@@ -168,19 +253,23 @@ class Executor:
             if shape is None:
                 raise MXNetError("simple_bind: could not infer shape of %r" % name)
             dt = np_dtype(type_dict.get(name, _np.float32))
-            arg_dict[name] = _nd_mod.zeros(shape, ctx=ctx, dtype=dt)
+            actx = var_ctx.get(name, ctx)
+            arg_dict[name] = alloc(shape, actx, dt)
             req = grad_req if isinstance(grad_req, str) else grad_req.get(name, "null")
-            grad_dict[name] = (
-                _nd_mod.zeros(shape, ctx=ctx, dtype=dt) if req != "null" else None
-            )
+            grad_dict[name] = alloc(shape, actx, dt) if req != "null" else None
         aux_dict = {}
         for name, shape in zip(aux_names, aux_shapes):
-            aux_dict[name] = _nd_mod.zeros(shape, ctx=ctx)
-        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)
+            aux_dict[name] = alloc(shape, var_ctx.get(name, ctx))
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
+                        group2ctx=group2ctx, placement=placement)
 
     @staticmethod
     def bind(symbol, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None) -> "Executor":
+             aux_states=None, group2ctx=None, shared_exec=None) -> "Executor":
+        """ref: python/mxnet/symbol.py bind.  ``shared_exec`` (reference:
+        workspace/memory-pool sharing, graph_executor.cc:913) is accepted
+        for API parity but has no effect — XLA owns buffer allocation, so
+        there is no user-visible pool to share."""
         ctx = ctx or current_context()
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
@@ -210,7 +299,8 @@ class Executor:
                 from .symbol.infer import infer_shape
 
                 raise MXNetError("bind: missing aux state %r" % name)
-        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
+                        group2ctx=group2ctx)
 
     # -- execution ------------------------------------------------------
     def _next_key(self):
@@ -267,12 +357,14 @@ class Executor:
     def _forward_monitored(self, is_train):
         jax = _jax()
         if self._monitor_eval is None:
-            eval_int = build_graph_eval(self._symbol, collect_internals=True)
+            eval_int = build_graph_eval(self._symbol, collect_internals=True,
+                                        placement=self._placement)
 
             def f(arg_vals, aux_vals, key, training):
                 return eval_int(arg_vals, aux_vals, key, training)
 
-            self._monitor_eval = jax.jit(f, static_argnums=3)
+            self._monitor_eval = (f if self._placement is not None
+                                  else jax.jit(f, static_argnums=3))
         outs, aux_upd, internals = self._monitor_eval(
             self._arg_vals(), self._aux_vals(), self._next_key(),
             bool(is_train))
@@ -294,7 +386,8 @@ class Executor:
         (same rng, same batch)."""
         jax = _jax()
         eval_fn = build_graph_eval(self._symbol,
-                                   collect_internals=collect_internals)
+                                   collect_internals=collect_internals,
+                                   placement=self._placement)
         grad_names = self._grad_names
 
         def train_step(arg_vals, aux_vals, key, out_cots):
@@ -314,7 +407,7 @@ class Executor:
             (grads,) = vjp_fn((cots,) + tuple(zero_rest))
             return (outs, grads) + tuple(res[1:])
 
-        return jax.jit(train_step)
+        return train_step if self._placement is not None else jax.jit(train_step)
 
     def _train_step_monitored(self, cots):
         if self._monitor_train_fn is None:
@@ -413,7 +506,8 @@ class Executor:
         ref: graph_executor.cc:1572 Reshape sharing memory pools)."""
         new_shapes = {k: tuple(v) for k, v in kwargs.items()}
         ex = Executor.simple_bind(self._symbol, ctx=self._ctx,
-                                  grad_req=self._grad_req, **new_shapes)
+                                  grad_req=self._grad_req,
+                                  group2ctx=self._group2ctx, **new_shapes)
         for name, arr in self.arg_dict.items():
             if name in ex.arg_dict and ex.arg_dict[name].shape == arr.shape:
                 arr.copyto(ex.arg_dict[name])

@@ -50,7 +50,18 @@ class Module(BaseModule):
             self._label_names = [n for n in symbol.list_arguments()
                                  if n.endswith("_label")]
         self._fixed_param_names = list(fixed_param_names or [])
+        # group2ctxs: dict (one mapping for the module) or list of dicts
+        # (reference: one per context; our single-executor design uses the
+        # first — per-replica remapping has no TPU analogue since replicas
+        # are mesh shards, not distinct processes)
+        if isinstance(group2ctxs, (list, tuple)):
+            group2ctxs = group2ctxs[0] if group2ctxs else None
         self._group2ctxs = group2ctxs
+        if self._group2ctxs and len(self._context_list) > 1:
+            raise ValueError(
+                "group2ctxs model parallelism cannot be combined with "
+                "multi-context data parallelism in this build; use "
+                "parallel.FusedTrainStep with a dp×mp mesh instead")
 
         arg_names = symbol.list_arguments()
         self._param_names = [
@@ -130,7 +141,8 @@ class Module(BaseModule):
                     req[name] = grad_req if isinstance(grad_req, str) else grad_req.get(name, "write")
 
         self._exec = Executor.simple_bind(self._symbol, ctx=self._ctx,
-                                          grad_req=req, **shapes)
+                                          grad_req=req,
+                                          group2ctx=self._group2ctxs, **shapes)
         if shared_module is not None and shared_module._exec is not None:
             # share parameter cells with the shared module (bucketing path,
             # ref: graph_executor.cc:1572 shared_exec memory sharing) — the

@@ -218,21 +218,25 @@ class Symbol:
 
     # -- binding -------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    shared_exec=None, shared_data_arrays=None, **kwargs):
+                    shared_exec=None, shared_data_arrays=None, group2ctx=None,
+                    **kwargs):
         """Allocate arrays from shapes and bind (ref: GraphExecutor::Init,
-        src/executor/graph_executor.cc:512; python symbol.py simple_bind)."""
+        src/executor/graph_executor.cc:512; python symbol.py simple_bind).
+        ``group2ctx`` maps ``ctx_group`` attribute values to Contexts for
+        model parallelism (PlaceDevice, graph_executor.cc:406)."""
         from ..executor import Executor
 
         return Executor.simple_bind(self, ctx=ctx, grad_req=grad_req,
                                     type_dict=type_dict, shared_exec=shared_exec,
-                                    **kwargs)
+                                    group2ctx=group2ctx, **kwargs)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None, shared_exec=None, **kwargs):
+             aux_states=None, shared_exec=None, group2ctx=None, **kwargs):
         from ..executor import Executor
 
         return Executor.bind(self, ctx=ctx, args=args, args_grad=args_grad,
-                             grad_req=grad_req, aux_states=aux_states)
+                             grad_req=grad_req, aux_states=aux_states,
+                             group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         ex = self.bind(ctx=ctx, args=kwargs)

@@ -1,0 +1,213 @@
+"""Model parallelism via ctx_group (ref: tests/python/unittest/
+test_model_parallel.py, src/executor/graph_executor.cc:406 PlaceDevice).
+
+Runs on the 8-device virtual CPU mesh from conftest: ctx groups map to
+distinct virtual devices, cross-group values move via device_put (the
+cross_device_copy analogue)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _dev_of(arr):
+    (dev,) = arr._data.devices()
+    return dev
+
+
+def test_chain_forward_backward_matches_single_device():
+    # reference test_model_parallel.py test_chain, adapted shapes
+    n, m = 4, 5
+    data1 = sym.Variable("data1")
+    data2 = sym.Variable("data2")
+    data3 = sym.Variable("data3")
+    with sym.AttrScope(ctx_group="dev1"):
+        net = data1 + data2
+        net = net * 3.0
+    with sym.AttrScope(ctx_group="dev2"):
+        net = net + data3
+
+    arr = [mx.nd.ones((n, m)) * (i + 1) for i in range(3)]
+    arr_grad = [mx.nd.zeros((n, m)) for _ in range(3)]
+
+    exec1 = net.bind(mx.cpu(),
+                     args=dict(zip(["data1", "data2", "data3"], arr)),
+                     args_grad=dict(zip(["data1", "data2", "data3"], arr_grad)),
+                     group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    out1 = exec1.forward(is_train=True)[0].asnumpy()
+    exec1.backward([mx.nd.ones((n, m)) * 2.0])
+
+    # single-device reference run
+    arr_s = [mx.nd.ones((n, m)) * (i + 1) for i in range(3)]
+    grad_s = [mx.nd.zeros((n, m)) for _ in range(3)]
+    exec2 = net.bind(mx.cpu(),
+                     args=dict(zip(["data1", "data2", "data3"], arr_s)),
+                     args_grad=dict(zip(["data1", "data2", "data3"], grad_s)))
+    out2 = exec2.forward(is_train=True)[0].asnumpy()
+    exec2.backward([mx.nd.ones((n, m)) * 2.0])
+
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+    np.testing.assert_allclose(out1, ((1 + 2) * 3.0 + 3) * np.ones((n, m)))
+    for g1, g2 in zip(arr_grad, grad_s):
+        np.testing.assert_allclose(g1.asnumpy(), g2.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(arr_grad[0].asnumpy(), 2 * 3.0 * np.ones((n, m)))
+
+
+def test_placement_is_real():
+    """Args land on their group's device; ungrouped stay on default."""
+    import jax
+
+    devs = jax.devices()
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    with sym.AttrScope(ctx_group="dev1"):
+        h = sym.FullyConnected(a, num_hidden=8, name="fc1")
+    with sym.AttrScope(ctx_group="dev2"):
+        out = sym.FullyConnected(h + b, num_hidden=4, name="fc2")
+
+    ex = out.simple_bind(mx.cpu(0), a=(2, 16), b=(2, 8),
+                         group2ctx={"dev1": mx.cpu(2), "dev2": mx.cpu(3)})
+    assert _dev_of(ex.arg_dict["fc1_weight"]) == devs[2]
+    assert _dev_of(ex.arg_dict["a"]) == devs[2]
+    assert _dev_of(ex.arg_dict["fc2_weight"]) == devs[3]
+    outs = ex.forward(is_train=True)
+    assert outs[0].shape == (2, 4)
+    ex.backward([mx.nd.ones((2, 4))])
+    # gradients come back on the argument's device
+    assert _dev_of(ex.grad_dict["fc1_weight"]) == devs[2]
+
+
+def test_variable_own_ctx_group_wins():
+    """A ctx_group set on the Variable itself overrides consumer
+    inheritance (reference PlaceDevice honors the node's own group)."""
+    import jax
+
+    devs = jax.devices()
+    with sym.AttrScope(ctx_group="wgroup"):
+        w = sym.Variable("w")
+    x = sym.Variable("x")
+    with sym.AttrScope(ctx_group="opgroup"):
+        out = sym.dot(x, w)
+    ex = out.simple_bind(mx.cpu(0), x=(3, 4), w=(4, 5),
+                         group2ctx={"wgroup": mx.cpu(4),
+                                    "opgroup": mx.cpu(5)})
+    assert _dev_of(ex.arg_dict["w"]) == devs[4]
+    assert _dev_of(ex.arg_dict["x"]) == devs[5]
+
+
+def test_monitor_on_placed_executor():
+    """Monitor taps work on a model-parallel executor (no jit over
+    mixed-device inputs)."""
+    a = sym.Variable("a")
+    with sym.AttrScope(ctx_group="dev1"):
+        h = sym.FullyConnected(a, num_hidden=4, name="fcm1")
+    with sym.AttrScope(ctx_group="dev2"):
+        o = sym.Activation(h, act_type="tanh", name="actm")
+    ex = o.simple_bind(mx.cpu(0), a=(2, 3),
+                       group2ctx={"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward(is_train=True)
+    assert any(n.startswith("fcm1") for n in seen)
+    assert any(n.startswith("actm") for n in seen)
+
+
+def test_module_group2ctxs_trains():
+    """Reference example/model-parallel style: an MLP split over two
+    groups trains through Module with numerics matching the unplaced run."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    N, D, H, C = 32, 10, 16, 3
+    X = np.random.randn(N, D).astype("float32")
+    W = np.random.randn(D, C)
+    y = X @ W
+    y = y.argmax(axis=1).astype("float32")
+
+    def build():
+        data = sym.Variable("data")
+        with sym.AttrScope(ctx_group="dev1"):
+            h = sym.Activation(
+                sym.FullyConnected(data, num_hidden=H, name="fc1"),
+                act_type="relu")
+        with sym.AttrScope(ctx_group="dev2"):
+            logits = sym.FullyConnected(h, num_hidden=C, name="fc2")
+        return sym.SoftmaxOutput(logits, sym.Variable("softmax_label"),
+                                 name="softmax")
+
+    def train(group2ctxs):
+        np.random.seed(0)
+        mx.random.seed(0)
+        mod = mx.mod.Module(build(), context=mx.cpu(0),
+                            group2ctxs=group2ctxs)
+        it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+        mod.fit(it, num_epoch=10,
+                optimizer="sgd", optimizer_params={"learning_rate": 0.2},
+                initializer=mx.init.Xavier(rnd_type="gaussian",
+                                           factor_type="in", magnitude=2),
+                eval_metric="acc")
+        params, _ = mod.get_params()
+        score = mod.score(it, mx.metric.Accuracy())
+        return params, dict(score)["accuracy"]
+
+    p_mp, acc_mp = train({"dev1": mx.cpu(1), "dev2": mx.cpu(2)})
+    p_sd, acc_sd = train(None)
+    assert acc_mp > 0.6
+    assert abs(acc_mp - acc_sd) < 1e-6
+    for k in p_sd:
+        np.testing.assert_allclose(p_mp[k].asnumpy(), p_sd[k].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_model_parallel_lstm():
+    """Reference example/model-parallel/lstm/lstm.py:65-75 pattern: each
+    LSTM layer + the decoder in its own ctx group, trained end-to-end;
+    numerics must match the single-device run."""
+    from mxnet_tpu import rnn
+
+    np.random.seed(0)
+    T, B, D, H, C = 5, 8, 6, 12, 4
+    X = np.random.randn(16, T, D).astype("float32")
+    y = np.random.randint(0, C, (16,)).astype("float32")
+
+    def build():
+        data = sym.Variable("data")
+        stack = rnn.SequentialRNNCell()
+        for i in range(2):
+            with sym.AttrScope(ctx_group="layer%d" % i):
+                stack.add(rnn.LSTMCell(H, prefix="lstm%d_" % i))
+        outputs, _ = stack.unroll(T, inputs=data, layout="NTC",
+                                  merge_outputs=True)
+        with sym.AttrScope(ctx_group="decode"):
+            last = sym.SequenceLast(sym.transpose(outputs, axes=(1, 0, 2)))
+            logits = sym.FullyConnected(last, num_hidden=C, name="cls")
+        return sym.SoftmaxOutput(logits, sym.Variable("softmax_label"),
+                                 name="softmax")
+
+    def train(group2ctxs):
+        np.random.seed(0)
+        mx.random.seed(0)
+        mod = mx.mod.Module(build(), context=mx.cpu(0),
+                            group2ctxs=group2ctxs)
+        it = mx.io.NDArrayIter(X, y, batch_size=B,
+                               label_name="softmax_label")
+        mod.fit(it, num_epoch=3, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                initializer=mx.init.Xavier())
+        params, _ = mod.get_params()
+        return params
+
+    g2c = {"layer0": mx.cpu(1), "layer1": mx.cpu(2), "decode": mx.cpu(3)}
+    p_mp = train(g2c)
+    p_sd = train(None)
+    for k in p_sd:
+        np.testing.assert_allclose(p_mp[k].asnumpy(), p_sd[k].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_group2ctxs_with_dp_raises():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=2)
+    with pytest.raises(ValueError):
+        mx.mod.Module(out, context=[mx.cpu(0), mx.cpu(1)],
+                      group2ctxs={"dev1": mx.cpu(2)})
