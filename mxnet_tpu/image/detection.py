@@ -28,6 +28,14 @@ __all__ = [
 ]
 
 
+def _np_rng():
+    """Numpy Generator seeded from the ``random`` module stream, so
+    ``random.seed(n)`` reproduces the whole detection pipeline (flip and
+    select draw from ``random`` directly; the vectorized samplers draw
+    from this derived generator)."""
+    return np.random.default_rng(pyrandom.getrandbits(63))
+
+
 class DetAugmenter(object):
     """Detection augmenter: __call__(src, label) → (src, label)
     (ref: detection.py:39)."""
@@ -105,12 +113,12 @@ class DetHorizontalFlipAug(DetAugmenter):
         return src, label
 
     def _flip_label(self, label):
-        label = np.array(label, copy=True)
-        valid = np.where(label[:, 0] > -1)[0]
-        tmp = 1.0 - label[valid, 1]
-        label[valid, 1] = 1.0 - label[valid, 3]
-        label[valid, 3] = tmp
-        return label
+        out = np.array(label, copy=True)
+        real = out[:, 0] > -1
+        x1 = out[real, 1].copy()
+        out[real, 1] = 1.0 - out[real, 3]
+        out[real, 3] = 1.0 - x1
+        return out
 
 
 class DetRandomCropAug(DetAugmenter):
@@ -142,102 +150,105 @@ class DetRandomCropAug(DetAugmenter):
             self.enabled = False
 
     def __call__(self, src, label):
-        crop = self._random_crop_proposal(label, *_to_np(src).shape[:2])
-        if crop:
+        crop = self._sample_crop(label, *_to_np(src).shape[:2])
+        if crop is not None:
             x, y, w, h, label = crop
             src = _img.fixed_crop(_to_np(src), x, y, w, h)
         return src, label
 
-    def _calculate_areas(self, label):
-        heights = np.maximum(0, label[:, 3] - label[:, 1])
-        widths = np.maximum(0, label[:, 2] - label[:, 0])
-        return heights * widths
+    # The SSD patch-sampling strategy (Liu et al. 2016, §2.2 "Data
+    # augmentation"): repeatedly propose a patch whose area / aspect ratio
+    # lie in configured ranges, accept it when every object it touches is
+    # sufficiently covered, then keep only the boxes that retain at least
+    # ``min_eject_coverage`` of their area inside the patch.  This
+    # implementation draws every proposal up front as vectorized numpy —
+    # a (max_attempts,) batch of (area-fraction, log-aspect) pairs —
+    # instead of a scalar rejection loop.
 
-    def _intersect(self, label, xmin, ymin, xmax, ymax):
-        left = np.maximum(label[:, 0], xmin)
-        right = np.minimum(label[:, 2], xmax)
-        top = np.maximum(label[:, 1], ymin)
-        bot = np.minimum(label[:, 3], ymax)
-        invalid = np.where(np.logical_or(left >= right, top >= bot))[0]
-        out = label.copy()
-        out[:, 0] = left
-        out[:, 1] = top
-        out[:, 2] = right
-        out[:, 3] = bot
-        out[invalid, :] = 0
-        return out
-
-    def _check_satisfy_constraints(self, label, xmin, ymin, xmax, ymax,
-                                   width, height):
-        if (xmax - xmin) * (ymax - ymin) < 2:
-            return False
-        x1 = float(xmin) / width
-        y1 = float(ymin) / height
-        x2 = float(xmax) / width
-        y2 = float(ymax) / height
-        object_areas = self._calculate_areas(label[:, 1:])
-        valid_objects = np.where(object_areas * width * height > 2)[0]
-        if valid_objects.size < 1:
-            return False
-        intersects = self._intersect(label[valid_objects, 1:], x1, y1,
-                                     x2, y2)
-        coverages = self._calculate_areas(intersects) / \
-            object_areas[valid_objects]
-        coverages = coverages[np.where(coverages > 0)[0]]
-        return coverages.size > 0 and np.amin(coverages) > \
-            self.min_object_covered
-
-    def _update_labels(self, label, crop_box, height, width):
-        xmin = float(crop_box[0]) / width
-        ymin = float(crop_box[1]) / height
-        w = float(crop_box[2]) / width
-        h = float(crop_box[3]) / height
-        out = label.copy()
-        out[:, (1, 3)] = (out[:, (1, 3)] - xmin) / w
-        out[:, (2, 4)] = (out[:, (2, 4)] - ymin) / h
-        out[:, 1:5] = np.maximum(0, out[:, 1:5])
-        out[:, 1:5] = np.minimum(1, out[:, 1:5])
-        coverage = self._calculate_areas(out[:, 1:]) * w * h / \
-            np.maximum(self._calculate_areas(label[:, 1:]), 1e-12)
-        valid = np.logical_and(out[:, 3] > out[:, 1], out[:, 4] > out[:, 2])
-        valid = np.logical_and(valid, coverage > self.min_eject_coverage)
-        valid = np.where(valid)[0]
-        if valid.size < 1:
+    def _sample_crop(self, label, im_h, im_w):
+        """Return (x, y, w, h, new_label) in pixels or None to skip."""
+        if not self.enabled or im_h <= 0 or im_w <= 0:
             return None
-        return out[valid, :]
+        n = self.max_attempts
+        lo, hi = self.aspect_ratio_range
+        if hi < lo or hi <= 0:
+            return None
+        rng = _np_rng()
+        # aspect sampled log-uniformly: symmetric treatment of wide/tall
+        ratios = np.exp(rng.uniform(np.log(max(lo, 1e-6)),
+                                    np.log(hi), size=n))
+        fracs = rng.uniform(self.area_range[0], self.area_range[1],
+                            size=n)
+        # w/h = ratio and w*h = frac*W*H  →  h = sqrt(frac*W*H/ratio)
+        hs = np.sqrt(fracs * im_w * im_h / ratios).round().astype(int)
+        ws = np.round(hs * ratios).astype(int)
+        ok = (ws >= 1) & (hs >= 1) & (ws <= im_w) & (hs <= im_h)
+        # re-check the realized (integer) area against the bounds
+        area = ws * hs
+        ok &= (area >= self.area_range[0] * im_w * im_h - 1) & \
+              (area <= self.area_range[1] * im_w * im_h + 1)
+        xs = (rng.uniform(size=n) * (im_w - ws + 1)).astype(int)
+        ys = (rng.uniform(size=n) * (im_h - hs + 1)).astype(int)
+        boxes = label[:, 1:5]
+        for i in np.flatnonzero(ok):
+            patch = (xs[i] / im_w, ys[i] / im_h,
+                     (xs[i] + ws[i]) / im_w, (ys[i] + hs[i]) / im_h)
+            if not self._patch_acceptable(boxes, patch, im_w, im_h):
+                continue
+            new_label = self._labels_in_patch(label, patch)
+            if new_label is not None:
+                return (int(xs[i]), int(ys[i]), int(ws[i]), int(hs[i]),
+                        new_label)
+        return None
 
-    def _random_crop_proposal(self, label, height, width):
-        if not self.enabled or height <= 0 or width <= 0:
-            return ()
-        min_area = self.area_range[0] * height * width
-        max_area = self.area_range[1] * height * width
-        for _ in range(self.max_attempts):
-            ratio = pyrandom.uniform(*self.aspect_ratio_range)
-            if ratio <= 0:
-                continue
-            h = int(round(np.sqrt(min_area / ratio)))
-            max_h = int(round(np.sqrt(max_area / ratio)))
-            if round(max_h * ratio) > width:
-                max_h = int((width + 0.4999999) / ratio)
-            if max_h > height:
-                max_h = height
-            if h > max_h:
-                h = max_h
-            if h < max_h:
-                h = pyrandom.randint(h, max_h)
-            w = int(round(h * ratio))
-            area = w * h
-            if area < min_area or area > max_area or w > width or h > height:
-                continue
-            y = pyrandom.randint(0, max(0, height - h))
-            x = pyrandom.randint(0, max(0, width - w))
-            if self._check_satisfy_constraints(label, x, y, x + w, y + h,
-                                               width, height):
-                new_label = self._update_labels(label, (x, y, w, h),
-                                                height, width)
-                if new_label is not None:
-                    return (x, y, w, h, new_label)
-        return ()
+    @staticmethod
+    def _coverage(boxes, patch):
+        """Fraction of each box's area inside the patch; 0 for
+        degenerate boxes."""
+        px1, py1, px2, py2 = patch
+        iw = np.minimum(boxes[:, 2], px2) - np.maximum(boxes[:, 0], px1)
+        ih = np.minimum(boxes[:, 3], py2) - np.maximum(boxes[:, 1], py1)
+        inter = np.clip(iw, 0, None) * np.clip(ih, 0, None)
+        area = np.clip(boxes[:, 2] - boxes[:, 0], 0, None) * \
+            np.clip(boxes[:, 3] - boxes[:, 1], 0, None)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            cov = np.where(area > 0, inter / area, 0.0)
+        return cov
+
+    def _patch_acceptable(self, boxes, patch, im_w, im_h):
+        """Accept iff the patch is non-degenerate and every object it
+        overlaps is covered beyond ``min_object_covered``."""
+        px1, py1, px2, py2 = patch
+        if (px2 - px1) * im_w * (py2 - py1) * im_h < 2:
+            return False
+        # ignore sub-pixel objects
+        real = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]) \
+            * im_w * im_h > 2
+        if not real.any():
+            return False
+        cov = self._coverage(boxes[real], patch)
+        touched = cov > 0
+        return touched.any() and cov[touched].min() > self.min_object_covered
+
+    def _labels_in_patch(self, label, patch):
+        """Clip boxes to the patch, renormalize to patch coords, and drop
+        boxes that lost too much area; None when nothing survives."""
+        px1, py1, px2, py2 = patch
+        pw, ph = px2 - px1, py2 - py1
+        cov = self._coverage(label[:, 1:5], patch)
+        keep = cov > self.min_eject_coverage
+        clipped = label[keep].copy()
+        if clipped.shape[0] == 0:
+            return None
+        cx1 = np.clip((clipped[:, 1] - px1) / pw, 0, 1)
+        cy1 = np.clip((clipped[:, 2] - py1) / ph, 0, 1)
+        cx2 = np.clip((clipped[:, 3] - px1) / pw, 0, 1)
+        cy2 = np.clip((clipped[:, 4] - py1) / ph, 0, 1)
+        alive = (cx2 > cx1) & (cy2 > cy1)
+        clipped[:, 1], clipped[:, 2] = cx1, cy1
+        clipped[:, 3], clipped[:, 4] = cx2, cy2
+        clipped = clipped[alive]
+        return clipped if clipped.shape[0] else None
 
 
 class DetRandomPadAug(DetAugmenter):
@@ -269,53 +280,58 @@ class DetRandomPadAug(DetAugmenter):
 
     def __call__(self, src, label):
         a = _to_np(src)
-        height, width = a.shape[:2]
-        pad = self._random_pad_proposal(label, height, width)
-        if pad:
-            x, y, w, h, label = pad
-            out = np.full((h, w, a.shape[2]), self.pad_val[:a.shape[2]] if
-                          len(self.pad_val) >= a.shape[2] else
-                          self.pad_val[0], dtype=a.dtype)
-            out[y:y + height, x:x + width, :] = a
+        im_h, im_w = a.shape[:2]
+        pad = self._sample_canvas(im_h, im_w)
+        if pad is not None:
+            x, y, w, h = pad
+            fill = (self.pad_val[:a.shape[2]]
+                    if len(self.pad_val) >= a.shape[2] else self.pad_val[0])
+            out = np.full((h, w, a.shape[2]), fill, dtype=a.dtype)
+            out[y:y + im_h, x:x + im_w, :] = a
             a = out
+            label = self._labels_on_canvas(label, (x, y, w, h), im_h, im_w)
         return a, label
 
-    def _update_labels(self, label, pad_box, height, width):
+    # The zoom-out expansion (SSD §2.2): place the image at a random
+    # offset on a larger canvas filled with pad_val, so objects shrink.
+    # Proposals are drawn as a vectorized batch of (area-factor,
+    # log-aspect) pairs; the first canvas that contains the image wins.
+
+    @staticmethod
+    def _labels_on_canvas(label, canvas, im_h, im_w):
+        """Map [0,1]-normalized image coords to canvas coords."""
+        x, y, w, h = canvas
         out = label.copy()
-        out[:, (1, 3)] = (out[:, (1, 3)] * width + pad_box[0]) / pad_box[2]
-        out[:, (2, 4)] = (out[:, (2, 4)] * height + pad_box[1]) / pad_box[3]
+        out[:, 1] = (out[:, 1] * im_w + x) / w
+        out[:, 3] = (out[:, 3] * im_w + x) / w
+        out[:, 2] = (out[:, 2] * im_h + y) / h
+        out[:, 4] = (out[:, 4] * im_h + y) / h
         return out
 
-    def _random_pad_proposal(self, label, height, width):
-        if not self.enabled or height <= 0 or width <= 0:
-            return ()
-        min_area = self.area_range[0] * height * width
-        max_area = self.area_range[1] * height * width
-        for _ in range(self.max_attempts):
-            ratio = pyrandom.uniform(*self.aspect_ratio_range)
-            if ratio <= 0:
-                continue
-            h = int(round(np.sqrt(min_area / ratio)))
-            max_h = int(round(np.sqrt(max_area / ratio)))
-            if round(h * ratio) < width:
-                h = int((width + 0.499999) / ratio)
-            if h < height:
-                h = height
-            if h > max_h:
-                h = max_h
-            if h < max_h:
-                h = pyrandom.randint(h, max_h)
-            w = int(round(h * ratio))
-            if w * h < min_area or w * h > max_area:
-                continue
-            if w < width or h < height:
-                continue
-            x = pyrandom.randint(0, max(0, w - width))
-            y = pyrandom.randint(0, max(0, h - height))
-            new_label = self._update_labels(label, (x, y, w, h),
-                                            height, width)
-            return (x, y, w, h, new_label)
-        return ()
+    def _sample_canvas(self, im_h, im_w):
+        """Return (x, y, canvas_w, canvas_h) or None to skip."""
+        if not self.enabled or im_h <= 0 or im_w <= 0:
+            return None
+        n = self.max_attempts
+        lo, hi = self.aspect_ratio_range
+        rng = _np_rng()
+        ratios = np.exp(rng.uniform(np.log(max(lo, 1e-6)),
+                                    np.log(max(hi, 1e-6)), size=n))
+        factors = rng.uniform(self.area_range[0], self.area_range[1],
+                              size=n)
+        hs = np.sqrt(factors * im_w * im_h / ratios).round().astype(int)
+        ws = np.round(hs * ratios).astype(int)
+        area_lo = self.area_range[0] * im_w * im_h
+        area_hi = self.area_range[1] * im_w * im_h
+        ok = (ws >= im_w) & (hs >= im_h) & \
+             (ws * hs >= area_lo - 1) & (ws * hs <= area_hi + 1)
+        idx = np.flatnonzero(ok)
+        if idx.size == 0:
+            return None
+        i = idx[0]
+        x = int(rng.uniform() * (ws[i] - im_w + 1))
+        y = int(rng.uniform() * (hs[i] - im_h + 1))
+        return (x, y, int(ws[i]), int(hs[i]))
 
 
 def CreateMultiRandCropAugmenter(min_object_covered=0.1,

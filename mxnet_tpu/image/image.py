@@ -213,18 +213,18 @@ def center_crop(src, size, interp=2):
     return _wrap_like(_to_np(out), src), (x0, y0, new_w, new_h)
 
 
-def random_size_crop(src, size, min_area, ratio, interp=2, **kwargs):
+def random_size_crop(src, size, min_area, ratio, interp=2, max_area=1.0,
+                     max_attempts=10, **kwargs):
     """Random area+aspect crop, the Inception-style crop
-    (ref: image.py:435). Returns (img, (x0, y0, w, h))."""
+    (ref: image.py:435). Returns (img, (x0, y0, w, h)); falls back to a
+    center crop when no proposal fits."""
     a = _to_np(src)
     h, w = a.shape[:2]
     src_area = h * w
-    if "max_area" in kwargs:
-        min_area = kwargs.pop("min_area", min_area)
-    for _ in range(10):
-        target_area = pyrandom.uniform(min_area, 1.0) * src_area
-        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
-        aspect = np.exp(pyrandom.uniform(*log_ratio))
+    log_lo, log_hi = np.log(ratio[0]), np.log(ratio[1])
+    for _ in range(max_attempts):
+        target_area = pyrandom.uniform(min_area, max_area) * src_area
+        aspect = np.exp(pyrandom.uniform(log_lo, log_hi))
         new_w = int(round(np.sqrt(target_area * aspect)))
         new_h = int(round(np.sqrt(target_area / aspect)))
         if new_w <= w and new_h <= h:
@@ -322,13 +322,14 @@ class RandomCropAug(Augmenter):
 class RandomSizedCropAug(Augmenter):
     def __init__(self, size, min_area, ratio, interp=2, **kwargs):
         super().__init__(size=size, min_area=min_area, ratio=ratio,
-                         interp=interp)
+                         interp=interp, **kwargs)
         self.size, self.min_area = size, min_area
         self.ratio, self.interp = ratio, interp
+        self.kwargs = kwargs
 
     def __call__(self, src):
         return random_size_crop(src, self.size, self.min_area, self.ratio,
-                                self.interp)[0]
+                                self.interp, **self.kwargs)[0]
 
 
 class CenterCropAug(Augmenter):
@@ -383,9 +384,8 @@ class ContrastJitterAug(Augmenter):
     def __call__(self, src):
         alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
         a = _to_np(src).astype(np.float32)
-        gray = (a * _GRAY_COEF).sum(axis=2, keepdims=True)
-        mean = 3.0 * (1.0 - alpha) / gray.size * gray.sum()
-        return _wrap_like(a * alpha + mean, src)
+        gray_mean = (a * _GRAY_COEF).sum(axis=2).mean()
+        return _wrap_like(a * alpha + (1.0 - alpha) * gray_mean, src)
 
 
 class SaturationJitterAug(Augmenter):
@@ -662,15 +662,15 @@ class ImageIter(io.DataIter):
         return self.provide_label_
 
     def reset(self):
+        """Start the next epoch.  Under roll_over a cached partial batch
+        survives the reset and is completed from the new epoch's samples
+        (the reference's carry-over contract)."""
         if self.seq is not None and self.shuffle:
             pyrandom.shuffle(self.seq)
-        if (self.last_batch_handle != "roll_over"
-                or self._cache_data is None):
-            if self.imgrec is not None:
-                self.imgrec.reset()
-            self.cur = 0
-            if self._allow_read is False:
-                self._allow_read = True
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+        self._allow_read = True
 
     def hard_reset(self):
         if self.seq is not None and self.shuffle:
@@ -735,17 +735,35 @@ class ImageIter(io.DataIter):
     def next(self):
         batch_size = self.batch_size
         c, h, w = self.data_shape
-        batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
-        if self.label_width > 1:
-            batch_label = np.zeros((batch_size, self.label_width),
-                                   dtype=self.dtype)
+        if self._cache_data is not None:
+            # roll_over: resume the partial batch carried across reset()
+            batch_data = self._cache_data
+            batch_label = self._cache_label
+            start = self._cache_idx
+            self._cache_data = None
+            self._cache_label = None
+            self._cache_idx = None
         else:
-            batch_label = np.zeros((batch_size,), dtype=self.dtype)
-        i = self._batchify(batch_data, batch_label)
+            batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
+            if self.label_width > 1:
+                batch_label = np.zeros((batch_size, self.label_width),
+                                       dtype=self.dtype)
+            else:
+                batch_label = np.zeros((batch_size,), dtype=self.dtype)
+            start = 0
+        i = self._batchify(batch_data, batch_label, start)
         pad = batch_size - i
-        if pad != 0 and self.last_batch_handle == "discard":
-            raise StopIteration
         if pad != 0:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            if self.last_batch_handle == "roll_over":
+                # stash the partial batch for the next epoch
+                # (ref: image.py ImageIter.next roll_over cache)
+                self._cache_data = batch_data
+                self._cache_label = batch_label
+                self._cache_idx = i
+                self._allow_read = False
+                raise StopIteration
             self._allow_read = False
         return io.DataBatch([array(batch_data.astype(self.dtype))],
                             [array(batch_label)], pad=pad)

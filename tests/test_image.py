@@ -217,3 +217,77 @@ def test_recordio_pack_unpack_img():
     s = recordio.pack_img(header, img, img_fmt=".png")
     _, img3 = recordio.unpack_img(s)
     np.testing.assert_array_equal(img3, img)  # png lossless
+
+
+def test_det_crop_sampler_properties():
+    """The rewritten SSD patch sampler: area/aspect bounds hold, accepted
+    patches cover every touched object, surviving boxes are clipped and
+    renormalized."""
+    np.random.seed(3)
+    img = _synth_img(80, 120)
+    label = np.array([[0, 0.1, 0.1, 0.5, 0.6],
+                      [1, 0.6, 0.5, 0.9, 0.95]], dtype=np.float32)
+    aug = image.DetRandomCropAug(min_object_covered=0.3,
+                                 aspect_ratio_range=(0.5, 2.0),
+                                 area_range=(0.3, 0.9), max_attempts=200)
+    hits = 0
+    for _ in range(30):
+        crop = aug._sample_crop(label, 80, 120)
+        if crop is None:
+            continue
+        hits += 1
+        x, y, w, h, lab = crop
+        assert 0 <= x and x + w <= 120 and 0 <= y and y + h <= 80
+        frac = (w * h) / (80 * 120)
+        assert 0.25 <= frac <= 0.95  # bounds with integer-rounding slack
+        assert 0.4 <= w / h <= 2.1
+        assert lab.shape[0] >= 1
+        assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+        assert (lab[:, 3] > lab[:, 1]).all() and (lab[:, 4] > lab[:, 2]).all()
+    assert hits > 0
+
+
+def test_det_pad_sampler_properties():
+    np.random.seed(4)
+    aug = image.DetRandomPadAug(aspect_ratio_range=(0.8, 1.25),
+                                area_range=(1.5, 3.0), max_attempts=100)
+    label = np.array([[0, 0.25, 0.25, 0.75, 0.75]], dtype=np.float32)
+    img = _synth_img(40, 40)
+    out, lab = aug(img, label)
+    a = np.asarray(out)
+    assert a.shape[0] >= 40 and a.shape[1] >= 40
+    assert a.shape[0] * a.shape[1] >= 1.4 * 40 * 40
+    # boxes stay on the original image content and shrink
+    assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+    w_new = lab[0, 3] - lab[0, 1]
+    assert w_new < 0.5 + 1e-6
+
+
+def test_contrast_jitter_preserves_mean_scale():
+    """alpha=1 must be identity; the gray blend uses the true mean (the
+    3x-scaled blend bug is gone)."""
+    img = _synth_img(16, 16).astype(np.float32)
+    aug = image.ContrastJitterAug(0.0)  # alpha == 1 always
+    out = np.asarray(aug(img))
+    np.testing.assert_allclose(out, img, rtol=1e-5)
+
+
+def test_image_iter_roll_over(tmp_path):
+    """10 images, batch 4: epoch1 yields 2 full batches and carries 2; the
+    carried samples lead epoch 2's first batch (no pad anywhere)."""
+    rec_path, idx_path = _make_rec(tmp_path, n=10)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                         path_imgrec=rec_path, path_imgidx=idx_path,
+                         last_batch_handle="roll_over")
+    ep1 = []
+    try:
+        while True:
+            ep1.append(it.next())
+    except StopIteration:
+        pass
+    assert len(ep1) == 2 and all(b.pad == 0 for b in ep1)
+    it.reset()
+    b = it.next()
+    assert b.pad == 0  # 2 carried + 2 fresh
+    labels = b.label[0].asnumpy()
+    assert labels.shape[0] == 4
