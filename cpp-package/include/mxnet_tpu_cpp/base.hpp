@@ -1,0 +1,54 @@
+/* mxnet_tpu C++ frontend — shared plumbing.
+ *
+ * ref: cpp-package/include/mxnet-cpp/base.h + MxNetCpp.h in the
+ * reference tree (a 7.9k-LoC frontend over c_api.h).  This frontend is
+ * a fresh header-only design over include/mxnet_tpu/c_api.h: handles
+ * are shared_ptr-owned, errors raise std::runtime_error carrying
+ * MXGetLastError, contexts are (dev_type, dev_id) tags.
+ */
+#ifndef MXNET_TPU_CPP_BASE_HPP_
+#define MXNET_TPU_CPP_BASE_HPP_
+
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu/c_api.h"
+
+namespace mxtpu {
+namespace cpp {
+
+inline void Check(int rc, const char *where) {
+  if (rc != 0)
+    throw std::runtime_error(std::string(where) + ": " + MXGetLastError());
+}
+
+#define MXTPU_CHECK(call) ::mxtpu::cpp::Check((call), #call)
+
+/* device tag (ref: cpp-package/include/mxnet-cpp/base.h DeviceType) */
+struct Context {
+  int dev_type;
+  int dev_id;
+  Context(int type, int id) : dev_type(type), dev_id(id) {}
+  static Context cpu(int id = 0) { return Context(1, id); }
+  static Context gpu(int id = 0) { return Context(2, id); }
+  static Context tpu(int id = 0) { return Context(2, id); }  /* alias */
+};
+
+/* shared_ptr deleter pairing for every handle family */
+template <int (*FreeFn)(void *)>
+struct HandleOwner {
+  std::shared_ptr<void> ptr;
+  HandleOwner() = default;
+  explicit HandleOwner(void *h) : ptr(h, [](void *p) {
+    if (p) FreeFn(p);
+  }) {}
+  void *get() const { return ptr.get(); }
+  explicit operator bool() const { return static_cast<bool>(ptr); }
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_BASE_HPP_

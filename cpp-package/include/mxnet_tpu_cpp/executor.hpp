@@ -1,0 +1,124 @@
+/* Executor — bound computation graph with forward/backward.
+ *
+ * ref: cpp-package/include/mxnet-cpp/executor.hpp; fresh design over
+ * MXExecutorBindEX.  The executor aliases the caller's arg/grad/aux
+ * NDArrays (reference semantics): imperative updates to the arg arrays
+ * are visible to the next Forward, gradients land in the grad arrays.
+ */
+#ifndef MXNET_TPU_CPP_EXECUTOR_HPP_
+#define MXNET_TPU_CPP_EXECUTOR_HPP_
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "symbol.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+enum class GradReq : mx_uint { kNull = 0, kWrite = 1, kAdd = 3 };
+
+class Executor {
+ public:
+  Executor() = default;
+
+  Executor(const Symbol &symbol, const Context &ctx,
+           const std::vector<NDArray> &arg_arrays,
+           const std::vector<NDArray> &grad_arrays,
+           const std::vector<GradReq> &grad_reqs,
+           const std::vector<NDArray> &aux_arrays,
+           const std::map<std::string, Context> &group2ctx = {})
+      : symbol_(symbol), args_(arg_arrays), grads_(grad_arrays),
+        aux_(aux_arrays) {
+    std::vector<NDArrayHandle> arg_h, grad_h, aux_h;
+    std::vector<mx_uint> reqs;
+    for (const auto &a : args_) arg_h.push_back(a.handle());
+    for (const auto &g : grads_) grad_h.push_back(g.handle());
+    for (const auto &r : grad_reqs)
+      reqs.push_back(static_cast<mx_uint>(r));
+    for (const auto &a : aux_) aux_h.push_back(a.handle());
+    std::vector<const char *> g2c_keys;
+    std::vector<int> g2c_types, g2c_ids;
+    for (const auto &kv : group2ctx) {
+      g2c_keys.push_back(kv.first.c_str());
+      g2c_types.push_back(kv.second.dev_type);
+      g2c_ids.push_back(kv.second.dev_id);
+    }
+    ExecutorHandle h = nullptr;
+    MXTPU_CHECK(MXExecutorBindEX(
+        symbol.handle(), ctx.dev_type, ctx.dev_id,
+        static_cast<mx_uint>(g2c_keys.size()),
+        g2c_keys.empty() ? nullptr : g2c_keys.data(),
+        g2c_types.empty() ? nullptr : g2c_types.data(),
+        g2c_ids.empty() ? nullptr : g2c_ids.data(),
+        static_cast<mx_uint>(arg_h.size()), arg_h.data(), grad_h.data(),
+        reqs.data(), static_cast<mx_uint>(aux_h.size()),
+        aux_h.empty() ? nullptr : aux_h.data(), nullptr, &h));
+    owner_ = HandleOwner<MXExecutorFree>(h);
+  }
+
+  ExecutorHandle handle() const { return owner_.get(); }
+
+  void Forward(bool is_train) {
+    MXTPU_CHECK(MXExecutorForward(handle(), is_train ? 1 : 0));
+  }
+
+  void Backward(const std::vector<NDArray> &head_grads = {}) {
+    std::vector<NDArrayHandle> hs;
+    for (const auto &g : head_grads) hs.push_back(g.handle());
+    MXTPU_CHECK(MXExecutorBackward(handle(),
+                                   static_cast<mx_uint>(hs.size()),
+                                   hs.empty() ? nullptr : hs.data()));
+  }
+
+  std::vector<NDArray> Outputs() const {
+    mx_uint n = 0;
+    NDArrayHandle *arr = nullptr;
+    MXTPU_CHECK(MXExecutorOutputs(handle(), &n, &arr));
+    std::vector<NDArray> out;
+    for (mx_uint i = 0; i < n; ++i) out.emplace_back(arr[i]);
+    return out;
+  }
+
+  std::string DebugString() const {
+    const char *s = nullptr;
+    MXTPU_CHECK(MXExecutorPrint(handle(), &s));
+    return s;
+  }
+
+  const std::vector<NDArray> &arg_arrays() const { return args_; }
+  const std::vector<NDArray> &grad_arrays() const { return grads_; }
+  const std::vector<NDArray> &aux_arrays() const { return aux_; }
+
+  /* allocate args/grads from inferred shapes and bind — the
+   * simple_bind convenience (reference MXExecutorSimpleBind) */
+  static Executor SimpleBind(
+      const Symbol &symbol, const Context &ctx,
+      const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+      GradReq default_req = GradReq::kWrite) {
+    std::vector<std::vector<mx_uint>> arg_shapes, out_shapes, aux_shapes;
+    symbol.InferShape(input_shapes, &arg_shapes, &out_shapes, &aux_shapes);
+    auto arg_names = symbol.ListArguments();
+    std::vector<NDArray> args, grads, aux;
+    std::vector<GradReq> reqs;
+    for (size_t i = 0; i < arg_shapes.size(); ++i) {
+      args.emplace_back(arg_shapes[i], ctx);
+      bool is_input = input_shapes.count(arg_names[i]) > 0;
+      grads.emplace_back(arg_shapes[i], ctx);
+      reqs.push_back(is_input ? GradReq::kNull : default_req);
+    }
+    for (const auto &s : aux_shapes) aux.emplace_back(s, ctx);
+    return Executor(symbol, ctx, args, grads, reqs, aux);
+  }
+
+ private:
+  Symbol symbol_;
+  std::vector<NDArray> args_, grads_, aux_;
+  HandleOwner<MXExecutorFree> owner_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_EXECUTOR_HPP_

@@ -1,0 +1,90 @@
+/* KVStore — the C++ face of the parameter store.
+ *
+ * ref: cpp-package/include/mxnet-cpp/kvstore.hpp; fresh design over
+ * MXKVStore*.  SetOptimizer installs a C updater trampoline so server-
+ * side (store-side) updates run the C++ optimizer, the reference's
+ * update_on_kvstore path.
+ */
+#ifndef MXNET_TPU_CPP_KVSTORE_HPP_
+#define MXNET_TPU_CPP_KVSTORE_HPP_
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "optimizer.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    KVStoreHandle h = nullptr;
+    MXTPU_CHECK(MXKVStoreCreate(type.c_str(), &h));
+    owner_ = HandleOwner<MXKVStoreFree>(h);
+  }
+
+  KVStoreHandle handle() const { return owner_.get(); }
+
+  void Init(int key, const NDArray &val) {
+    NDArrayHandle vh = val.handle();
+    MXTPU_CHECK(MXKVStoreInit(handle(), 1, &key, &vh));
+  }
+
+  void Push(int key, const NDArray &val, int priority = 0) {
+    NDArrayHandle vh = val.handle();
+    MXTPU_CHECK(MXKVStorePush(handle(), 1, &key, &vh, priority));
+  }
+
+  void Pull(int key, NDArray *out, int priority = 0) {
+    NDArrayHandle oh = out->handle();
+    MXTPU_CHECK(MXKVStorePull(handle(), 1, &key, &oh, priority));
+  }
+
+  /* store-side updates via the installed optimizer (the reference's
+   * update_on_kvstore path; updater contract: callee owns the recv /
+   * local handles) */
+  void SetOptimizer(std::unique_ptr<Optimizer> optimizer) {
+    optimizer_ = std::move(optimizer);
+    MXTPU_CHECK(MXKVStoreSetUpdater(handle(), &KVStore::UpdaterThunk,
+                                    optimizer_.get()));
+  }
+
+  std::string Type() const {
+    const char *t = nullptr;
+    MXTPU_CHECK(MXKVStoreGetType(handle(), &t));
+    return t;
+  }
+
+  int Rank() const {
+    int r = 0;
+    MXTPU_CHECK(MXKVStoreGetRank(handle(), &r));
+    return r;
+  }
+
+  int NumWorkers() const {
+    int n = 0;
+    MXTPU_CHECK(MXKVStoreGetGroupSize(handle(), &n));
+    return n;
+  }
+
+  void Barrier() { MXTPU_CHECK(MXKVStoreBarrier(handle())); }
+
+ private:
+  static void UpdaterThunk(int key, NDArrayHandle recv, NDArrayHandle local,
+                           void *user) {
+    auto *opt = static_cast<Optimizer *>(user);
+    /* NDArray takes ownership — frees the handles when done */
+    NDArray grad(recv), weight(local);
+    opt->Update(key, weight, grad);
+  }
+
+  HandleOwner<MXKVStoreFree> owner_;
+  std::unique_ptr<Optimizer> optimizer_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_KVSTORE_HPP_

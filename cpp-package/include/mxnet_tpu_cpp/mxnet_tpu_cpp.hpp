@@ -1,0 +1,14 @@
+/* Umbrella header for the mxnet_tpu C++ frontend
+ * (ref: cpp-package/include/mxnet-cpp/MxNetCpp.h). */
+#ifndef MXNET_TPU_CPP_MXNET_TPU_CPP_HPP_
+#define MXNET_TPU_CPP_MXNET_TPU_CPP_HPP_
+
+#include "base.hpp"
+#include "ndarray.hpp"
+#include "op.hpp"
+#include "symbol.hpp"
+#include "executor.hpp"
+#include "optimizer.hpp"
+#include "kvstore.hpp"
+
+#endif  // MXNET_TPU_CPP_MXNET_TPU_CPP_HPP_

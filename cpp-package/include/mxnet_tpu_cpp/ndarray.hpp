@@ -1,0 +1,131 @@
+/* NDArray — the C++ tensor handle.
+ *
+ * ref: cpp-package/include/mxnet-cpp/ndarray.hpp (reference frontend);
+ * fresh design over the MXNDArray* ABI: value-semantic wrapper, copies
+ * share the underlying handle (shared_ptr), data moves via the
+ * SyncCopy pair, ops via imperative invoke (see op.hpp).
+ */
+#ifndef MXNET_TPU_CPP_NDARRAY_HPP_
+#define MXNET_TPU_CPP_NDARRAY_HPP_
+
+#include <map>
+#include <numeric>
+#include <string>
+#include <vector>
+
+#include "base.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+class NDArray {
+ public:
+  NDArray() = default;
+
+  /* wrap an ABI handle (takes ownership) */
+  explicit NDArray(NDArrayHandle h) : owner_(h) {}
+
+  NDArray(const std::vector<mx_uint> &shape, const Context &ctx,
+          int dtype = 0) {
+    NDArrayHandle h = nullptr;
+    MXTPU_CHECK(MXNDArrayCreateEx(shape.data(),
+                                  static_cast<mx_uint>(shape.size()),
+                                  ctx.dev_type, ctx.dev_id, 0, dtype, &h));
+    owner_ = HandleOwner<MXNDArrayFree>(h);
+  }
+
+  NDArray(const std::vector<float> &data, const std::vector<mx_uint> &shape,
+          const Context &ctx)
+      : NDArray(shape, ctx, 0) {
+    SyncCopyFromCPU(data.data(), data.size());
+  }
+
+  NDArrayHandle handle() const { return owner_.get(); }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint *pdata = nullptr;
+    MXTPU_CHECK(MXNDArrayGetShape(handle(), &ndim, &pdata));
+    return std::vector<mx_uint>(pdata, pdata + ndim);
+  }
+
+  size_t Size() const {
+    auto s = Shape();
+    return std::accumulate(s.begin(), s.end(), size_t(1),
+                           std::multiplies<size_t>());
+  }
+
+  int DType() const {
+    int dt = 0;
+    MXTPU_CHECK(MXNDArrayGetDType(handle(), &dt));
+    return dt;
+  }
+
+  void SyncCopyFromCPU(const float *data, size_t size) {
+    MXTPU_CHECK(MXNDArraySyncCopyFromCPU(handle(), data, size));
+  }
+
+  void SyncCopyToCPU(float *data, size_t size) const {
+    MXTPU_CHECK(MXNDArraySyncCopyToCPU(handle(),
+                                       static_cast<void *>(data), size));
+  }
+
+  std::vector<float> CopyToVector() const {
+    std::vector<float> out(Size());
+    SyncCopyToCPU(out.data(), out.size());
+    return out;
+  }
+
+  NDArray Reshape(const std::vector<int> &dims) const {
+    NDArrayHandle h = nullptr;
+    MXTPU_CHECK(MXNDArrayReshape(handle(), static_cast<int>(dims.size()),
+                                 const_cast<int *>(dims.data()), &h));
+    return NDArray(h);
+  }
+
+  NDArray Slice(mx_uint begin, mx_uint end) const {
+    NDArrayHandle h = nullptr;
+    MXTPU_CHECK(MXNDArraySlice(handle(), begin, end, &h));
+    return NDArray(h);
+  }
+
+  void WaitToRead() const { MXTPU_CHECK(MXNDArrayWaitToRead(handle())); }
+
+  static void WaitAll() { MXTPU_CHECK(MXNDArrayWaitAll()); }
+
+  static void Save(const std::string &fname,
+                   const std::map<std::string, NDArray> &arrays) {
+    std::vector<NDArrayHandle> handles;
+    std::vector<const char *> keys;
+    for (const auto &kv : arrays) {
+      keys.push_back(kv.first.c_str());
+      handles.push_back(kv.second.handle());
+    }
+    MXTPU_CHECK(MXNDArraySave(fname.c_str(),
+                              static_cast<mx_uint>(handles.size()),
+                              handles.data(), keys.data()));
+  }
+
+  static std::map<std::string, NDArray> Load(const std::string &fname) {
+    mx_uint size = 0, name_size = 0;
+    NDArrayHandle *arrs = nullptr;
+    const char **names = nullptr;
+    MXTPU_CHECK(MXNDArrayLoad(fname.c_str(), &size, &arrs, &name_size,
+                              &names));
+    std::map<std::string, NDArray> out;
+    for (mx_uint i = 0; i < size; ++i) {
+      std::string key = (i < name_size) ? names[i]
+                                        : ("arg:" + std::to_string(i));
+      out.emplace(key, NDArray(arrs[i]));
+    }
+    return out;
+  }
+
+ private:
+  HandleOwner<MXNDArrayFree> owner_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_NDARRAY_HPP_

@@ -1,0 +1,94 @@
+/* Operator invocation — imperative ops and symbol building.
+ *
+ * ref: cpp-package/include/mxnet-cpp/operator.h + the generated op.h
+ * (reference emits thousands of wrappers from the registry at build
+ * time).  Fresh design: one OpCall builder resolves the creator by
+ * name at first use and serves both MXImperativeInvoke (on NDArrays)
+ * and MXSymbolCreateAtomicSymbol+Compose (on Symbols, see symbol.hpp).
+ */
+#ifndef MXNET_TPU_CPP_OP_HPP_
+#define MXNET_TPU_CPP_OP_HPP_
+
+#include <map>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "ndarray.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+inline AtomicSymbolCreator FindCreator(const std::string &op_name) {
+  static std::unordered_map<std::string, AtomicSymbolCreator> index;
+  if (index.empty()) {
+    mx_uint n = 0;
+    AtomicSymbolCreator *arr = nullptr;
+    MXTPU_CHECK(MXSymbolListAtomicSymbolCreators(&n, &arr));
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *name = nullptr;
+      MXTPU_CHECK(MXSymbolGetAtomicSymbolName(arr[i], &name));
+      index.emplace(name, arr[i]);
+    }
+  }
+  auto it = index.find(op_name);
+  if (it == index.end())
+    throw std::runtime_error("unknown operator: " + op_name);
+  return it->second;
+}
+
+/* fluent op application: OpCall("FullyConnected").Param("num_hidden", 64)
+ *    .Arg(x).Arg(w).Arg(b).Invoke()   — imperative
+ * or .ArgSym("data", s).BuildSymbol("fc1") — symbolic (symbol.hpp)  */
+class OpCall {
+ public:
+  explicit OpCall(const std::string &op_name) : name_(op_name) {}
+
+  template <typename T>
+  OpCall &Param(const std::string &key, const T &value) {
+    std::ostringstream os;
+    os << value;
+    param_keys_.push_back(key);
+    param_vals_.push_back(os.str());
+    return *this;
+  }
+
+  OpCall &Arg(const NDArray &arr) {
+    inputs_.push_back(arr.handle());
+    return *this;
+  }
+
+  /* run imperatively; results land in `outputs` (empty → allocated) */
+  std::vector<NDArray> Invoke(std::vector<NDArray> outputs = {}) {
+    std::vector<const char *> ks, vs;
+    for (auto &k : param_keys_) ks.push_back(k.c_str());
+    for (auto &v : param_vals_) vs.push_back(v.c_str());
+    int num_out = static_cast<int>(outputs.size());
+    std::vector<NDArrayHandle> out_handles;
+    for (auto &o : outputs) out_handles.push_back(o.handle());
+    NDArrayHandle *outs = outputs.empty() ? nullptr : out_handles.data();
+    MXTPU_CHECK(MXImperativeInvoke(
+        FindCreator(name_), static_cast<int>(inputs_.size()),
+        inputs_.data(), &num_out, &outs,
+        static_cast<int>(ks.size()), ks.data(), vs.data()));
+    if (!outputs.empty()) return outputs;  /* written in place */
+    std::vector<NDArray> fresh;
+    for (int i = 0; i < num_out; ++i) fresh.emplace_back(outs[i]);
+    return fresh;
+  }
+
+  const std::string &name() const { return name_; }
+  const std::vector<std::string> &param_keys() const { return param_keys_; }
+  const std::vector<std::string> &param_vals() const { return param_vals_; }
+
+ protected:
+  std::string name_;
+  std::vector<std::string> param_keys_, param_vals_;
+  std::vector<NDArrayHandle> inputs_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_OP_HPP_

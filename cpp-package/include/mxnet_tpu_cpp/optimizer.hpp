@@ -1,0 +1,109 @@
+/* Optimizers — parameter updates through the fused imperative update
+ * ops (sgd_update / sgd_mom_update / adam_update), mirroring how the
+ * reference frontend drives its optimizers through the same registry
+ * (ref: cpp-package/include/mxnet-cpp/optimizer.hpp; op refs:
+ * src/operator/optimizer_op.cc).
+ */
+#ifndef MXNET_TPU_CPP_OPTIMIZER_HPP_
+#define MXNET_TPU_CPP_OPTIMIZER_HPP_
+
+#include <cmath>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "op.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+class Optimizer {
+ public:
+  explicit Optimizer(float learning_rate, float wd = 0.0f)
+      : lr_(learning_rate), wd_(wd) {}
+  virtual ~Optimizer() = default;
+
+  /* update one parameter in place given its gradient */
+  virtual void Update(int index, NDArray weight, NDArray grad) = 0;
+
+  static std::unique_ptr<Optimizer> Create(const std::string &name,
+                                           float lr, float wd = 0.0f);
+
+ protected:
+  float lr_, wd_;
+};
+
+class SGDOptimizer : public Optimizer {
+ public:
+  SGDOptimizer(float lr, float momentum = 0.0f, float wd = 0.0f)
+      : Optimizer(lr, wd), momentum_(momentum) {}
+
+  void Update(int index, NDArray weight, NDArray grad) override {
+    if (momentum_ == 0.0f) {
+      OpCall("sgd_update").Arg(weight).Arg(grad)
+          .Param("lr", lr_).Param("wd", wd_)
+          .Invoke({weight});
+      return;
+    }
+    auto it = states_.find(index);
+    if (it == states_.end()) {
+      NDArray mom(weight.Shape(), Context::cpu());
+      it = states_.emplace(index, mom).first;
+    }
+    OpCall("sgd_mom_update").Arg(weight).Arg(grad).Arg(it->second)
+        .Param("lr", lr_).Param("momentum", momentum_).Param("wd", wd_)
+        .Invoke({weight});
+  }
+
+ private:
+  float momentum_;
+  std::map<int, NDArray> states_;
+};
+
+class AdamOptimizer : public Optimizer {
+ public:
+  AdamOptimizer(float lr, float beta1 = 0.9f, float beta2 = 0.999f,
+                float epsilon = 1e-8f, float wd = 0.0f)
+      : Optimizer(lr, wd), beta1_(beta1), beta2_(beta2), eps_(epsilon) {}
+
+  void Update(int index, NDArray weight, NDArray grad) override {
+    auto it = states_.find(index);
+    if (it == states_.end()) {
+      NDArray mean(weight.Shape(), Context::cpu());
+      NDArray var(weight.Shape(), Context::cpu());
+      it = states_.emplace(index, std::make_pair(mean, var)).first;
+      t_[index] = 0;
+    }
+    ++t_[index];
+    /* bias-corrected lr like the reference python optimizer */
+    double t = t_[index];
+    float lr_t = lr_ * std::sqrt(1.0 - std::pow(beta2_, t)) /
+                 (1.0 - std::pow(beta1_, t));
+    OpCall("adam_update").Arg(weight).Arg(grad)
+        .Arg(it->second.first).Arg(it->second.second)
+        .Param("lr", lr_t).Param("beta1", beta1_).Param("beta2", beta2_)
+        .Param("epsilon", eps_).Param("wd", wd_)
+        .Invoke({weight});
+  }
+
+ private:
+  float beta1_, beta2_, eps_;
+  std::map<int, std::pair<NDArray, NDArray>> states_;
+  std::map<int, int> t_;
+};
+
+inline std::unique_ptr<Optimizer> Optimizer::Create(const std::string &name,
+                                                    float lr, float wd) {
+  if (name == "sgd") return std::make_unique<SGDOptimizer>(lr, 0.0f, wd);
+  if (name == "sgd_momentum" || name == "nag")
+    return std::make_unique<SGDOptimizer>(lr, 0.9f, wd);
+  if (name == "adam")
+    return std::make_unique<AdamOptimizer>(lr, 0.9f, 0.999f, 1e-8f, wd);
+  throw std::runtime_error("unknown optimizer: " + name);
+}
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_OPTIMIZER_HPP_

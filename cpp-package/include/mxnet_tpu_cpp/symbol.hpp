@@ -1,0 +1,233 @@
+/* Symbol — declarative graph composition from C++.
+ *
+ * ref: cpp-package/include/mxnet-cpp/symbol.hpp (reference frontend);
+ * fresh design over the MXSymbol* ABI plus convenience builders for
+ * the common layers (the reference generates these from the registry;
+ * here the hot subset is hand-rolled and everything else is reachable
+ * through SymBuilder("<any-op>")).
+ */
+#ifndef MXNET_TPU_CPP_SYMBOL_HPP_
+#define MXNET_TPU_CPP_SYMBOL_HPP_
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "op.hpp"
+
+namespace mxtpu {
+namespace cpp {
+
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle h) : owner_(h) {}
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    MXTPU_CHECK(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    MXTPU_CHECK(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+
+  static Symbol FromFile(const std::string &fname) {
+    SymbolHandle h = nullptr;
+    MXTPU_CHECK(MXSymbolCreateFromFile(fname.c_str(), &h));
+    return Symbol(h);
+  }
+
+  static Symbol Group(const std::vector<Symbol> &parts) {
+    std::vector<SymbolHandle> hs;
+    for (const auto &p : parts) hs.push_back(p.handle());
+    SymbolHandle h = nullptr;
+    MXTPU_CHECK(MXSymbolCreateGroup(static_cast<mx_uint>(hs.size()),
+                                    hs.data(), &h));
+    return Symbol(h);
+  }
+
+  SymbolHandle handle() const { return owner_.get(); }
+
+  std::string ToJSON() const {
+    const char *out = nullptr;
+    MXTPU_CHECK(MXSymbolSaveToJSON(handle(), &out));
+    return out;
+  }
+
+  void Save(const std::string &fname) const {
+    MXTPU_CHECK(MXSymbolSaveToFile(handle(), fname.c_str()));
+  }
+
+  std::vector<std::string> ListArguments() const {
+    return ListNames(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return ListNames(&MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return ListNames(&MXSymbolListAuxiliaryStates);
+  }
+
+  Symbol GetInternals() const {
+    SymbolHandle h = nullptr;
+    MXTPU_CHECK(MXSymbolGetInternals(handle(), &h));
+    return Symbol(h);
+  }
+
+  Symbol operator[](mx_uint index) const {
+    SymbolHandle h = nullptr;
+    MXTPU_CHECK(MXSymbolGetOutput(handle(), index, &h));
+    return Symbol(h);
+  }
+
+  /* shape inference for the given named input shapes; returns arg,
+   * out, aux shape lists (ref: MXSymbolInferShape CSR marshalling) */
+  void InferShape(
+      const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+      std::vector<std::vector<mx_uint>> *arg_shapes,
+      std::vector<std::vector<mx_uint>> *out_shapes,
+      std::vector<std::vector<mx_uint>> *aux_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> ind_ptr{0}, data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) data.push_back(d);
+      ind_ptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    mx_uint in_n = 0, out_n = 0, aux_n = 0;
+    const mx_uint *in_nd = nullptr, *out_nd = nullptr, *aux_nd = nullptr;
+    const mx_uint **in_d = nullptr, **out_d = nullptr, **aux_d = nullptr;
+    int complete = 0;
+    MXTPU_CHECK(MXSymbolInferShape(
+        handle(), static_cast<mx_uint>(keys.size()), keys.data(),
+        ind_ptr.data(), data.data(), &in_n, &in_nd, &in_d, &out_n, &out_nd,
+        &out_d, &aux_n, &aux_nd, &aux_d, &complete));
+    auto unpack = [](mx_uint n, const mx_uint *nd, const mx_uint **d,
+                     std::vector<std::vector<mx_uint>> *out) {
+      if (!out) return;
+      out->clear();
+      for (mx_uint i = 0; i < n; ++i)
+        out->emplace_back(d[i], d[i] + nd[i]);
+    };
+    unpack(in_n, in_nd, in_d, arg_shapes);
+    unpack(out_n, out_nd, out_d, out_shapes);
+    unpack(aux_n, aux_nd, aux_d, aux_shapes);
+  }
+
+ private:
+  using ListFn = int (*)(SymbolHandle, mx_uint *, const char ***);
+  std::vector<std::string> ListNames(ListFn fn) const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    MXTPU_CHECK(fn(handle(), &n, &arr));
+    return std::vector<std::string>(arr, arr + n);
+  }
+
+  HandleOwner<MXSymbolFree> owner_;
+};
+
+/* symbolic op application, sharing OpCall's param plumbing:
+ *   SymBuilder("FullyConnected").Param("num_hidden", 64)
+ *       .Input("data", x).Build("fc1")                                  */
+class SymBuilder : public OpCall {
+ public:
+  explicit SymBuilder(const std::string &op_name) : OpCall(op_name) {}
+
+  template <typename T>
+  SymBuilder &Param(const std::string &key, const T &value) {
+    OpCall::Param(key, value);
+    return *this;
+  }
+
+  SymBuilder &Input(const std::string &key, const Symbol &s) {
+    input_keys_.push_back(key);
+    input_syms_.push_back(s);
+    return *this;
+  }
+
+  SymBuilder &Input(const Symbol &s) {  /* positional */
+    input_syms_.push_back(s);
+    return *this;
+  }
+
+  Symbol Build(const std::string &name = "") {
+    std::vector<const char *> ks, vs;
+    for (auto &k : param_keys_) ks.push_back(k.c_str());
+    for (auto &v : param_vals_) vs.push_back(v.c_str());
+    SymbolHandle h = nullptr;
+    MXTPU_CHECK(MXSymbolCreateAtomicSymbol(
+        FindCreator(name_), static_cast<mx_uint>(ks.size()), ks.data(),
+        vs.data(), &h));
+    Symbol sym(h);
+    std::vector<const char *> iks;
+    std::vector<SymbolHandle> ihs;
+    for (auto &k : input_keys_) iks.push_back(k.c_str());
+    for (auto &s : input_syms_) ihs.push_back(s.handle());
+    MXTPU_CHECK(MXSymbolCompose(
+        sym.handle(), name.empty() ? nullptr : name.c_str(),
+        static_cast<mx_uint>(ihs.size()),
+        input_keys_.empty() ? nullptr : iks.data(), ihs.data()));
+    return sym;
+  }
+
+ private:
+  std::vector<std::string> input_keys_;
+  std::vector<Symbol> input_syms_;
+};
+
+/* hand-rolled wrappers for the hot layer set (the reference generates
+ * these; anything not listed: SymBuilder("<op>") reaches all ~380
+ * registered names) */
+inline Symbol FullyConnected(const std::string &name, const Symbol &data,
+                             int num_hidden) {
+  return SymBuilder("FullyConnected").Param("num_hidden", num_hidden)
+      .Input("data", data).Build(name);
+}
+
+inline Symbol Activation(const std::string &name, const Symbol &data,
+                         const std::string &act_type) {
+  return SymBuilder("Activation").Param("act_type", act_type)
+      .Input("data", data).Build(name);
+}
+
+inline Symbol SoftmaxOutput(const std::string &name, const Symbol &data,
+                            const Symbol &label,
+                            const std::string &normalization = "null") {
+  return SymBuilder("SoftmaxOutput").Param("normalization", normalization)
+      .Input("data", data).Input("label", label).Build(name);
+}
+
+inline Symbol Convolution(const std::string &name, const Symbol &data,
+                          const std::string &kernel, int num_filter,
+                          const std::string &stride = "(1, 1)",
+                          const std::string &pad = "(0, 0)") {
+  return SymBuilder("Convolution").Param("kernel", kernel)
+      .Param("num_filter", num_filter).Param("stride", stride)
+      .Param("pad", pad).Input("data", data).Build(name);
+}
+
+inline Symbol Pooling(const std::string &name, const Symbol &data,
+                      const std::string &kernel,
+                      const std::string &pool_type,
+                      const std::string &stride = "(1, 1)") {
+  return SymBuilder("Pooling").Param("kernel", kernel)
+      .Param("pool_type", pool_type).Param("stride", stride)
+      .Input("data", data).Build(name);
+}
+
+inline Symbol Flatten(const std::string &name, const Symbol &data) {
+  return SymBuilder("Flatten").Input("data", data).Build(name);
+}
+
+inline Symbol BatchNorm(const std::string &name, const Symbol &data) {
+  return SymBuilder("BatchNorm").Input("data", data).Build(name);
+}
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_SYMBOL_HPP_

@@ -85,7 +85,10 @@ class Predictor:
         if key not in self.exe.arg_dict:
             raise MXNetError("unknown input %r" % key)
         dst = self.exe.arg_dict[key]
-        src = np.asarray(data, dtype=np.float32).reshape(dst.shape)
+        # owned copy: `data` may view the C caller's buffer, whose
+        # lifetime ends when MXPredSetInput returns, and jax on CPU may
+        # alias numpy memory instead of copying
+        src = np.array(data, dtype=np.float32, copy=True).reshape(dst.shape)
         dst[:] = src
 
     def forward(self) -> None:

@@ -1,0 +1,405 @@
+"""Python support layer for the general C ABI.
+
+ref: include/mxnet/c_api.h (165 ``MX*`` entry points) and
+src/c_api/c_api.cc / c_api_symbolic.cc / c_api_executor.cc — the
+reference backs the ABI with its C++ runtime; here the runtime is this
+package, so ``native/c_api.cc`` embeds CPython and marshals flat C
+arguments into the calls below.  Every handle the C side holds is a
+``PyObject*`` owning one of: NDArray, CSymbol, Executor, KVStore.
+
+Design note: the C shim stays a dumb marshalling layer; anything with
+semantics (dtype codes, grad_req codes, compose rules, CSR shape
+marshalling) lives here where it is testable from pytest without a
+compiler.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, num_tpus, tpu
+from .executor import Executor
+from .ndarray import NDArray
+from .ndarray import ndarray as _nd
+from .ndarray.utils import load as _nd_load
+from .ndarray.utils import save as _nd_save
+from .ops import registry as _op_registry
+from .symbol import symbol as _sym
+
+__all__ = ["CSymbol"]
+
+# mshadow dtype codes (ref: 3rdparty/mshadow/mshadow/base.h kFloat32 …)
+_DTYPE_FROM_CODE = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                    4: "int32", 5: "int8", 6: "int64", -1: "float32"}
+_CODE_FROM_DTYPE = {v: k for k, v in _DTYPE_FROM_CODE.items() if k != -1}
+
+# OpReqType (ref: include/mxnet/op_attr_types.h:45)
+_GRAD_REQ = {0: "null", 1: "write", 2: "write", 3: "add"}
+
+
+def _device(dev_type: int, dev_id: int) -> Context:
+    # reference dev_type codes (include/mxnet/base.h): 1=cpu, 2=gpu,
+    # 3=cpu_pinned; the TPU build maps gpu → tpu
+    if dev_type == 2 and num_tpus() > 0:
+        return tpu(dev_id)
+    return cpu(dev_id)
+
+
+def _devcode(ctx: Context) -> Tuple[int, int]:
+    table = {"cpu": 1, "gpu": 2, "tpu": 2, "cpu_pinned": 3, "cpu_shared": 5}
+    return table.get(ctx.device_type, 1), ctx.device_id
+
+
+# ---------------------------------------------------------------------------
+# NDArray
+# ---------------------------------------------------------------------------
+def nd_create(shape: Sequence[int], dev_type: int, dev_id: int,
+              dtype: int = 0) -> NDArray:
+    """ref: MXNDArrayCreateEx (c_api.cc MXNDArrayCreateEx)."""
+    return _nd.zeros(tuple(int(d) for d in shape),
+                     ctx=_device(dev_type, dev_id),
+                     dtype=_DTYPE_FROM_CODE[int(dtype)])
+
+
+def nd_create_none() -> NDArray:
+    """ref: MXNDArrayCreateNone — a placeholder with no data."""
+    return _nd.zeros((0,))
+
+
+def nd_shape(arr: NDArray) -> Tuple[int, ...]:
+    return tuple(int(d) for d in arr.shape)
+
+
+def nd_dtype(arr: NDArray) -> int:
+    return _CODE_FROM_DTYPE.get(np.dtype(arr.dtype).name, 0)
+
+
+def nd_context(arr: NDArray) -> Tuple[int, int]:
+    return _devcode(arr.context)
+
+
+def nd_sync_copy_from(arr: NDArray, flat: np.ndarray) -> None:
+    """ref: MXNDArraySyncCopyFromCPU — the C side hands a flat buffer
+    already viewed with the array's dtype.
+
+    The view wraps the *caller's* memory (np.frombuffer over the C
+    pointer) and jax.device_put on CPU may alias rather than copy, so an
+    owned copy here is mandatory — the caller's buffer lifetime ends at
+    return (reference contract)."""
+    import jax
+
+    shape = tuple(arr.shape)
+    arr._data = jax.device_put(np.array(flat, copy=True).reshape(shape))
+    arr._vt = object()
+
+
+def nd_tobytes(arr: NDArray) -> bytes:
+    """ref: MXNDArraySyncCopyToCPU."""
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()
+
+
+def nd_slice(arr: NDArray, begin: int, end: int) -> NDArray:
+    return arr[int(begin):int(end)]
+
+
+def nd_at(arr: NDArray, idx: int) -> NDArray:
+    return arr[int(idx)]
+
+
+def nd_reshape(arr: NDArray, shape: Sequence[int]) -> NDArray:
+    return arr.reshape(tuple(int(d) for d in shape))
+
+
+def nd_save(fname: str, arrs: Sequence[NDArray],
+            keys: Sequence[str]) -> None:
+    if keys:
+        _nd_save(fname, dict(zip(keys, arrs)))
+    else:
+        _nd_save(fname, list(arrs))
+
+
+def nd_load(fname: str) -> Tuple[List[NDArray], List[str]]:
+    data = _nd_load(fname)
+    if isinstance(data, dict):
+        names = list(data)
+        return [data[k] for k in names], names
+    return list(data), []
+
+
+def nd_waitall() -> None:
+    from . import nd as _ndns
+
+    _ndns.waitall()
+
+
+def nd_wait(arr: NDArray) -> None:
+    arr.wait_to_read()
+
+
+# ---------------------------------------------------------------------------
+# operator registry + imperative invoke
+# ---------------------------------------------------------------------------
+def op_names() -> List[str]:
+    return _op_registry.list_ops()
+
+
+def op_info(name: str) -> Tuple[str, str, List[str]]:
+    """(name, doc, input_names) — ref: MXSymbolGetAtomicSymbolInfo."""
+    op = _op_registry.get(name)
+    return op.name, op.doc or "", list(op.input_names or ())
+
+
+def imperative_invoke(op_name: str, inputs: Sequence[NDArray],
+                      param_keys: Sequence[str],
+                      param_vals: Sequence[str],
+                      outputs: Optional[Sequence[NDArray]]) -> List[NDArray]:
+    """ref: MXImperativeInvoke (src/c_api/c_api_ndarray.cc:117).
+    Returns the output list; when ``outputs`` is given the results are
+    written into those arrays (reference out-param semantics)."""
+    params = dict(zip(param_keys, param_vals))
+    out = list(outputs) if outputs else None
+    res = _nd.invoke(op_name, list(inputs), params, out=out)
+    if isinstance(res, NDArray):
+        return [res]
+    return list(res)
+
+
+# ---------------------------------------------------------------------------
+# Symbol — handles are CSymbol wrappers so MXSymbolCompose can mutate
+# the object behind a stable PyObject* (reference symbols are mutated
+# in place by Compose, c_api_symbolic.cc MXSymbolCompose)
+# ---------------------------------------------------------------------------
+class CSymbol:
+    """C-ABI symbol handle: either a built Symbol or a pending atomic op
+    awaiting Compose."""
+
+    __slots__ = ("sym", "op", "params")
+
+    def __init__(self, sym: Optional[_sym.Symbol] = None,
+                 op: Optional[str] = None,
+                 params: Optional[Dict[str, str]] = None):
+        self.sym = sym
+        self.op = op
+        self.params = params or {}
+
+    def built(self) -> _sym.Symbol:
+        if self.sym is None:
+            # an atomic symbol used without compose: all-variable inputs
+            self.sym = _sym.create(self.op, **self.params)
+        return self.sym
+
+
+def sym_create_atomic(op_name: str, keys: Sequence[str],
+                      vals: Sequence[str]) -> CSymbol:
+    """ref: MXSymbolCreateAtomicSymbol."""
+    _op_registry.get(op_name)  # validate early
+    return CSymbol(op=op_name, params=dict(zip(keys, vals)))
+
+
+def sym_compose(h: CSymbol, name: Optional[str], keys: Sequence[str],
+                args: Sequence[CSymbol]) -> None:
+    """ref: MXSymbolCompose — attach inputs, finalize the node."""
+    if h.op is None:
+        raise MXNetError("Compose on a non-atomic symbol")
+    kwargs = dict(h.params)
+    arg_syms = [a.built() for a in args]
+    if keys:
+        for k, s in zip(keys, arg_syms):
+            kwargs[k] = s
+        h.sym = _sym.create(h.op, name=name or None, **kwargs)
+    else:
+        h.sym = _sym.create(h.op, *arg_syms, name=name or None, **kwargs)
+
+
+def sym_variable(name: str) -> CSymbol:
+    return CSymbol(sym=_sym.Variable(name))
+
+
+def sym_group(handles: Sequence[CSymbol]) -> CSymbol:
+    return CSymbol(sym=_sym.Group([h.built() for h in handles]))
+
+
+def sym_from_json(json_str: str) -> CSymbol:
+    return CSymbol(sym=_sym.load_json(json_str))
+
+
+def sym_from_file(fname: str) -> CSymbol:
+    return CSymbol(sym=_sym.load(fname))
+
+
+def sym_to_json(h: CSymbol) -> str:
+    return h.built().tojson()
+
+
+def sym_save(h: CSymbol, fname: str) -> None:
+    h.built().save(fname)
+
+
+def sym_copy(h: CSymbol) -> CSymbol:
+    # deep copy through JSON so SetAttr on the copy cannot touch nodes
+    # shared with the original (reference MXSymbolCopy contract)
+    return CSymbol(sym=_sym.load_json(h.built().tojson()))
+
+
+def sym_name(h: CSymbol) -> str:
+    return h.built().name
+
+
+def sym_list_arguments(h: CSymbol) -> List[str]:
+    return h.built().list_arguments()
+
+
+def sym_list_outputs(h: CSymbol) -> List[str]:
+    return h.built().list_outputs()
+
+
+def sym_list_aux(h: CSymbol) -> List[str]:
+    return h.built().list_auxiliary_states()
+
+
+def sym_get_internals(h: CSymbol) -> CSymbol:
+    return CSymbol(sym=h.built().get_internals())
+
+
+def sym_get_output(h: CSymbol, index: int) -> CSymbol:
+    return CSymbol(sym=h.built()[int(index)])
+
+
+def sym_num_outputs(h: CSymbol) -> int:
+    return len(h.built().list_outputs())
+
+
+def sym_get_attr(h: CSymbol, key: str) -> Optional[str]:
+    return h.built().attr(key)
+
+
+def sym_set_attr(h: CSymbol, key: str, value: str) -> None:
+    node = h.built()._entries[0][0]
+    node.attrs["__%s__" % key if not key.startswith("__") else key] = value
+
+
+def sym_infer_shape(h: CSymbol, keys: Sequence[str],
+                    shapes: Sequence[Sequence[int]], partial: bool):
+    """ref: MXSymbolInferShape(Partial) — returns
+    (arg_shapes, out_shapes, aux_shapes, complete)."""
+    from .symbol.infer import infer_shape
+
+    kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
+    arg, out, aux = infer_shape(h.built(), partial=partial, **kwargs)
+    complete = all(s is not None for s in list(arg) + list(out) +
+                   list(aux))
+    fix = lambda lst: [tuple(s) if s is not None else () for s in lst]
+    return fix(arg), fix(out), fix(aux), complete
+
+
+def sym_infer_type(h: CSymbol, keys: Sequence[str],
+                   dtypes: Sequence[int]):
+    """ref: MXSymbolInferType."""
+    from .symbol.infer import infer_type
+
+    kwargs = {k: _DTYPE_FROM_CODE[int(d)] for k, d in zip(keys, dtypes)}
+    arg, out, aux = infer_type(h.built(), **kwargs)
+    code = lambda lst: [_CODE_FROM_DTYPE.get(np.dtype(t).name, 0)
+                       if t is not None else -1 for t in lst]
+    return code(arg), code(out), code(aux), True
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+def exec_bind(h: CSymbol, dev_type: int, dev_id: int,
+              g2c_keys: Sequence[str], g2c_dev_types: Sequence[int],
+              g2c_dev_ids: Sequence[int], in_args: Sequence[NDArray],
+              arg_grads: Sequence[Optional[NDArray]],
+              grad_reqs: Sequence[int],
+              aux_states: Sequence[NDArray]) -> Executor:
+    """ref: MXExecutorBindEX (c_api_executor.cc)."""
+    sym = h.built()
+    ctx = _device(dev_type, dev_id)
+    group2ctx = {k: _device(t, i) for k, t, i in
+                 zip(g2c_keys, g2c_dev_types, g2c_dev_ids)} or None
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    if len(in_args) != len(arg_names):
+        raise MXNetError("Bind: %d args given, %d expected"
+                         % (len(in_args), len(arg_names)))
+    args = dict(zip(arg_names, in_args))
+    req = {n: _GRAD_REQ[int(r)] for n, r in zip(arg_names, grad_reqs)}
+    grads = {n: g for n, g in zip(arg_names, arg_grads) if g is not None}
+    return Executor.bind(sym, ctx=ctx, args=args, args_grad=grads,
+                         grad_req=req,
+                         aux_states=dict(zip(aux_names, aux_states)),
+                         group2ctx=group2ctx)
+
+
+def exec_forward(ex: Executor, is_train: int) -> None:
+    ex.forward(is_train=bool(is_train))
+
+
+def exec_backward(ex: Executor, head_grads: Sequence[NDArray]) -> None:
+    ex.backward(list(head_grads) if head_grads else None)
+
+
+def exec_outputs(ex: Executor) -> List[NDArray]:
+    if not ex.outputs:
+        ex.forward()
+    return list(ex.outputs)
+
+
+def exec_print(ex: Executor) -> str:
+    lines = ["Symbol outputs: %s" % ", ".join(ex._output_names)]
+    for name, arr in ex.arg_dict.items():
+        lines.append("arg %s %s %s" % (name, arr.shape,
+                                       np.dtype(arr.dtype).name))
+    for name, arr in ex.aux_dict.items():
+        lines.append("aux %s %s %s" % (name, arr.shape,
+                                       np.dtype(arr.dtype).name))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# KVStore
+# ---------------------------------------------------------------------------
+def kv_create(kind: str):
+    from . import kvstore as _kv
+
+    return _kv.create(kind)
+
+
+def kv_init(kv, keys: Sequence, vals: Sequence[NDArray]) -> None:
+    kv.init(list(keys), list(vals))
+
+
+def kv_push(kv, keys: Sequence, vals: Sequence[NDArray],
+            priority: int) -> None:
+    kv.push(list(keys), list(vals), priority=priority)
+
+
+def kv_pull(kv, keys: Sequence, outs: Sequence[NDArray],
+            priority: int) -> None:
+    kv.pull(list(keys), out=list(outs), priority=priority)
+
+
+def kv_type(kv) -> str:
+    return kv.type
+
+
+def kv_rank(kv) -> int:
+    return kv.rank
+
+
+def kv_num_workers(kv) -> int:
+    return kv.num_workers
+
+
+def kv_barrier(kv) -> None:
+    kv._barrier() if hasattr(kv, "_barrier") else None
+
+
+def kv_set_updater(kv, trampoline) -> None:
+    """``trampoline(key:int, recv:NDArray, local:NDArray)`` calls back
+    into the C function pointer (ref: MXKVStoreSetUpdater)."""
+    kv.set_updater(lambda key, recv, local: trampoline(int(key), recv,
+                                                       local))

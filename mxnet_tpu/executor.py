@@ -128,7 +128,9 @@ def build_graph_eval(symbol, collect_internals: bool = False,
                 env[id(node)] = [val]
                 continue
             op = _op_registry.get(node.op)
-            params = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
+            params = {k: _op_registry.coerce_attr(v)
+                      for k, v in node.attrs.items()
+                      if not k.startswith("__")}
             if op.train_aware:
                 params["_training"] = training
             args = [env[id(p)][oi] for p, oi in node.inputs]

@@ -120,14 +120,45 @@ class Op:
         return self.fn(*arrays, **params)
 
 
+def _parse_scalar(s: str):
+    t = s.strip()
+    if t in ("True", "true"):
+        return True
+    if t in ("False", "false"):
+        return False
+    if t in ("None",):
+        return None
+    try:
+        return int(t)
+    except ValueError:
+        pass
+    try:
+        return float(t)
+    except ValueError:
+        pass
+    return s
+
+
+def coerce_attr(v: Any):
+    """Parse a string attribute into its typed value — the dmlc::Parameter
+    string-parsing analogue (ref: src/c_api/c_api_ndarray.cc:117 routes
+    param_vals as strings; nnvm JSON attrs are always strings).  Numbers,
+    booleans, ``None`` and flat ``(a, b)``/``[a, b]`` tuples parse; any
+    other string (act_type names, dtype names, …) passes through."""
+    if not isinstance(v, str):
+        return tuple(v) if isinstance(v, list) else v
+    t = v.strip()
+    if t.startswith(("(", "[")) and t.endswith((")", "]")):
+        inner = t[1:-1].strip()
+        if not inner:
+            return ()
+        parts = [p.strip() for p in inner.split(",") if p.strip()]
+        return tuple(_parse_scalar(p) for p in parts)
+    return _parse_scalar(t)
+
+
 def _freeze(params: Dict[str, Any]) -> Tuple[Tuple[str, Any], ...]:
-    out = []
-    for k in sorted(params):
-        v = params[k]
-        if isinstance(v, list):
-            v = tuple(v)
-        out.append((k, v))
-    return tuple(out)
+    return tuple((k, coerce_attr(params[k])) for k in sorted(params))
 
 
 @functools.lru_cache(maxsize=4096)

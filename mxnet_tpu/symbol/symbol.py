@@ -330,6 +330,10 @@ def create(op_name: str, *args, name: Optional[str] = None, **kwargs) -> Symbol:
     """Create an op node, auto-creating missing tensor-input variables
     (the reference behavior from the generated symbol stubs)."""
     op = _op_registry.get(op_name)
+    # string-valued params (C ABI, reference-style code) parse to their
+    # typed values here so input-arity decisions ("no_bias") see booleans
+    kwargs = {k: (v if isinstance(v, Symbol) else _op_registry.coerce_attr(v))
+              for k, v in kwargs.items()}
     attrs = {}
     sym_inputs: List[Tuple[_Node, int]] = []
 
@@ -401,6 +405,8 @@ def _default_no_bias(op) -> bool:
 
 def _static_num_outputs(op: _op_registry.Op, params: Dict[str, Any]) -> int:
     """Total arrays the op body returns (visible outputs + aux writebacks)."""
+    # attrs may arrive as strings (JSON load, C ABI) — "False" is truthy
+    params = {k: _op_registry.coerce_attr(v) for k, v in params.items()}
     if op.name == "SliceChannel":
         return int(params.get("num_outputs", 1))
     if op.name == "Custom":

@@ -1,9 +1,11 @@
 #!/bin/sh
-# Build libmxnet_tpu.so — the embedded-python C predict ABI
+# Build libmxnet_tpu.so — the embedded-python C ABI: the predict surface
+# (c_predict_api.cc) plus the general MXNDArray*/MXSymbol*/MXExecutor*/
+# MXKVStore* surface (c_api.cc).
 # (ref: the reference ships these entry points inside libmxnet.so).
 set -e
 cd "$(dirname "$0")"
-g++ -O2 -shared -fPIC -std=c++17 c_predict_api.cc \
+g++ -O2 -shared -fPIC -std=c++17 c_predict_api.cc c_api.cc \
     $(python3-config --includes) \
     $(python3-config --ldflags --embed) \
     -o libmxnet_tpu.so

@@ -1,0 +1,1077 @@
+/* General C ABI over the embedded-CPython runtime.
+ *
+ * ref: include/mxnet/c_api.h (the 165-entry MXNET_DLL surface) and its
+ * backing src/c_api/{c_api.cc,c_api_ndarray.cc,c_api_symbolic.cc,
+ * c_api_executor.cc}.  The reference marshals into its C++ runtime;
+ * this build marshals into mxnet_tpu.cabi_runtime (see that module for
+ * the semantic layer).  Handle types are PyObject* owning NDArray /
+ * CSymbol / Executor / KVStore objects; MX*Free drops the reference.
+ *
+ * Covered families: MXNDArray*, MXImperativeInvoke, MXSymbol*,
+ * MXExecutor{Bind,BindX,BindEX,Forward,Backward,Outputs,Free,Print},
+ * MXKVStore* (single-process surface), registry introspection.
+ * Deliberately absent (documented parity gaps): MXExecutorSimpleBind
+ * (the cpp frontend binds explicitly), custom-op/RTC registration
+ * (PallasModule is python-only), and the DataIter C surface (the cpp
+ * frontend feeds NDArrays directly).
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "embed_common.h"
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+typedef void *AtomicSymbolCreator;
+
+#define MXNET_DLL __attribute__((visibility("default")))
+#define MXAPI extern "C" MXNET_DLL
+
+using mxtpu::CallRt;
+using mxtpu::Fail;
+using mxtpu::Gil;
+using mxtpu::HandleList;
+using mxtpu::LastError;
+using mxtpu::StrList;
+
+namespace {
+
+/* dtype element sizes by mshadow code (ref: mshadow/base.h) */
+size_t DtypeSize(int code) {
+  switch (code) {
+    case 0: return 4;   /* float32 */
+    case 1: return 8;   /* float64 */
+    case 2: return 2;   /* float16 */
+    case 3: return 1;   /* uint8 */
+    case 4: return 4;   /* int32 */
+    case 5: return 1;   /* int8 */
+    case 6: return 8;   /* int64 */
+    default: return 4;
+  }
+}
+
+const char *DtypeNumpyName(int code) {
+  switch (code) {
+    case 0: return "float32";
+    case 1: return "float64";
+    case 2: return "float16";
+    case 3: return "uint8";
+    case 4: return "int32";
+    case 5: return "int8";
+    case 6: return "int64";
+    default: return "float32";
+  }
+}
+
+/* take one handle out of a python return value (new ref → handle) */
+int ReturnHandle(PyObject *obj, void **out, const char *where) {
+  if (!obj) return Fail(where);
+  *out = obj;
+  return 0;
+}
+
+/* unpack a python sequence of objects into a thread-local handle array;
+ * the objects are increfed (caller of the ABI owns them via MX*Free) */
+struct HandleStore {
+  std::vector<void *> handles;
+  int Fill(PyObject *seq_any, mx_uint *out_size, NDArrayHandle **out,
+           const char *where) {
+    PyObject *seq = PySequence_Fast(seq_any, where);
+    if (!seq) return Fail(where);
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PySequence_Fast_GET_ITEM(seq, i);
+      Py_INCREF(it);
+      handles.push_back(it);
+    }
+    Py_DECREF(seq);
+    *out_size = static_cast<mx_uint>(handles.size());
+    *out = handles.data();
+    return 0;
+  }
+};
+
+thread_local HandleStore g_nd_out_store;     /* invoke / outputs / load */
+thread_local HandleStore g_exec_out_store;
+thread_local std::vector<mx_uint> g_shape_store;
+thread_local std::string g_str_store;
+thread_local mxtpu::StrStore g_list_store;   /* arguments/outputs/aux */
+thread_local mxtpu::StrStore g_load_names_store;
+
+/* one CSR shape-group return buffer (InferShape has three) */
+struct ShapeGroup {
+  std::vector<mx_uint> ndims;
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<const mx_uint *> ptrs;
+  int Fill(PyObject *seq_any, mx_uint *out_size, const mx_uint **out_ndim,
+           const mx_uint ***out_data) {
+    PyObject *seq = PySequence_Fast(seq_any, "shape list");
+    if (!seq) return Fail("InferShape result");
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    ndims.clear();
+    shapes.assign(n, {});
+    ptrs.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *shp = PySequence_Fast_GET_ITEM(seq, i);
+      Py_ssize_t nd = PyTuple_Check(shp) ? PyTuple_Size(shp) : 0;
+      for (Py_ssize_t d = 0; d < nd; ++d)
+        shapes[i].push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(shp, d))));
+      ndims.push_back(static_cast<mx_uint>(shapes[i].size()));
+    }
+    Py_DECREF(seq);
+    for (const auto &s : shapes) ptrs.push_back(s.data());
+    *out_size = static_cast<mx_uint>(ndims.size());
+    *out_ndim = ndims.data();
+    *out_data = ptrs.data();
+    return 0;
+  }
+};
+
+thread_local ShapeGroup g_in_shapes, g_out_shapes, g_aux_shapes;
+
+struct TypeGroup {
+  std::vector<int> codes;
+  int Fill(PyObject *seq_any, mx_uint *out_size, const int **out_data) {
+    PyObject *seq = PySequence_Fast(seq_any, "type list");
+    if (!seq) return Fail("InferType result");
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    codes.clear();
+    for (Py_ssize_t i = 0; i < n; ++i)
+      codes.push_back(static_cast<int>(
+          PyLong_AsLong(PySequence_Fast_GET_ITEM(seq, i))));
+    Py_DECREF(seq);
+    *out_size = static_cast<mx_uint>(codes.size());
+    *out_data = codes.data();
+    return 0;
+  }
+};
+
+thread_local TypeGroup g_in_types, g_out_types, g_aux_types;
+
+PyObject *ShapeTupleList(mx_uint num, const mx_uint *ind_ptr,
+                         const mx_uint *data) {
+  PyObject *lst = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint b = ind_ptr[i], e = ind_ptr[i + 1];
+    PyObject *t = PyTuple_New(e - b);
+    for (mx_uint d = b; d < e; ++d)
+      PyTuple_SetItem(t, d - b, PyLong_FromUnsignedLong(data[d]));
+    PyList_SetItem(lst, i, t);
+  }
+  return lst;
+}
+
+}  // namespace
+
+/* ====================================================================
+ * NDArray
+ * ==================================================================== */
+MXAPI int MXNDArrayCreateNone(NDArrayHandle *out) {
+  Gil gil;
+  return ReturnHandle(CallRt("nd_create_none", nullptr),
+                      out, "MXNDArrayCreateNone");
+}
+
+MXAPI int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                            int dev_type, int dev_id, int delay_alloc,
+                            int dtype, NDArrayHandle *out) {
+  (void)delay_alloc;  /* XLA owns allocation; nothing to delay */
+  Gil gil;
+  PyObject *shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject *r = CallRt("nd_create", "Oiii", shp, dev_type, dev_id, dtype);
+  Py_DECREF(shp);
+  return ReturnHandle(r, out, "MXNDArrayCreateEx");
+}
+
+MXAPI int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                          int dev_id, int delay_alloc, NDArrayHandle *out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0,
+                           out);
+}
+
+MXAPI int MXNDArrayFree(NDArrayHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+MXAPI int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                            const mx_uint **out_pdata) {
+  Gil gil;
+  PyObject *shp = CallRt("nd_shape", "O", static_cast<PyObject *>(handle));
+  if (!shp) return Fail("MXNDArrayGetShape");
+  Py_ssize_t n = PyTuple_Size(shp);
+  g_shape_store.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    g_shape_store[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(shp, i)));
+  Py_DECREF(shp);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = g_shape_store.data();
+  return 0;
+}
+
+MXAPI int MXNDArrayGetDType(NDArrayHandle handle, int *out) {
+  Gil gil;
+  PyObject *r = CallRt("nd_dtype", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXNDArrayGetDType");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                              int *out_dev_id) {
+  Gil gil;
+  PyObject *r = CallRt("nd_context", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXNDArrayGetContext");
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                                   size_t size) {
+  Gil gil;
+  int dtype = 0;
+  if (MXNDArrayGetDType(handle, &dtype) != 0) return -1;
+  PyObject *view = PyMemoryView_FromMemory(
+      const_cast<char *>(static_cast<const char *>(data)),
+      static_cast<Py_ssize_t>(size) * DtypeSize(dtype), PyBUF_READ);
+  if (!view) return Fail("MXNDArraySyncCopyFromCPU view");
+  PyObject *np = PyImport_ImportModule("numpy");
+  PyObject *arr = nullptr;
+  if (np) {
+    arr = PyObject_CallMethod(np, "frombuffer", "Os", view,
+                              DtypeNumpyName(dtype));
+    Py_DECREF(np);
+  }
+  Py_DECREF(view);
+  if (!arr) return Fail("MXNDArraySyncCopyFromCPU frombuffer");
+  PyObject *r = CallRt("nd_sync_copy_from", "OO",
+                       static_cast<PyObject *>(handle), arr);
+  Py_DECREF(arr);
+  if (!r) return Fail("MXNDArraySyncCopyFromCPU");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                 size_t size) {
+  Gil gil;
+  int dtype = 0;
+  if (MXNDArrayGetDType(handle, &dtype) != 0) return -1;
+  PyObject *b = CallRt("nd_tobytes", "O", static_cast<PyObject *>(handle));
+  if (!b) return Fail("MXNDArraySyncCopyToCPU");
+  char *buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(b, &buf, &n) != 0) {
+    Py_DECREF(b);
+    return Fail("MXNDArraySyncCopyToCPU buffer");
+  }
+  size_t want = size * DtypeSize(dtype);
+  if (static_cast<size_t>(n) != want) {
+    Py_DECREF(b);
+    LastError() = "MXNDArraySyncCopyToCPU: size mismatch (have " +
+                  std::to_string(n) + " bytes, caller wants " +
+                  std::to_string(want) + ")";
+    return -1;
+  }
+  std::memcpy(data, buf, n);
+  Py_DECREF(b);
+  return 0;
+}
+
+MXAPI int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  Gil gil;
+  PyObject *r = CallRt("nd_wait", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXNDArrayWaitToRead");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return MXNDArrayWaitToRead(handle);
+}
+
+MXAPI int MXNDArrayWaitAll() {
+  Gil gil;
+  PyObject *r = CallRt("nd_waitall", nullptr);
+  if (!r) return Fail("MXNDArrayWaitAll");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                         NDArrayHandle *out) {
+  Gil gil;
+  return ReturnHandle(CallRt("nd_slice", "OII",
+                             static_cast<PyObject *>(handle), begin, end),
+                      out, "MXNDArraySlice");
+}
+
+MXAPI int MXNDArrayAt(NDArrayHandle handle, mx_uint idx,
+                      NDArrayHandle *out) {
+  Gil gil;
+  return ReturnHandle(CallRt("nd_at", "OI", static_cast<PyObject *>(handle),
+                             idx),
+                      out, "MXNDArrayAt");
+}
+
+MXAPI int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                           NDArrayHandle *out) {
+  Gil gil;
+  PyObject *shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SetItem(shp, i, PyLong_FromLong(dims[i]));
+  PyObject *r = CallRt("nd_reshape", "OO", static_cast<PyObject *>(handle),
+                       shp);
+  Py_DECREF(shp);
+  return ReturnHandle(r, out, "MXNDArrayReshape");
+}
+
+MXAPI int MXNDArraySave(const char *fname, mx_uint num_args,
+                        NDArrayHandle *args, const char **keys) {
+  Gil gil;
+  PyObject *arrs = HandleList(num_args, args);
+  PyObject *ks = keys ? StrList(num_args, keys) : PyList_New(0);
+  PyObject *r = CallRt("nd_save", "sOO", fname, arrs, ks);
+  Py_DECREF(arrs);
+  Py_DECREF(ks);
+  if (!r) return Fail("MXNDArraySave");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                        NDArrayHandle **out_arr, mx_uint *out_name_size,
+                        const char ***out_names) {
+  Gil gil;
+  PyObject *r = CallRt("nd_load", "s", fname);
+  if (!r) return Fail("MXNDArrayLoad");
+  PyObject *arrs = PyTuple_GetItem(r, 0);
+  PyObject *names = PyTuple_GetItem(r, 1);
+  int rc = g_nd_out_store.Fill(arrs, out_size, out_arr, "MXNDArrayLoad");
+  if (rc == 0) rc = g_load_names_store.Fill(names, out_name_size, out_names);
+  Py_DECREF(r);
+  return rc;
+}
+
+/* ====================================================================
+ * registry + imperative invoke
+ * ==================================================================== */
+namespace {
+/* creators are interned op-name strings, alive for the process */
+std::vector<PyObject *> *g_creators = nullptr;
+}  // namespace
+
+MXAPI int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                           AtomicSymbolCreator **out_array) {
+  Gil gil;
+  static thread_local std::vector<void *> creators_view;
+  if (!g_creators) {
+    PyObject *names = CallRt("op_names", nullptr);
+    if (!names) return Fail("MXSymbolListAtomicSymbolCreators");
+    PyObject *seq = PySequence_Fast(names, "op names");
+    Py_DECREF(names);
+    if (!seq) return Fail("MXSymbolListAtomicSymbolCreators");
+    g_creators = new std::vector<PyObject *>();
+    for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(seq); ++i) {
+      PyObject *it = PySequence_Fast_GET_ITEM(seq, i);
+      Py_INCREF(it);
+      g_creators->push_back(it);
+    }
+    Py_DECREF(seq);
+  }
+  creators_view.assign(g_creators->begin(), g_creators->end());
+  *out_size = static_cast<mx_uint>(creators_view.size());
+  *out_array = creators_view.data();
+  return 0;
+}
+
+MXAPI int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                      const char **name) {
+  Gil gil;
+  const char *s = PyUnicode_AsUTF8(static_cast<PyObject *>(creator));
+  if (!s) return Fail("MXSymbolGetAtomicSymbolName");
+  *name = s;  /* interned for process lifetime */
+  return 0;
+}
+
+MXAPI int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char **name, const char **description,
+    mx_uint *num_args, const char ***arg_names, const char ***arg_type_infos,
+    const char ***arg_descriptions, const char **key_var_num_args,
+    const char **return_type) {
+  Gil gil;
+  static thread_local std::string desc_store;
+  static thread_local mxtpu::StrStore args_store;
+  static thread_local std::vector<const char *> empty_infos;
+  PyObject *r = CallRt("op_info", "O", static_cast<PyObject *>(creator));
+  if (!r) return Fail("MXSymbolGetAtomicSymbolInfo");
+  *name = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  desc_store = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  *description = desc_store.c_str();
+  mx_uint n = 0;
+  const char **names_arr = nullptr;
+  int rc = args_store.Fill(PyTuple_GetItem(r, 2), &n, &names_arr);
+  Py_DECREF(r);
+  if (rc != 0) return rc;
+  *num_args = n;
+  *arg_names = names_arr;
+  empty_infos.assign(n, "");
+  if (arg_type_infos) *arg_type_infos = empty_infos.data();
+  if (arg_descriptions) *arg_descriptions = empty_infos.data();
+  if (key_var_num_args) *key_var_num_args = "";
+  if (return_type) *return_type = "";
+  return 0;
+}
+
+MXAPI int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                             NDArrayHandle *inputs, int *num_outputs,
+                             NDArrayHandle **outputs, int num_params,
+                             const char **param_keys,
+                             const char **param_vals) {
+  Gil gil;
+  PyObject *ins = HandleList(num_inputs, inputs);
+  PyObject *keys = StrList(num_params, param_keys);
+  PyObject *vals = StrList(num_params, param_vals);
+  int had_outs = (*outputs != nullptr && *num_outputs > 0);
+  PyObject *outs;
+  if (had_outs) {
+    outs = HandleList(*num_outputs, *outputs);
+  } else {
+    outs = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *r = CallRt("imperative_invoke", "OOOOO",
+                       static_cast<PyObject *>(creator), ins, keys, vals,
+                       outs);
+  Py_DECREF(ins);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  Py_DECREF(outs);
+  if (!r) return Fail("MXImperativeInvoke");
+  if (had_outs) {
+    /* results were written into the caller-provided arrays in place */
+    *num_outputs = static_cast<int>(PySequence_Size(r));
+    Py_DECREF(r);
+    return 0;
+  }
+  mx_uint n = 0;
+  NDArrayHandle *arr = nullptr;
+  int rc = g_nd_out_store.Fill(r, &n, &arr, "MXImperativeInvoke");
+  Py_DECREF(r);
+  if (rc != 0) return rc;
+  *num_outputs = static_cast<int>(n);
+  *outputs = arr;
+  return 0;
+}
+
+/* ====================================================================
+ * Symbol
+ * ==================================================================== */
+MXAPI int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                                     mx_uint num_param, const char **keys,
+                                     const char **vals, SymbolHandle *out) {
+  Gil gil;
+  PyObject *ks = StrList(num_param, keys);
+  PyObject *vs = StrList(num_param, vals);
+  PyObject *r = CallRt("sym_create_atomic", "OOO",
+                       static_cast<PyObject *>(creator), ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  return ReturnHandle(r, out, "MXSymbolCreateAtomicSymbol");
+}
+
+MXAPI int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  Gil gil;
+  return ReturnHandle(CallRt("sym_variable", "s", name), out,
+                      "MXSymbolCreateVariable");
+}
+
+MXAPI int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                              SymbolHandle *out) {
+  Gil gil;
+  PyObject *lst = HandleList(num_symbols, symbols);
+  PyObject *r = CallRt("sym_group", "O", lst);
+  Py_DECREF(lst);
+  return ReturnHandle(r, out, "MXSymbolCreateGroup");
+}
+
+MXAPI int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  Gil gil;
+  return ReturnHandle(CallRt("sym_from_json", "s", json), out,
+                      "MXSymbolCreateFromJSON");
+}
+
+MXAPI int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  Gil gil;
+  return ReturnHandle(CallRt("sym_from_file", "s", fname), out,
+                      "MXSymbolCreateFromFile");
+}
+
+MXAPI int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json) {
+  Gil gil;
+  PyObject *r = CallRt("sym_to_json", "O", static_cast<PyObject *>(symbol));
+  if (!r) return Fail("MXSymbolSaveToJSON");
+  g_str_store = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_json = g_str_store.c_str();
+  return 0;
+}
+
+MXAPI int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname) {
+  Gil gil;
+  PyObject *r = CallRt("sym_save", "Os", static_cast<PyObject *>(symbol),
+                       fname);
+  if (!r) return Fail("MXSymbolSaveToFile");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXSymbolFree(SymbolHandle symbol) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(symbol));
+  return 0;
+}
+
+MXAPI int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out) {
+  Gil gil;
+  return ReturnHandle(CallRt("sym_copy", "O",
+                             static_cast<PyObject *>(symbol)),
+                      out, "MXSymbolCopy");
+}
+
+MXAPI int MXSymbolPrint(SymbolHandle symbol, const char **out_str) {
+  return MXSymbolSaveToJSON(symbol, out_str);
+}
+
+MXAPI int MXSymbolGetName(SymbolHandle symbol, const char **out,
+                          int *success) {
+  Gil gil;
+  PyObject *r = CallRt("sym_name", "O", static_cast<PyObject *>(symbol));
+  if (!r) return Fail("MXSymbolGetName");
+  g_str_store = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out = g_str_store.c_str();
+  *success = 1;
+  return 0;
+}
+
+MXAPI int MXSymbolGetAttr(SymbolHandle symbol, const char *key,
+                          const char **out, int *success) {
+  Gil gil;
+  PyObject *r = CallRt("sym_get_attr", "Os",
+                       static_cast<PyObject *>(symbol), key);
+  if (!r) return Fail("MXSymbolGetAttr");
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    g_str_store = PyUnicode_AsUTF8(r);
+    *out = g_str_store.c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
+                          const char *value) {
+  Gil gil;
+  PyObject *r = CallRt("sym_set_attr", "Oss",
+                       static_cast<PyObject *>(symbol), key, value);
+  if (!r) return Fail("MXSymbolSetAttr");
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+int ListNames(SymbolHandle symbol, const char *fn, mx_uint *out_size,
+              const char ***out_str_array) {
+  Gil gil;
+  PyObject *r = CallRt(fn, "O", static_cast<PyObject *>(symbol));
+  if (!r) return Fail(fn);
+  int rc = g_list_store.Fill(r, out_size, out_str_array);
+  Py_DECREF(r);
+  return rc;
+}
+}  // namespace
+
+MXAPI int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array) {
+  return ListNames(symbol, "sym_list_arguments", out_size, out_str_array);
+}
+
+MXAPI int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                              const char ***out_str_array) {
+  return ListNames(symbol, "sym_list_outputs", out_size, out_str_array);
+}
+
+MXAPI int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                      const char ***out_str_array) {
+  return ListNames(symbol, "sym_list_aux", out_size, out_str_array);
+}
+
+MXAPI int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out) {
+  Gil gil;
+  return ReturnHandle(CallRt("sym_get_internals", "O",
+                             static_cast<PyObject *>(symbol)),
+                      out, "MXSymbolGetInternals");
+}
+
+MXAPI int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index,
+                            SymbolHandle *out) {
+  Gil gil;
+  return ReturnHandle(CallRt("sym_get_output", "OI",
+                             static_cast<PyObject *>(symbol), index),
+                      out, "MXSymbolGetOutput");
+}
+
+MXAPI int MXSymbolGetNumOutputs(SymbolHandle symbol, mx_uint *output_count) {
+  Gil gil;
+  PyObject *r = CallRt("sym_num_outputs", "O",
+                       static_cast<PyObject *>(symbol));
+  if (!r) return Fail("MXSymbolGetNumOutputs");
+  *output_count = static_cast<mx_uint>(PyLong_AsUnsignedLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXSymbolCompose(SymbolHandle sym, const char *name,
+                          mx_uint num_args, const char **keys,
+                          SymbolHandle *args) {
+  Gil gil;
+  PyObject *ks = keys ? StrList(num_args, keys) : PyList_New(0);
+  PyObject *as = HandleList(num_args, args);
+  PyObject *r = CallRt("sym_compose", "OsOO", static_cast<PyObject *>(sym),
+                       name ? name : "", ks, as);
+  Py_DECREF(ks);
+  Py_DECREF(as);
+  if (!r) return Fail("MXSymbolCompose");
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+int InferShapeImpl(SymbolHandle sym, mx_uint num_args, const char **keys,
+                   const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+                   mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+                   const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+                   const mx_uint **out_shape_ndim,
+                   const mx_uint ***out_shape_data, mx_uint *aux_shape_size,
+                   const mx_uint **aux_shape_ndim,
+                   const mx_uint ***aux_shape_data, int *complete,
+                   int partial) {
+  Gil gil;
+  PyObject *ks = StrList(num_args, keys);
+  PyObject *shapes = ShapeTupleList(num_args, arg_ind_ptr, arg_shape_data);
+  PyObject *r = CallRt("sym_infer_shape", "OOOi",
+                       static_cast<PyObject *>(sym), ks, shapes, partial);
+  Py_DECREF(ks);
+  Py_DECREF(shapes);
+  if (!r) return Fail("MXSymbolInferShape");
+  int rc = g_in_shapes.Fill(PyTuple_GetItem(r, 0), in_shape_size,
+                            in_shape_ndim, in_shape_data);
+  if (rc == 0)
+    rc = g_out_shapes.Fill(PyTuple_GetItem(r, 1), out_shape_size,
+                           out_shape_ndim, out_shape_data);
+  if (rc == 0)
+    rc = g_aux_shapes.Fill(PyTuple_GetItem(r, 2), aux_shape_size,
+                           aux_shape_ndim, aux_shape_data);
+  if (rc == 0) *complete = PyObject_IsTrue(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  return rc;
+}
+}  // namespace
+
+MXAPI int MXSymbolInferShape(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  return InferShapeImpl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                        in_shape_size, in_shape_ndim, in_shape_data,
+                        out_shape_size, out_shape_ndim, out_shape_data,
+                        aux_shape_size, aux_shape_ndim, aux_shape_data,
+                        complete, 0);
+}
+
+MXAPI int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  return InferShapeImpl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                        in_shape_size, in_shape_ndim, in_shape_data,
+                        out_shape_size, out_shape_ndim, out_shape_data,
+                        aux_shape_size, aux_shape_ndim, aux_shape_data,
+                        complete, 1);
+}
+
+MXAPI int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                            const char **keys, const int *arg_type_data,
+                            mx_uint *in_type_size, const int **in_type_data,
+                            mx_uint *out_type_size,
+                            const int **out_type_data,
+                            mx_uint *aux_type_size,
+                            const int **aux_type_data, int *complete) {
+  Gil gil;
+  PyObject *ks = StrList(num_args, keys);
+  PyObject *ts = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyList_SetItem(ts, i, PyLong_FromLong(arg_type_data[i]));
+  PyObject *r = CallRt("sym_infer_type", "OOO",
+                       static_cast<PyObject *>(sym), ks, ts);
+  Py_DECREF(ks);
+  Py_DECREF(ts);
+  if (!r) return Fail("MXSymbolInferType");
+  int rc = g_in_types.Fill(PyTuple_GetItem(r, 0), in_type_size,
+                           in_type_data);
+  if (rc == 0)
+    rc = g_out_types.Fill(PyTuple_GetItem(r, 1), out_type_size,
+                          out_type_data);
+  if (rc == 0)
+    rc = g_aux_types.Fill(PyTuple_GetItem(r, 2), aux_type_size,
+                          aux_type_data);
+  if (rc == 0) *complete = PyObject_IsTrue(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  return rc;
+}
+
+/* ====================================================================
+ * Executor
+ * ==================================================================== */
+MXAPI int MXExecutorFree(ExecutorHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+MXAPI int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  Gil gil;
+  PyObject *r = CallRt("exec_print", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXExecutorPrint");
+  g_str_store = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_str = g_str_store.c_str();
+  return 0;
+}
+
+MXAPI int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  Gil gil;
+  PyObject *r = CallRt("exec_forward", "Oi",
+                       static_cast<PyObject *>(handle), is_train);
+  if (!r) return Fail("MXExecutorForward");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                             NDArrayHandle *head_grads) {
+  Gil gil;
+  PyObject *grads = HandleList(len, head_grads);
+  PyObject *r = CallRt("exec_backward", "OO",
+                       static_cast<PyObject *>(handle), grads);
+  Py_DECREF(grads);
+  if (!r) return Fail("MXExecutorBackward");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                            NDArrayHandle **out) {
+  Gil gil;
+  PyObject *r = CallRt("exec_outputs", "O",
+                       static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXExecutorOutputs");
+  int rc = g_exec_out_store.Fill(r, out_size, out, "MXExecutorOutputs");
+  Py_DECREF(r);
+  return rc;
+}
+
+MXAPI int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type,
+                           int dev_id, mx_uint num_map_keys,
+                           const char **map_keys, const int *map_dev_types,
+                           const int *map_dev_ids, mx_uint len,
+                           NDArrayHandle *in_args,
+                           NDArrayHandle *arg_grad_store,
+                           mx_uint *grad_req_type, mx_uint aux_states_len,
+                           NDArrayHandle *aux_states,
+                           ExecutorHandle shared_exec, ExecutorHandle *out) {
+  (void)shared_exec;  /* memory-pool sharing is XLA's job */
+  Gil gil;
+  PyObject *g2c_keys = StrList(num_map_keys, map_keys);
+  PyObject *g2c_types = PyList_New(num_map_keys);
+  PyObject *g2c_ids = PyList_New(num_map_keys);
+  for (mx_uint i = 0; i < num_map_keys; ++i) {
+    PyList_SetItem(g2c_types, i, PyLong_FromLong(map_dev_types[i]));
+    PyList_SetItem(g2c_ids, i, PyLong_FromLong(map_dev_ids[i]));
+  }
+  PyObject *args = HandleList(len, in_args);
+  PyObject *grads = HandleList(len, arg_grad_store);
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i)
+    PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(grad_req_type[i]));
+  PyObject *aux = HandleList(aux_states_len, aux_states);
+  PyObject *r = CallRt("exec_bind", "OiiOOOOOOO",
+                       static_cast<PyObject *>(symbol_handle), dev_type,
+                       dev_id, g2c_keys, g2c_types, g2c_ids, args, grads,
+                       reqs, aux);
+  Py_DECREF(g2c_keys);
+  Py_DECREF(g2c_types);
+  Py_DECREF(g2c_ids);
+  Py_DECREF(args);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  Py_DECREF(aux);
+  return ReturnHandle(r, out, "MXExecutorBindEX");
+}
+
+MXAPI int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type,
+                          int dev_id, mx_uint num_map_keys,
+                          const char **map_keys, const int *map_dev_types,
+                          const int *map_dev_ids, mx_uint len,
+                          NDArrayHandle *in_args,
+                          NDArrayHandle *arg_grad_store,
+                          mx_uint *grad_req_type, mx_uint aux_states_len,
+                          NDArrayHandle *aux_states, ExecutorHandle *out) {
+  return MXExecutorBindEX(symbol_handle, dev_type, dev_id, num_map_keys,
+                          map_keys, map_dev_types, map_dev_ids, len, in_args,
+                          arg_grad_store, grad_req_type, aux_states_len,
+                          aux_states, nullptr, out);
+}
+
+MXAPI int MXExecutorBind(SymbolHandle symbol_handle, int dev_type,
+                         int dev_id, mx_uint len, NDArrayHandle *in_args,
+                         NDArrayHandle *arg_grad_store,
+                         mx_uint *grad_req_type, mx_uint aux_states_len,
+                         NDArrayHandle *aux_states, ExecutorHandle *out) {
+  return MXExecutorBindEX(symbol_handle, dev_type, dev_id, 0, nullptr,
+                          nullptr, nullptr, len, in_args, arg_grad_store,
+                          grad_req_type, aux_states_len, aux_states, nullptr,
+                          out);
+}
+
+/* ====================================================================
+ * KVStore
+ * ==================================================================== */
+MXAPI int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  Gil gil;
+  return ReturnHandle(CallRt("kv_create", "s", type), out,
+                      "MXKVStoreCreate");
+}
+
+MXAPI int MXKVStoreFree(KVStoreHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+namespace {
+PyObject *IntKeyList(mx_uint num, const int *keys) {
+  PyObject *lst = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i)
+    PyList_SetItem(lst, i, PyLong_FromLong(keys[i]));
+  return lst;
+}
+
+int KVApply(KVStoreHandle handle, const char *fn, PyObject *keys,
+            mx_uint num, NDArrayHandle *vals, int priority,
+            const char *where) {
+  PyObject *vs = HandleList(num, vals);
+  PyObject *r = CallRt(fn, "OOOi", static_cast<PyObject *>(handle), keys,
+                       vs, priority);
+  Py_DECREF(keys);
+  Py_DECREF(vs);
+  if (!r) return Fail(where);
+  Py_DECREF(r);
+  return 0;
+}
+}  // namespace
+
+MXAPI int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                        NDArrayHandle *vals) {
+  Gil gil;
+  PyObject *ks = IntKeyList(num, keys);
+  PyObject *vs = HandleList(num, vals);
+  PyObject *r = CallRt("kv_init", "OOO", static_cast<PyObject *>(handle),
+                       ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (!r) return Fail("MXKVStoreInit");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num,
+                          const char **keys, NDArrayHandle *vals) {
+  Gil gil;
+  PyObject *ks = StrList(num, keys);
+  PyObject *vs = HandleList(num, vals);
+  PyObject *r = CallRt("kv_init", "OOO", static_cast<PyObject *>(handle),
+                       ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  if (!r) return Fail("MXKVStoreInitEx");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                        NDArrayHandle *vals, int priority) {
+  Gil gil;
+  return KVApply(handle, "kv_push", IntKeyList(num, keys), num, vals,
+                 priority, "MXKVStorePush");
+}
+
+MXAPI int MXKVStorePushEx(KVStoreHandle handle, mx_uint num,
+                          const char **keys, NDArrayHandle *vals,
+                          int priority) {
+  Gil gil;
+  return KVApply(handle, "kv_push", StrList(num, keys), num, vals, priority,
+                 "MXKVStorePushEx");
+}
+
+MXAPI int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                        NDArrayHandle *vals, int priority) {
+  Gil gil;
+  return KVApply(handle, "kv_pull", IntKeyList(num, keys), num, vals,
+                 priority, "MXKVStorePull");
+}
+
+MXAPI int MXKVStorePullEx(KVStoreHandle handle, mx_uint num,
+                          const char **keys, NDArrayHandle *vals,
+                          int priority) {
+  Gil gil;
+  return KVApply(handle, "kv_pull", StrList(num, keys), num, vals, priority,
+                 "MXKVStorePullEx");
+}
+
+typedef void(MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                               NDArrayHandle local, void *handle);
+
+namespace {
+struct UpdaterCtx {
+  MXKVStoreUpdater *fn;
+  void *user;
+};
+
+PyObject *UpdaterTrampoline(PyObject *self, PyObject *args) {
+  UpdaterCtx *ctx = static_cast<UpdaterCtx *>(
+      PyCapsule_GetPointer(self, "mxnet_tpu.updater"));
+  int key = 0;
+  PyObject *recv = nullptr, *local = nullptr;
+  if (!ctx || !PyArg_ParseTuple(args, "iOO", &key, &recv, &local))
+    return nullptr;
+  /* reference contract: the callback owns both handles and frees them
+   * via MXNDArrayFree (cpp-package NDArray dtor) */
+  Py_INCREF(recv);
+  Py_INCREF(local);
+  ctx->fn(key, recv, local, ctx->user);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_updater_def = {"kv_updater_trampoline", UpdaterTrampoline,
+                             METH_VARARGS, "C updater trampoline"};
+}  // namespace
+
+MXAPI int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                              void *updater_handle) {
+  Gil gil;
+  /* ctx outlives the kvstore (freed never — one per SetUpdater call) */
+  UpdaterCtx *ctx = new UpdaterCtx{updater, updater_handle};
+  PyObject *capsule = PyCapsule_New(ctx, "mxnet_tpu.updater", nullptr);
+  if (!capsule) return Fail("MXKVStoreSetUpdater capsule");
+  PyObject *fn = PyCFunction_New(&g_updater_def, capsule);
+  Py_DECREF(capsule);  /* fn owns it now */
+  if (!fn) return Fail("MXKVStoreSetUpdater trampoline");
+  PyObject *r = CallRt("kv_set_updater", "OO",
+                       static_cast<PyObject *>(handle), fn);
+  Py_DECREF(fn);
+  if (!r) return Fail("MXKVStoreSetUpdater");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  Gil gil;
+  PyObject *r = CallRt("kv_type", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXKVStoreGetType");
+  g_str_store = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *type = g_str_store.c_str();
+  return 0;
+}
+
+MXAPI int MXKVStoreGetRank(KVStoreHandle handle, int *rank) {
+  Gil gil;
+  PyObject *r = CallRt("kv_rank", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXKVStoreGetRank");
+  *rank = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size) {
+  Gil gil;
+  PyObject *r = CallRt("kv_num_workers", "O",
+                       static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXKVStoreGetGroupSize");
+  *size = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreBarrier(KVStoreHandle handle) {
+  Gil gil;
+  PyObject *r = CallRt("kv_barrier", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXKVStoreBarrier");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreIsWorkerNode(int *ret) {
+  const char *role = getenv("DMLC_ROLE");
+  *ret = (!role || std::string(role) == "worker") ? 1 : 0;
+  return 0;
+}
+
+/* ====================================================================
+ * misc
+ * ==================================================================== */
+MXAPI int MXRandomSeed(int seed) {
+  Gil gil;
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu");
+  if (!mod) return Fail("MXRandomSeed import");
+  PyObject *random = PyObject_GetAttrString(mod, "random");
+  Py_DECREF(mod);
+  if (!random) return Fail("MXRandomSeed random");
+  PyObject *r = PyObject_CallMethod(random, "seed", "i", seed);
+  Py_DECREF(random);
+  if (!r) return Fail("MXRandomSeed");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNotifyShutdown() { return 0; }

@@ -19,69 +19,25 @@
 #include <string>
 #include <vector>
 
+#include "embed_common.h"
+
 typedef uint32_t mx_uint;
 typedef float mx_float;
 typedef void *PredictorHandle;
 
 #define MXNET_DLL __attribute__((visibility("default")))
 
-static thread_local std::string g_last_error;
+using mxtpu::Fail;
+using mxtpu::Gil;
+using mxtpu::LastError;
+
 static thread_local std::vector<mx_uint> g_shape_buf;
 
 extern "C" MXNET_DLL const char *MXGetLastError() {
-  return g_last_error.c_str();
+  return LastError().c_str();
 }
 
 namespace {
-
-std::once_flag g_py_once;
-
-void EnsurePython() {
-  std::call_once(g_py_once, []() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      // release the GIL acquired by Py_Initialize so PyGILState works
-      // from any caller thread; the interpreter lives until process
-      // exit (finalizing would invalidate outstanding handles)
-      PyEval_SaveThread();
-    }
-  });
-}
-
-// RAII GIL acquisition for every entry point
-struct Gil {
-  PyGILState_STATE st;
-  Gil() {
-    EnsurePython();
-    st = PyGILState_Ensure();
-  }
-  ~Gil() { PyGILState_Release(st); }
-};
-
-int Fail(const char *where) {
-  std::string msg = where;
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  if (value) {
-    PyObject *s = PyObject_Str(value);
-    if (s) {
-      const char *c = PyUnicode_AsUTF8(s);
-      if (c) {
-        msg += ": ";
-        msg += c;
-      } else {
-        PyErr_Clear();  // undecodable message: don't leave it pending
-        msg += ": <unprintable python error>";
-      }
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  g_last_error = msg;
-  return -1;
-}
 
 PyObject *CabiModule() {
   return PyImport_ImportModule("mxnet_tpu.cabi");
@@ -241,7 +197,7 @@ extern "C" MXNET_DLL int MXPredGetOutput(PredictorHandle handle,
   }
   if (static_cast<size_t>(n) != size * sizeof(mx_float)) {
     Py_DECREF(tobytes);
-    g_last_error = "MXPredGetOutput: size mismatch (got " +
+    LastError() = "MXPredGetOutput: size mismatch (got " +
                    std::to_string(n / sizeof(mx_float)) + " floats, want " +
                    std::to_string(size) + ")";
     return -1;

@@ -1,0 +1,130 @@
+/* Shared embedded-CPython plumbing for the C ABI translation units
+ * (c_predict_api.cc + c_api.cc link into one libmxnet_tpu.so).
+ *
+ * ref: src/c_api/c_api_error.cc — thread-local error string surfaced
+ * through MXGetLastError; here errors additionally capture the pending
+ * Python exception text.
+ */
+#pragma once
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+inline std::string &LastError() {
+  static thread_local std::string err;
+  return err;
+}
+
+inline void EnsurePython() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      /* release the GIL acquired by Py_Initialize so PyGILState works
+       * from any caller thread; the interpreter lives until process
+       * exit (finalizing would invalidate outstanding handles) */
+      PyEval_SaveThread();
+    }
+  });
+}
+
+/* RAII GIL acquisition for every entry point */
+struct Gil {
+  PyGILState_STATE st;
+  Gil() {
+    EnsurePython();
+    st = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+inline int Fail(const char *where) {
+  std::string msg = where;
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) {
+        msg += ": ";
+        msg += c;
+      } else {
+        PyErr_Clear();
+        msg += ": <unprintable python error>";
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  LastError() = msg;
+  return -1;
+}
+
+/* cached handle to mxnet_tpu.cabi_runtime (borrowed forever) */
+inline PyObject *Runtime() {
+  static PyObject *mod = nullptr;
+  if (!mod) mod = PyImport_ImportModule("mxnet_tpu.cabi_runtime");
+  return mod;
+}
+
+/* printf-style call into the runtime module; returns new ref or null */
+template <typename... A>
+inline PyObject *CallRt(const char *fn, const char *fmt, A... args) {
+  PyObject *mod = Runtime();
+  if (!mod) return nullptr;
+  return PyObject_CallMethod(mod, fn, fmt, args...);
+}
+
+inline PyObject *StrList(uint32_t n, const char **a) {
+  PyObject *lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i)
+    PyList_SetItem(lst, i, PyUnicode_FromString(a ? a[i] : ""));
+  return lst;
+}
+
+/* list of borrowed handles → list of owned refs (or None for nulls) */
+inline PyObject *HandleList(uint32_t n, void *const *h) {
+  PyObject *lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject *o = h && h[i] ? static_cast<PyObject *>(h[i]) : Py_None;
+    Py_INCREF(o);
+    PyList_SetItem(lst, i, o);
+  }
+  return lst;
+}
+
+/* thread-local string-list return storage */
+struct StrStore {
+  std::vector<std::string> storage;
+  std::vector<const char *> ptrs;
+  int Fill(PyObject *seq_any, uint32_t *out_size, const char ***out) {
+    PyObject *seq = PySequence_Fast(seq_any, "expected sequence");
+    if (!seq) return Fail("string list");
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    storage.clear();
+    ptrs.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PySequence_Fast_GET_ITEM(seq, i);
+      const char *s = it == Py_None ? "" : PyUnicode_AsUTF8(it);
+      if (!s) {
+        Py_DECREF(seq);
+        return Fail("undecodable string in list");
+      }
+      storage.emplace_back(s);
+    }
+    Py_DECREF(seq);
+    for (const auto &s : storage) ptrs.push_back(s.c_str());
+    *out_size = static_cast<uint32_t>(ptrs.size());
+    *out = ptrs.data();
+    return 0;
+  }
+};
+
+}  // namespace mxtpu

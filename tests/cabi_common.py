@@ -12,11 +12,12 @@ NATIVE = os.path.join(ROOT, "native")
 
 
 def ensure_lib() -> str:
-    """(Re)build libmxnet_tpu.so when the source is newer."""
+    """(Re)build libmxnet_tpu.so when any source is newer."""
     lib = os.path.join(NATIVE, "libmxnet_tpu.so")
-    src = os.path.join(NATIVE, "c_predict_api.cc")
-    if not os.path.exists(lib) or \
-            os.path.getmtime(lib) < os.path.getmtime(src):
+    srcs = [os.path.join(NATIVE, f) for f in
+            ("c_predict_api.cc", "c_api.cc", "embed_common.h")]
+    if not os.path.exists(lib) or any(
+            os.path.getmtime(lib) < os.path.getmtime(s) for s in srcs):
         subprocess.run(["sh", os.path.join(NATIVE, "build_cabi.sh")],
                        check=True, capture_output=True)
     return lib
