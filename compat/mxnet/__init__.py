@@ -1,0 +1,44 @@
+"""``import mxnet`` compatibility shim.
+
+The BASELINE.md north star is reference scripts running **unmodified**
+(``example/image-classification``, ``example/gluon``) with only
+``ctx=mx.tpu()`` / ``--kv-store tpu`` style flags.  Those scripts do
+``import mxnet as mx`` — this package makes that import resolve to
+:mod:`mxnet_tpu`.
+
+Usage: put ``<repo>/compat`` on ``PYTHONPATH`` (before any real mxnet
+install).  After ``import mxnet``, ``sys.modules['mxnet']`` IS the
+``mxnet_tpu`` package object, and every ``mxnet_tpu.*`` submodule is
+aliased as the matching ``mxnet.*`` name so ``from mxnet.gluon import
+nn``-style imports work.
+"""
+import importlib
+import sys
+
+_pkg = importlib.import_module("mxnet_tpu")
+
+# eagerly import the submodules reference scripts reach for, so their
+# ``mxnet.<sub>`` aliases exist even before first attribute access
+for _sub in (
+    "io", "nd", "ndarray", "symbol", "module", "metric", "callback",
+    "initializer", "lr_scheduler", "kvstore", "model", "optimizer",
+    "monitor", "image", "recordio", "gluon", "gluon.nn", "gluon.rnn",
+    "gluon.model_zoo", "gluon.model_zoo.vision", "gluon.data",
+    "gluon.loss", "gluon.utils", "autograd", "random", "test_utils",
+    "context", "executor", "rnn", "contrib", "profiler",
+    "visualization", "engine", "attribute",
+):
+    try:
+        importlib.import_module("mxnet_tpu." + _sub)
+    except ImportError:
+        pass
+
+for _name, _mod in list(sys.modules.items()):
+    if _name == "mxnet_tpu" or _name.startswith("mxnet_tpu."):
+        sys.modules["mxnet" + _name[len("mxnet_tpu"):]] = _mod
+
+# re-export for the in-flight import of this module; afterwards
+# ``import mxnet`` binds the mxnet_tpu package itself (aliased above),
+# so even lazily-added attributes resolve
+globals().update({k: v for k, v in _pkg.__dict__.items()
+                  if not k.startswith("__")})

@@ -155,6 +155,10 @@ class SymBuilder : public OpCall {
   }
 
   Symbol Build(const std::string &name = "") {
+    if (!input_keys_.empty() && input_keys_.size() != input_syms_.size())
+      throw std::runtime_error(
+          "SymBuilder(" + name_ + "): cannot mix keyword and positional "
+          "Input() calls — use one form for all inputs");
     std::vector<const char *> ks, vs;
     for (auto &k : param_keys_) ks.push_back(k.c_str());
     for (auto &v : param_vals_) vs.push_back(v.c_str());

@@ -303,7 +303,9 @@ def sym_infer_type(h: CSymbol, keys: Sequence[str],
     arg, out, aux = infer_type(h.built(), **kwargs)
     code = lambda lst: [_CODE_FROM_DTYPE.get(np.dtype(t).name, 0)
                        if t is not None else -1 for t in lst]
-    return code(arg), code(out), code(aux), True
+    carg, cout, caux = code(arg), code(out), code(aux)
+    complete = all(c != -1 for c in carg + cout + caux)
+    return carg, cout, caux, complete
 
 
 # ---------------------------------------------------------------------------
@@ -395,7 +397,9 @@ def kv_num_workers(kv) -> int:
 
 
 def kv_barrier(kv) -> None:
-    kv._barrier() if hasattr(kv, "_barrier") else None
+    barrier = getattr(kv, "barrier", None)
+    if callable(barrier):
+        barrier()
 
 
 def kv_set_updater(kv, trampoline) -> None:

@@ -27,11 +27,21 @@ __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter", "Ima
            "CSVIter", "LibSVMIter", "ResizeIter", "PrefetchingIter"]
 
 
-class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
-    """ref: python/mxnet/io.py DataDesc."""
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """ref: python/mxnet/io.py DataDesc — a (name, shape) 2-tuple (so
+    ``for name, shape in data_shapes`` unpacks, as reference scripts
+    do) carrying dtype/layout as attributes."""
 
     def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
-        return super().__new__(cls, name, tuple(shape), _np.dtype(dtype), layout)
+        ret = super().__new__(cls, name, tuple(shape))
+        ret.dtype = _np.dtype(dtype)
+        ret.layout = layout
+        return ret
+
+    def __getnewargs__(self):
+        # keep dtype/layout across pickle/copy (namedtuple would only
+        # replay the two tuple fields)
+        return (self.name, self.shape, self.dtype, self.layout)
 
     @staticmethod
     def get_batch_axis(layout: Optional[str]) -> int:

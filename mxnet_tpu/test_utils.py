@@ -247,3 +247,56 @@ def create_2d_tensor(rows, columns, dtype=np.int64):
     a = np.arange(0, rows).reshape(rows, 1)
     b = np.broadcast_to(a, shape=(a.shape[0], columns))
     return nd.array(b, dtype=dtype)
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    """ref: python/mxnet/test_utils.py download.  This build runs in
+    offline environments: an already-present file is returned as-is;
+    otherwise the download is attempted and a clear error raised when
+    the network is unreachable."""
+    import os
+
+    if fname is None:
+        fname = url.split("/")[-1]
+    if dirname is not None:
+        fname = os.path.join(dirname, fname)
+    d = os.path.dirname(os.path.abspath(fname))
+    if d and not os.path.exists(d):
+        os.makedirs(d, exist_ok=True)
+    if not overwrite and os.path.exists(fname):
+        return fname
+    try:
+        from urllib.request import urlretrieve
+
+        urlretrieve(url, fname)
+    except Exception as e:
+        raise IOError(
+            "download(%s) failed (%s). This environment has no network "
+            "egress — place the file at %r beforehand." % (url, e, fname))
+    return fname
+
+
+def get_mnist():
+    """ref: test_utils.get_mnist — returns the MNIST dict from local
+    ``data/`` idx files (pre-seeded in offline environments)."""
+    import gzip
+    import os
+    import struct
+
+    def read(label_f, image_f):
+        with gzip.open(label_f) as f:
+            _, n = struct.unpack(">II", f.read(8))
+            label = np.frombuffer(f.read(), dtype=np.int8)
+        with gzip.open(image_f, "rb") as f:
+            _, _, rows, cols = struct.unpack(">IIII", f.read(16))
+            image = np.frombuffer(
+                f.read(), dtype=np.uint8).reshape(len(label), rows, cols)
+        return label, image.astype(np.float32) / 255.0
+
+    path = "data"
+    tl, ti = read(os.path.join(path, "train-labels-idx1-ubyte.gz"),
+                  os.path.join(path, "train-images-idx3-ubyte.gz"))
+    vl, vi = read(os.path.join(path, "t10k-labels-idx1-ubyte.gz"),
+                  os.path.join(path, "t10k-images-idx3-ubyte.gz"))
+    return {"train_data": ti.reshape(-1, 1, 28, 28), "train_label": tl,
+            "test_data": vi.reshape(-1, 1, 28, 28), "test_label": vl}

@@ -1,0 +1,153 @@
+"""The BASELINE.md north star, demonstrated literally: the reference
+repo's example scripts run **byte-identical** (straight out of
+/root/reference) against this framework through the ``compat/mxnet``
+import shim.
+
+Covered: example/image-classification/{train_mnist,train_cifar10,
+train_imagenet,benchmark_score}.py and example/gluon/
+image_classification.py.  Data comes from pre-seeded synthetic files
+(offline environment) — the scripts' own download helpers short-circuit
+on existing files; CLI flags are the scripts' documented interface.
+"""
+import gzip
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REFERENCE = "/root/reference"
+IC_DIR = os.path.join(REFERENCE, "example", "image-classification")
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(IC_DIR), reason="reference tree not present")
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.join(ROOT, "compat"), ROOT,
+         env.get("PYTHONPATH", "")])
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("XLA_FLAGS", None)  # single-device is fine for the scripts
+    return env
+
+
+def _write_mnist(data_dir):
+    rng = np.random.RandomState(0)
+
+    def write(prefix, n):
+        labels = (np.arange(n) % 10).astype(np.uint8)
+        imgs = np.zeros((n, 28, 28), np.uint8)
+        for i, c in enumerate(labels):
+            img = rng.randint(0, 30, (28, 28))
+            img[c:c + 10, c:c + 10] += 180
+            imgs[i] = np.clip(img, 0, 255)
+        with gzip.open(prefix % "labels-idx1", "wb") as f:
+            f.write(struct.pack(">II", 2049, n) + labels.tobytes())
+        with gzip.open(prefix % "images-idx3", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28) + imgs.tobytes())
+
+    write(os.path.join(data_dir, "train-%s-ubyte.gz"), 2000)
+    write(os.path.join(data_dir, "t10k-%s-ubyte.gz"), 1000)
+
+
+def _write_cifar_rec(data_dir):
+    from mxnet_tpu import recordio
+
+    rng = np.random.RandomState(1)
+    for name, n in (("cifar10_train.rec", 512), ("cifar10_val.rec", 256)):
+        w = recordio.MXRecordIO(os.path.join(data_dir, name), "w")
+        for i in range(n):
+            c = i % 10
+            img = rng.randint(0, 60, (32, 32, 3)).astype(np.uint8)
+            img[:, :, c % 3] = np.clip(
+                img[:, :, c % 3].astype(int) + 40 + 15 * c, 0, 255)
+            hdr = recordio.IRHeader(0, float(c), i, 0)
+            w.write(recordio.pack_img(hdr, img, quality=95))
+        w.close()
+
+
+def _run(script, args, cwd, timeout=900):
+    proc = subprocess.run([sys.executable, script] + args, cwd=cwd,
+                          env=_env(), capture_output=True, text=True,
+                          timeout=timeout)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-4000:]
+    return proc.stdout + proc.stderr
+
+
+def _val_accuracies(log):
+    out = []
+    for line in log.splitlines():
+        if "Validation-accuracy=" in line:
+            out.append(float(line.rsplit("=", 1)[1]))
+    return out
+
+
+@pytest.mark.slow
+def test_reference_train_mnist_unmodified(tmp_path):
+    data = tmp_path / "data"
+    data.mkdir()
+    _write_mnist(str(data))
+    log = _run(os.path.join(IC_DIR, "train_mnist.py"),
+               ["--num-epochs", "2", "--disp-batches", "10"],
+               cwd=str(tmp_path))
+    accs = _val_accuracies(log)
+    assert accs and accs[-1] > 0.95, log[-2000:]
+
+
+@pytest.mark.slow
+def test_reference_train_cifar10_unmodified(tmp_path):
+    data = tmp_path / "data"
+    data.mkdir()
+    _write_cifar_rec(str(data))
+    log = _run(os.path.join(IC_DIR, "train_cifar10.py"),
+               ["--network", "lenet", "--num-epochs", "2",
+                "--batch-size", "64", "--disp-batches", "4"],
+               cwd=str(tmp_path))
+    accs = _val_accuracies(log)
+    assert accs and accs[-1] > 0.5, log[-2000:]
+
+
+@pytest.mark.slow
+def test_reference_train_imagenet_benchmark_mode(tmp_path):
+    log = _run(os.path.join(IC_DIR, "train_imagenet.py"),
+               ["--benchmark", "1", "--network", "lenet",
+                "--image-shape", "3,28,28", "--num-classes", "10",
+                "--num-examples", "6400", "--num-epochs", "1",
+                "--batch-size", "32", "--disp-batches", "100"],
+               cwd=str(tmp_path))
+    assert "Train-accuracy" in log, log[-2000:]
+
+
+@pytest.mark.slow
+def test_reference_benchmark_score_unmodified(tmp_path):
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import mxnet as mx\n"
+        "import benchmark_score\n"
+        "s = benchmark_score.score(network='resnet-18', dev=mx.cpu(),"
+        " batch_size=1, num_batches=2)\n"
+        "assert s > 0\n"
+        "print('SCORE_OK', s)\n" % IC_DIR)
+    proc = subprocess.run([sys.executable, "-c", code], cwd=str(tmp_path),
+                          env=_env(), capture_output=True, text=True,
+                          timeout=900)
+    assert proc.returncode == 0 and "SCORE_OK" in proc.stdout, \
+        (proc.stdout + proc.stderr)[-4000:]
+
+
+@pytest.mark.slow
+def test_reference_gluon_image_classification_unmodified(tmp_path):
+    script = os.path.join(REFERENCE, "example", "gluon",
+                          "image_classification.py")
+    log = _run(script,
+               ["--dataset", "dummy", "--model", "resnet18_v1",
+                "--epochs", "1", "--mode", "hybrid",
+                "--batch-size", "2", "--log-interval", "50"],
+               cwd=str(tmp_path), timeout=1500)
+    assert "validation: accuracy=" in log, log[-2000:]
