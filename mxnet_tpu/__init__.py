@@ -47,6 +47,7 @@ from . import callback
 from . import model
 from . import kvstore
 from . import kvstore as kv
+from . import dist
 from . import module
 from . import module as mod
 from . import gluon

@@ -8,31 +8,71 @@ TPU-native stance (SURVEY.md §5 "Distributed communication backend"):
 gradient exchange *inside* a slice rides XLA collectives over ICI; this
 module is the API-compat **host-side** PS used by `dist_sync`/
 `dist_async` — cross-process key/value traffic over TCP, exactly the
-role ps-lite's Van plays, with the scheduler doing rank assignment and
-barriers the way ps-lite's Postoffice does.
+role ps-lite's Van plays, with the scheduler doing rank assignment,
+barriers, and node liveness the way ps-lite's Postoffice does
+(GetDeadNodes, src/kvstore/kvstore_dist.h:113-121).
 
-Protocol: length-prefixed pickled dicts over TCP. Roles from env:
+Protocol: length-prefixed pickled dicts over TCP.  When
+``MXNET_PS_SECRET`` is set, every frame carries an HMAC-SHA256 tag over
+the payload and unauthenticated frames are rejected — pickle is only
+ever loaded from peers holding the shared secret.  Sockets bind to the
+interface implied by ``DMLC_PS_ROOT_URI`` (loopback launches never
+listen on external interfaces).
+
+Roles from env:
   DMLC_ROLE           scheduler | server | worker
   DMLC_PS_ROOT_URI    scheduler host
   DMLC_PS_ROOT_PORT   scheduler port
   DMLC_NUM_SERVER     server count
   DMLC_NUM_WORKER     worker count
+  MXNET_PS_SECRET     optional shared secret authenticating frames
+  MXNET_PS_REQUEST_TIMEOUT   per-request socket timeout, seconds
+  MXNET_PS_HEARTBEAT_INTERVAL  node heartbeat period, seconds
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
 import os
 import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 _LEN = struct.Struct("<Q")
+_TAG_LEN = hashlib.sha256().digest_size
+
+
+def _secret() -> Optional[bytes]:
+    s = os.environ.get("MXNET_PS_SECRET")
+    return s.encode() if s else None
+
+
+def request_timeout() -> float:
+    # default exceeds the server's sync-pull grace window (600s,
+    # MXNET_KVSTORE_SYNC_TIMEOUT) so a straggler the server tolerates is
+    # not aborted client-side first
+    return float(os.environ.get("MXNET_PS_REQUEST_TIMEOUT", "900"))
+
+
+def heartbeat_interval() -> float:
+    return float(os.environ.get("MXNET_PS_HEARTBEAT_INTERVAL", "5"))
+
+
+def bind_host() -> str:
+    """The interface servers/scheduler listen on: loopback for loopback
+    clusters, all interfaces only when the cluster spans hosts."""
+    root = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    return "127.0.0.1" if root in ("127.0.0.1", "localhost") else "0.0.0.0"
 
 
 def send_msg(sock: socket.socket, obj: Any) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    key = _secret()
+    tag = _hmac.new(key, payload, hashlib.sha256).digest() if key else b""
+    sock.sendall(_LEN.pack(len(payload)) + tag + payload)
 
 
 def recv_msg(sock: socket.socket) -> Any:
@@ -40,9 +80,20 @@ def recv_msg(sock: socket.socket) -> Any:
     if hdr is None:
         return None
     (n,) = _LEN.unpack(hdr)
+    key = _secret()
+    tag = b""
+    if key:
+        tag = _recv_exact(sock, _TAG_LEN)
+        if tag is None:
+            return None
     body = _recv_exact(sock, n)
     if body is None:
         return None
+    if key:
+        want = _hmac.new(key, body, hashlib.sha256).digest()
+        if not _hmac.compare_digest(tag, want):
+            raise ConnectionError(
+                "rejected PS frame with bad authentication tag")
     return pickle.loads(body)
 
 
@@ -68,39 +119,51 @@ def env_cluster() -> Tuple[str, int, int, int]:
 
 
 class Scheduler:
-    """Rendezvous + barrier service (the Postoffice scheduler role).
+    """Rendezvous + barrier + liveness service (the Postoffice scheduler
+    role).
 
     Servers register with their listen address; workers register and
-    receive the full server table + their rank. Runs until every node
-    sends a `finalize` (ref: ps-lite scheduler lifecycle)."""
+    receive the full server table + their rank.  Every node heartbeats
+    on a side connection; ``dead_nodes`` reports nodes whose last beat
+    is older than the caller's timeout — the reference's
+    ``ps::Postoffice::GetDeadNodes`` (kvstore_dist.h:113-121).  A node
+    re-registering with its previous rank (``recovery``) gets its slot
+    back without shifting rank assignment — the ``is_recovery`` rejoin
+    path.  Runs until every non-recovered node sends ``finalize``."""
 
     def __init__(self, port: int, num_servers: int, num_workers: int):
         self.num_servers = num_servers
         self.num_workers = num_workers
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind(("0.0.0.0", port))
+        self.sock.bind((bind_host(), port))
         self.sock.listen(128)
         self.lock = threading.Condition()
         self.servers: List[Tuple[str, int]] = []
         self.worker_ranks = 0
         self.barrier_count: Dict[int, int] = {}
         self.barrier_gen: Dict[int, int] = {}
+        self.heartbeats: Dict[Tuple[str, int], float] = {}
         self.done = 0
 
     def run(self):
         threads = []
         total = self.num_servers + self.num_workers
-        conns = []
-        for _ in range(total):
-            conn, _ = self.sock.accept()
-            conns.append(conn)
+        self.sock.settimeout(0.2)
+        while True:
+            with self.lock:
+                if self.done >= total:
+                    break
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
             t = threading.Thread(target=self._serve, args=(conn,),
                                  daemon=True)
             t.start()
             threads.append(t)
         for t in threads:
-            t.join()
+            t.join(timeout=5)
         self.sock.close()
 
     def _serve(self, conn):
@@ -112,18 +175,40 @@ class Scheduler:
                 op = msg["op"]
                 if op == "register_server":
                     with self.lock:
-                        rank = len(self.servers)
-                        self.servers.append(tuple(msg["addr"]))
+                        if msg.get("recovery") is not None:
+                            rank = int(msg["recovery"])
+                            while len(self.servers) <= rank:
+                                self.servers.append(None)
+                            self.servers[rank] = tuple(msg["addr"])
+                        else:
+                            rank = len(self.servers)
+                            self.servers.append(tuple(msg["addr"]))
+                        self.heartbeats[("server", rank)] = time.time()
                         self.lock.notify_all()
                     send_msg(conn, {"rank": rank})
                 elif op == "register_worker":
                     with self.lock:
-                        while len(self.servers) < self.num_servers:
+                        # every server slot must be filled with a real
+                        # address (a recovering server may fill a later
+                        # slot before earlier ones re-register)
+                        while (len(self.servers) < self.num_servers or
+                               any(s is None for s in self.servers)):
                             self.lock.wait()
-                        rank = self.worker_ranks
-                        self.worker_ranks += 1
+                        if msg.get("recovery") is not None:
+                            # rejoin with the previous rank: rank table
+                            # unchanged; the response carries the barrier
+                            # generation so the rejoiner can skip exactly
+                            # the startup barriers the cohort already
+                            # passed, then participate normally
+                            rank = int(msg["recovery"])
+                        else:
+                            rank = self.worker_ranks
+                            self.worker_ranks += 1
+                        self.heartbeats[("worker", rank)] = time.time()
+                        gen = self.barrier_gen.get(0, 0)
                     send_msg(conn, {"rank": rank,
-                                    "servers": list(self.servers)})
+                                    "servers": list(self.servers),
+                                    "barrier_gen": gen})
                 elif op == "barrier":
                     gid = msg.get("group", 0)
                     with self.lock:
@@ -138,27 +223,86 @@ class Scheduler:
                             while self.barrier_gen[gid] == gen:
                                 self.lock.wait()
                     send_msg(conn, {"ok": True})
+                elif op == "heartbeat":
+                    with self.lock:
+                        self.heartbeats[(msg["role"], int(msg["rank"]))] = \
+                            time.time()
+                    send_msg(conn, {"ok": True})
+                elif op == "dead_nodes":
+                    timeout = float(msg.get("timeout", 60.0))
+                    now = time.time()
+                    with self.lock:
+                        dead = sorted(
+                            ["%s:%d" % node
+                             for node, ts in self.heartbeats.items()
+                             if now - ts > timeout])
+                    send_msg(conn, {"dead": dead})
                 elif op == "finalize":
+                    with self.lock:
+                        self.done += 1
+                        # a cleanly-exited node must not be reported
+                        # dead by later dead_nodes queries
+                        if "role" in msg:
+                            self.heartbeats.pop(
+                                (msg["role"], int(msg.get("rank", -1))),
+                                None)
+                        self.lock.notify_all()
                     send_msg(conn, {"ok": True})
                     return
+        except ConnectionError:
+            pass
         finally:
             conn.close()
 
 
 class Client:
     """One TCP connection with request/response framing + a lock so
-    multiple frontend threads can share it."""
+    multiple frontend threads can share it.  Requests carry a socket
+    timeout (MXNET_PS_REQUEST_TIMEOUT): a hung peer surfaces as a
+    ConnectionError instead of blocking the worker forever — the
+    failure-detection contract kvstore_dist.h gets from ps-lite
+    timeouts."""
 
-    def __init__(self, addr: Tuple[str, int]):
+    def __init__(self, addr: Tuple[str, int],
+                 timeout: Optional[float] = None):
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.connect(tuple(addr))
+        self.addr = tuple(addr)
+        self.timeout = timeout
+        self.broken = False
         self.lock = threading.Lock()
 
-    def request(self, msg: Any) -> Any:
+    def request(self, msg: Any, timeout: Optional[float] = None) -> Any:
+        t = timeout if timeout is not None else (
+            self.timeout if self.timeout is not None else request_timeout())
         with self.lock:
-            send_msg(self.sock, msg)
-            return recv_msg(self.sock)
+            if self.broken:
+                raise ConnectionError(
+                    "connection to %s:%d was aborted after an earlier "
+                    "timeout" % self.addr)
+            try:
+                self.sock.settimeout(t)
+                send_msg(self.sock, msg)
+                return recv_msg(self.sock)
+            except socket.timeout:
+                # the peer's late response would desync request/response
+                # pairing — this connection is unusable from here on
+                self.broken = True
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                raise ConnectionError(
+                    "no response from %s:%d within %.0fs for %r (peer "
+                    "dead or hung)" % (self.addr[0], self.addr[1], t,
+                                       msg.get("op")))
+            finally:
+                if not self.broken:
+                    try:
+                        self.sock.settimeout(None)
+                    except OSError:
+                        pass
 
     def close(self):
         try:
@@ -167,9 +311,38 @@ class Client:
             pass
 
 
-def connect_scheduler(retries: int = 200, delay: float = 0.05) -> Client:
-    import time
+class Heartbeat:
+    """Background liveness beacon: a daemon thread on its own scheduler
+    connection (barriers block the main connection, so heartbeats ride a
+    side channel)."""
 
+    def __init__(self, role: str, rank: int):
+        self.role, self.rank = role, rank
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        interval = heartbeat_interval()
+        client = None
+        while not self._stop.wait(interval):
+            try:
+                if client is None:
+                    client = connect_scheduler(retries=1)
+                client.request({"op": "heartbeat", "role": self.role,
+                                "rank": self.rank}, timeout=interval)
+            except (OSError, ConnectionError):
+                if client is not None:
+                    client.close()
+                client = None
+        if client is not None:
+            client.close()
+
+    def stop(self):
+        self._stop.set()
+
+
+def connect_scheduler(retries: int = 200, delay: float = 0.05) -> Client:
     host, port, _, _ = env_cluster()
     last = None
     for _ in range(retries):

@@ -1,0 +1,115 @@
+"""Multi-host runtime initialization — the DCN-scale backend.
+
+ref: the reference scales data-parallel training across hosts with
+ps-lite over TCP (src/kvstore/kvstore_dist.h:54-58, 256 GPUs / 16 hosts
+in BASELINE.md).  The TPU-native equivalent is ``jax.distributed``: one
+controller process per host joins a coordination service, after which
+``jax.devices()`` spans every chip in the pod and a single
+``jax.sharding.Mesh`` over them turns gradient exchange into XLA
+collectives — ICI within a slice, DCN between slices — with no
+host-side parameter server on the hot path.
+
+Env contract (exported by ``tools/launch.py --launcher jax`` or any
+scheduler):
+
+  MXNET_COORDINATOR_ADDRESS  host:port of process 0's coordinator
+  MXNET_NUM_PROCESSES        total controller processes
+  MXNET_PROCESS_ID           this process's id (0-based)
+
+After :func:`initialize`, ``kvstore.create(...)`` stores report the real
+``rank``/``num_workers`` (kvstore.h:254-306's rank contract), and
+meshes built from ``jax.devices()`` are pod-wide.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["initialize", "is_initialized", "shutdown", "rank",
+           "num_processes", "local_devices", "global_devices"]
+
+_initialized = False
+
+
+def env_spec():
+    """The (coordinator, num_processes, process_id) triple from env, or
+    None when no multi-host launch is configured."""
+    addr = os.environ.get("MXNET_COORDINATOR_ADDRESS")
+    if not addr:
+        return None
+    return (addr,
+            int(os.environ.get("MXNET_NUM_PROCESSES", "1")),
+            int(os.environ.get("MXNET_PROCESS_ID", "0")))
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Join (or start, for process 0) the pod's coordination service.
+
+    Arguments default to the MXNET_* env contract; returns True when a
+    multi-process runtime was initialized, False when running
+    single-process (no env, no args) — callers can treat it as a no-op
+    probe.  Idempotent."""
+    global _initialized
+    if _initialized:
+        return True
+    spec = env_spec()
+    if coordinator_address is None:
+        if spec is None:
+            return False
+        coordinator_address, num_processes, process_id = spec
+    elif num_processes is None or process_id is None:
+        raise ValueError("initialize() needs num_processes and process_id "
+                         "alongside coordinator_address")
+
+    import jax
+
+    # must run BEFORE the XLA backend exists, so probe env, not the
+    # backend.  The CPU backend only joins the pod when a cross-process
+    # collectives implementation is configured (the virtual-pod test
+    # path); the setting is ignored by the TPU backend.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def shutdown() -> None:
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+
+    jax.distributed.shutdown()
+    _initialized = False
+
+
+def rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def num_processes() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def local_devices():
+    import jax
+
+    return jax.local_devices()
+
+
+def global_devices():
+    import jax
+
+    return jax.devices()

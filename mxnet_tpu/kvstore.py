@@ -225,25 +225,45 @@ class KVStoreDist(KVStore):
 
     def __init__(self, kind: str):
         super().__init__(kind)
+        import os
+
         from . import _ps
 
         self._ps = _ps
         self._sync = "async" not in kind
+        self._recovery = bool(os.environ.get("DMLC_PS_IS_RECOVERY"))
         sched = _ps.connect_scheduler()
-        resp = sched.request({"op": "register_worker"})
+        reg = {"op": "register_worker"}
+        if self._recovery:
+            # is_recovery rejoin (ref: kvstore_dist.h:56): reclaim the
+            # previous rank; startup barriers are skipped so the healthy
+            # cohort is never blocked on the rejoining node
+            reg["recovery"] = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        resp = sched.request(reg)
         self._rank = resp["rank"]
+        # barrier catch-up for recovery: skip exactly as many barriers
+        # as the cohort has already completed, then participate normally
+        # (a blanket skip would deadlock healthy workers at the next
+        # barrier; ref: is_recovery skips only the *startup* barrier)
+        self._barrier_skip = resp.get("barrier_gen", 0) \
+            if self._recovery else 0
         self._server_clients = [_ps.Client(a) for a in resp["servers"]]
         self._sched = sched
         _, _, _, nw = _ps.env_cluster()
         self._nw = nw
         self._gc = None
         self._closed = False
+        self._heartbeat = _ps.Heartbeat("worker", self._rank)
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(max_workers=16)
-        if not self._sync and self._rank == 0:
-            for c in self._server_clients:
-                c.request({"op": "set_sync", "sync": False})
+        if not self._sync:
+            if self._rank == 0:
+                for c in self._server_clients:
+                    self._req(c, {"op": "set_sync", "sync": False})
+            # every rank reaches this barrier => servers switched mode
+            # before any worker's first push can race the set_sync
+            self.barrier()
         import atexit
 
         atexit.register(self.close)
@@ -439,12 +459,27 @@ class KVStoreDist(KVStore):
     # -- cluster control -----------------------------------------------
     def barrier(self) -> None:
         """ref: Postoffice::Barrier via the scheduler."""
-        self._sched.request({"op": "barrier"})
+        if self._barrier_skip > 0:
+            # is_recovery catch-up: this barrier was already completed
+            # by the cohort before the rejoin
+            self._barrier_skip -= 1
+            return
+        self._sched.request({"op": "barrier"}, timeout=86400.0)
+
+    def get_dead_nodes(self, timeout: float = 60.0) -> List[str]:
+        """Nodes whose heartbeat is older than ``timeout`` seconds, as
+        ``role:rank`` strings (ref: ps::Postoffice::GetDeadNodes via
+        kvstore_dist.h:113-121 — the reference surfaces liveness through
+        the scheduler exactly like this)."""
+        resp = self._sched.request({"op": "dead_nodes",
+                                    "timeout": timeout})
+        return list(resp["dead"]) if resp else []
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
+        self._heartbeat.stop()
         self._pool.shutdown(wait=False)
         for c in self._server_clients:
             try:
@@ -453,9 +488,10 @@ class KVStoreDist(KVStore):
             except OSError:
                 pass
         try:
-            self._sched.request({"op": "finalize"})
+            self._sched.request({"op": "finalize", "role": "worker",
+                                 "rank": self._rank})
             self._sched.close()
-        except OSError:
+        except (OSError, ConnectionError):
             pass
 
     def __del__(self):
@@ -476,6 +512,12 @@ def create(name: str = "local") -> KVStore:
     so launcher-less scripts still run."""
     if not isinstance(name, str) or name not in _VALID:
         raise MXNetError("unknown kvstore type %r" % (name,))
+    from . import dist as _dist
+
+    # multi-host pod: join the jax.distributed coordination service when
+    # the MXNET_COORDINATOR_ADDRESS contract is present (no-op otherwise)
+    # so rank/num_workers and pod-wide meshes are real
+    _dist.initialize()
     if name.startswith("dist"):
         import os
 

@@ -52,15 +52,22 @@ class KVStoreServer:
         self.stopped_workers = 0
         self.listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.listen.bind(("0.0.0.0", 0))
+        # never listen on external interfaces for loopback clusters
+        self.listen.bind((_ps.bind_host(), 0))
         self.listen.listen(128)
         self.addr = (socket.gethostbyname(socket.gethostname())
                      if host not in ("127.0.0.1", "localhost")
                      else "127.0.0.1", self.listen.getsockname()[1])
         sched = _ps.connect_scheduler()
-        resp = sched.request({"op": "register_server", "addr": self.addr})
+        reg = {"op": "register_server", "addr": self.addr}
+        if os.environ.get("DMLC_PS_IS_RECOVERY"):
+            # is_recovery rejoin (ref: kvstore_dist.h:56): reclaim the
+            # previous rank slot instead of taking a fresh one
+            reg["recovery"] = int(os.environ.get("DMLC_SERVER_ID", "0"))
+        resp = sched.request(reg)
         self.rank = resp["rank"]
         self.sched = sched
+        self._heartbeat = _ps.Heartbeat("server", self.rank)
 
     def run(self):
         """Accept one connection per worker and serve until every worker
@@ -81,7 +88,9 @@ class KVStoreServer:
             threads.append(t)
         for t in threads:
             t.join(timeout=5)
-        self.sched.request({"op": "finalize"})
+        self._heartbeat.stop()
+        self.sched.request({"op": "finalize", "role": "server",
+                            "rank": self.rank})
         self.sched.close()
         self.listen.close()
 
@@ -132,7 +141,9 @@ class KVStoreServer:
                 from . import optimizer as _opt
 
                 optimizer = pickle.loads(msg["payload"])
-                self.updater = _opt.get_updater(optimizer)
+                # None uninstalls: back to raw-aggregate semantics
+                self.updater = (None if optimizer is None
+                                else _opt.get_updater(optimizer))
             _ps.send_msg(conn, {"ok": True})
         elif op == "set_sync":
             # ref: sync-mode command, kvstore_dist_server.h:154
@@ -241,12 +252,25 @@ class KVStoreServer:
             st = self.state.setdefault(key, _KeyState())
             if self.sync_mode and w is not None:
                 want = st.pushed_by.get(int(w), 0)
+                # overall deadline that RESETS whenever a round applies:
+                # a peer's slow first-step XLA compile between pushes is
+                # progress-adjacent, not a failure
+                window = float(os.environ.get(
+                    "MXNET_KVSTORE_SYNC_TIMEOUT", "600"))
+                last_applied = st.applied
+                import time as _time
+                deadline = _time.monotonic() + window
                 while st.applied < want:
-                    if not self.lock.wait(timeout=60):
+                    self.lock.wait(timeout=1.0)
+                    if st.applied != last_applied:
+                        last_applied = st.applied
+                        deadline = _time.monotonic() + window
+                    elif _time.monotonic() > deadline:
                         raise RuntimeError(
-                            "sync pull timed out: key %r waits for round "
-                            "%d, applied %d (did every worker push?)"
-                            % (key, want, st.applied))
+                            "sync pull timed out after %.0fs without "
+                            "progress: key %r waits for round %d, applied "
+                            "%d (did every worker push?)"
+                            % (window, key, want, st.applied))
             if key not in self.store:
                 raise RuntimeError("pull before init on %r" % key)
             return self.store[key]

@@ -27,6 +27,58 @@ def test_sync_push_pull(kv):
     np.testing.assert_allclose(out.asnumpy(), 2 * want)
 
 
+def test_adversarial_orderings(kv):
+    """Exact arithmetic identity under shuffled concurrent key orders +
+    over-pushing (burst) workers — the reference's adversarial dist_sync
+    coverage (tests/nightly/dist_sync_kvstore.py:29-60).
+
+    Parts from different logical rounds may interleave arbitrarily at
+    the server, so the only order-independent exact identity is the
+    integral one: with a plain-SGD updater, the total decrement equals
+    lr * (sum of every gradient ever pushed), however the rounds were
+    grouped."""
+    rank, nw = kv.rank, kv.num_workers
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.0,
+                                      rescale_grad=1.0, wd=0.0))
+    rng = np.random.RandomState(1000 + rank)
+    keys = ["adv%d" % i for i in range(8)]
+    for i, k in enumerate(keys):
+        kv.init(k, nd.zeros((3,)))
+    kv.barrier()
+    for rnd in range(3):
+        order = rng.permutation(len(keys))
+        for i in order:
+            kv.push(keys[i], nd.ones((3,)) * (i + 1) * (rnd + 1))
+    # every worker pushed 3 rounds per key; pull blocks until this
+    # worker's own pushes are folded into applied rounds, which needs
+    # every other worker's parts too
+    for i, k in enumerate(keys):
+        out = nd.zeros((3,))
+        kv.pull(k, out=out)
+        want = -0.1 * nw * (i + 1) * (1 + 2 + 3)
+        np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+    kv.barrier()
+    # burst: two pushes back-to-back with no pull between — the server
+    # rolls the over-push into the next round instead of double-counting
+    kv.init("burst", nd.zeros((2,)))
+    kv.push("burst", nd.ones((2,)) * (rank + 1))
+    kv.push("burst", nd.ones((2,)) * 10 * (rank + 1))
+    out = nd.zeros((2,))
+    kv.pull("burst", out=out)
+    want = -0.1 * 11 * sum(range(1, nw + 1))
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+    kv.barrier()
+    # back to raw-aggregate semantics for the tests that follow
+    kv.set_optimizer(None)
+    kv.barrier()
+
+
+def test_liveness(kv):
+    """All nodes heartbeating => nothing reported dead."""
+    dead = kv.get_dead_nodes(timeout=60)
+    assert dead == [], "unexpected dead nodes: %s" % dead
+
+
 def test_sync_optimizer(kv):
     rank, nw = kv.rank, kv.num_workers
     kv.init("w", nd.ones((2, 2)))
@@ -128,6 +180,8 @@ def main():
     assert kv.num_workers >= 1
     if kind == "dist_sync":
         test_sync_push_pull(kv)
+        test_adversarial_orderings(kv)
+        test_liveness(kv)
         test_sync_optimizer(kv)
         test_optimizer_state_roundtrip(kv)
         test_row_sparse_pull(kv)

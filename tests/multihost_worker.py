@@ -1,0 +1,66 @@
+"""Worker for the multi-host (jax.distributed) equivalence test.
+
+Each controller process owns one CPU device; the pod-wide mesh spans
+both processes, and a jitted SGD step reduces gradients across the pod
+via XLA collectives (gloo on CPU standing in for DCN).  The result must
+match the single-process computation bit-for-bit — the reference's
+dist-sync exactness contract (tests/nightly/dist_sync_kvstore.py).
+Launched by tools/launch.py --launcher jax (test_multihost.py)."""
+import json
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    out_dir = sys.argv[1]
+    assert mx.dist.initialize(), "MXNET_COORDINATOR_ADDRESS not set?"
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+
+    # kvstore identity reflects the pod (kvstore.h:254-306 rank contract)
+    kv = mx.kv.create("tpu")
+    assert kv.rank == rank, (kv.rank, rank)
+    assert kv.num_workers == 2
+
+    devs = jax.devices()
+    assert len(devs) == 2, devs
+    mesh = Mesh(np.array(devs), ("dp",))
+    rep = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P("dp"))
+
+    # each process contributes its local batch row to the global batch
+    local = (jnp.arange(4, dtype=jnp.float32) + 1.0) * (rank + 1)
+    X = jax.make_array_from_process_local_data(shard, local.reshape(1, 4))
+    w = jax.device_put(jnp.ones((4,), jnp.float32), rep)
+
+    @jax.jit
+    def step(w, X):
+        grad = jnp.mean(X, axis=0)  # global-batch mean => cross-host psum
+        return w - 0.1 * grad
+
+    w2 = step(w, X)
+    got = np.asarray(jax.device_get(w2.addressable_data(0)))
+
+    # single-process ground truth: same jitted program, no pod sharding
+    rows = np.stack([(np.arange(4, dtype=np.float32) + 1.0) * r
+                     for r in (1, 2)]).astype(np.float32)
+    want = np.asarray(jax.device_get(step(
+        jnp.ones((4,), jnp.float32), jnp.asarray(rows))))
+    np.testing.assert_array_equal(got, want)
+
+    with open(os.path.join(out_dir, "rank%d.json" % rank), "w") as f:
+        json.dump({"rank": rank, "w": got.tolist()}, f)
+    print("rank %d OK" % rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,14 +1,25 @@
 #!/usr/bin/env python
 """Cluster launcher for dist_sync/dist_async training.
 
-ref: tools/launch.py:30-80 (delegates to the dmlc-core tracker; the
-local launcher spawns scheduler+servers+workers as processes on one
-host — the mode tests/nightly/test_all.sh:55 uses). ssh/mpi/yarn modes
-are out of scope for the TPU build: multi-host TPU jobs launch through
-jax.distributed; this launcher covers the PS-compat path.
+ref: tools/launch.py:30-80 (delegates to the dmlc-core tracker: local,
+ssh, mpi, yarn modes; the local launcher spawns scheduler+servers+
+workers as processes on one host — the mode tests/nightly/test_all.sh:55
+uses).
+
+Modes here:
+  * ``local`` — PS-compat path: scheduler + servers + workers on this
+    host (DMLC_* env contract).
+  * ``jax``   — TPU-native path: N ``jax.distributed`` controller
+    processes on this host (MXNET_COORDINATOR_ADDRESS env contract);
+    gradient exchange rides XLA collectives, no parameter server.
+  * ``ssh``   — the multi-host version of ``jax``: one controller per
+    host from ``--hostfile``, like the reference's ssh tracker
+    (dmlc-core tracker ssh mode).
 
 Usage:
     python tools/launch.py -n 2 [-s 1] python train.py --kv-store dist_sync
+    python tools/launch.py -n 2 --launcher jax python train.py --kv-store tpu
+    python tools/launch.py -n 16 --launcher ssh -H hosts python train.py
 """
 from __future__ import annotations
 
@@ -97,19 +108,81 @@ def launch_local(num_workers: int, num_servers: int, cmd, env=None,
     return codes
 
 
+def launch_jax(num_processes: int, cmd, env=None, hosts=None,
+               coordinator_port=None):
+    """Spawn ``jax.distributed`` controller processes — locally (one per
+    process id) or one per host over ssh.  Process 0's host runs the
+    coordination service; every process exports the MXNET_* contract
+    consumed by ``mxnet_tpu.dist.initialize()``."""
+    base_env = dict(os.environ)
+    if env:
+        base_env.update(env)
+    if hosts:
+        coord = "%s:%d" % (hosts[0], coordinator_port or 9123)
+    else:
+        coord = "127.0.0.1:%d" % (coordinator_port or _free_port())
+
+    procs = []
+    for pid in range(num_processes):
+        e = dict(base_env)
+        e.update({
+            "MXNET_COORDINATOR_ADDRESS": coord,
+            "MXNET_NUM_PROCESSES": str(num_processes),
+            "MXNET_PROCESS_ID": str(pid),
+        })
+        if hosts:
+            host = hosts[pid % len(hosts)]
+            exports = " ".join(
+                "%s=%s" % (k, _shquote(e[k]))
+                for k in ("MXNET_COORDINATOR_ADDRESS",
+                          "MXNET_NUM_PROCESSES", "MXNET_PROCESS_ID",
+                          "PYTHONPATH") if k in e)
+            remote = "cd %s && env %s %s" % (
+                _shquote(os.getcwd()), exports,
+                " ".join(_shquote(c) for c in cmd))
+            argv = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+            procs.append(subprocess.Popen(argv, env=base_env))
+        else:
+            procs.append(subprocess.Popen(list(cmd), env=e))
+    return [p.wait() for p in procs]
+
+
+def _shquote(s):
+    import shlex
+
+    return shlex.quote(str(s))
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=None,
                     help="defaults to num-workers (like the reference)")
-    ap.add_argument("--launcher", choices=["local"], default="local")
+    ap.add_argument("--launcher", choices=["local", "jax", "ssh"],
+                    default="local")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="one host per line (ssh launcher)")
+    ap.add_argument("--coordinator-port", type=int, default=None,
+                    help="jax.distributed coordinator port (ssh/jax "
+                         "launchers); default: free port locally, 9123 "
+                         "over ssh")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
-    ns = args.num_servers if args.num_servers is not None \
-        else args.num_workers
-    codes = launch_local(args.num_workers, ns, args.command)
+    if args.launcher == "local":
+        ns = args.num_servers if args.num_servers is not None \
+            else args.num_workers
+        codes = launch_local(args.num_workers, ns, args.command)
+    else:
+        hosts = None
+        if args.launcher == "ssh":
+            if not args.hostfile:
+                ap.error("--launcher ssh needs --hostfile")
+            with open(args.hostfile) as f:
+                hosts = [ln.strip() for ln in f if ln.strip()]
+        codes = launch_jax(args.num_workers, args.command, hosts=hosts,
+                           coordinator_port=args.coordinator_port)
     sys.exit(max(codes) if codes else 0)
 
 
