@@ -141,7 +141,9 @@ class Scheduler:
         self.lock = threading.Condition()
         self.servers: List[Tuple[str, int]] = []
         self.worker_ranks = 0
-        self.barrier_count: Dict[int, int] = {}
+        # per-generation set of arrived worker ranks: a rank arriving
+        # twice (crash + recovery replay) cannot double-count
+        self.barrier_ranks: Dict[int, set] = {}
         self.barrier_gen: Dict[int, int] = {}
         self.heartbeats: Dict[Tuple[str, int], float] = {}
         self.done = 0
@@ -211,12 +213,16 @@ class Scheduler:
                                     "barrier_gen": gen})
                 elif op == "barrier":
                     gid = msg.get("group", 0)
+                    rank = msg.get("rank")
                     with self.lock:
                         gen = self.barrier_gen.setdefault(gid, 0)
-                        self.barrier_count[gid] = \
-                            self.barrier_count.get(gid, 0) + 1
-                        if self.barrier_count[gid] >= self.num_workers:
-                            self.barrier_count[gid] = 0
+                        arrived = self.barrier_ranks.setdefault(gid, set())
+                        # anonymous callers get a synthetic id; ranked
+                        # callers dedupe across crash/recovery replays
+                        arrived.add(rank if rank is not None
+                                    else object())
+                        if len(arrived) >= self.num_workers:
+                            arrived.clear()
                             self.barrier_gen[gid] = gen + 1
                             self.lock.notify_all()
                         else:

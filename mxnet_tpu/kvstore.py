@@ -464,7 +464,8 @@ class KVStoreDist(KVStore):
             # by the cohort before the rejoin
             self._barrier_skip -= 1
             return
-        self._sched.request({"op": "barrier"}, timeout=86400.0)
+        self._sched.request({"op": "barrier", "rank": self._rank},
+                            timeout=86400.0)
 
     def get_dead_nodes(self, timeout: float = 60.0) -> List[str]:
         """Nodes whose heartbeat is older than ``timeout`` seconds, as

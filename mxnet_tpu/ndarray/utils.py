@@ -1,28 +1,66 @@
 """NDArray save/load (ref: python/mxnet/ndarray/utils.py:149,185 and the C
 container format in src/ndarray/ndarray.cc Save/Load).
 
-The on-disk format here is ``.npz`` with a small header entry — a documented
-divergence from the reference's dmlc binary container: same semantics
-(named or unnamed tensor dict), portable, and loadable without this
-framework.  ``load``/``save`` round-trip both list and dict payloads.
+Two on-disk formats are understood:
+
+* **Reference dmlc container** (``.params`` files from the reference
+  framework / its model zoo): ``uint64 0x112`` magic + list of
+  NDArray records (V2 ``0xF993fac9`` per-array magic with storage type,
+  V1 ``0xF993fac8``, or pre-V1 where the leading uint32 is the ndim) +
+  name list.  Read AND written (``save(..., format="dmlc")``), so
+  checkpoints flow both directions between the reference and this
+  framework — the layout is from src/ndarray/ndarray.cc:860-1100.
+* **npz** — the native default: same semantics (named or unnamed tensor
+  dict), portable, loadable without this framework.
+
+``load``/``save`` round-trip both list and dict payloads; ``load``
+sniffs the magic, so reference checkpoints need no flag.
 """
 from __future__ import annotations
 
 import io
 import os
+import struct
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as _np
 
+from ..base import MXNetError
 from ..context import Context, cpu
 from .ndarray import NDArray, array
 
 _LIST_PREFIX = "__mx_list_%d"
 
+# src/ndarray/ndarray.cc:1062 / :861-864
+_LIST_MAGIC = 0x112
+_V1_MAGIC = 0xF993FAC8
+_V2_MAGIC = 0xF993FAC9
 
-def save(fname: str, data: Union[NDArray, List[NDArray], Dict[str, NDArray]]) -> None:
+# mshadow type flags (mshadow/base.h kFloat32...)
+_FLAG_TO_DTYPE = {0: _np.float32, 1: _np.float64, 2: _np.float16,
+                  3: _np.uint8, 4: _np.int32, 5: _np.int8, 6: _np.int64}
+_DTYPE_TO_FLAG = {_np.dtype(v): k for k, v in _FLAG_TO_DTYPE.items()}
+# bfloat16 has no reference flag: dmlc saves cast to float32
+
+
+def save(fname: str,
+         data: Union[NDArray, List[NDArray], Dict[str, NDArray]],
+         format: str = "auto") -> None:
+    """``format``: "npz" (native), "dmlc" (reference-compatible
+    container), or "auto" — dmlc when ``fname`` ends in ``.params``
+    (the reference checkpoint convention), npz otherwise."""
     if isinstance(data, NDArray):
         data = [data]
+    if format == "auto":
+        format = "dmlc" if fname.endswith(".params") else "npz"
+    if format == "dmlc":
+        if isinstance(data, dict):
+            names, arrays = list(data.keys()), list(data.values())
+        else:
+            names, arrays = [], list(data)
+        with open(fname, "wb") as f:
+            _write_dmlc(f, arrays, names)
+        return
     payload = {}
     if isinstance(data, dict):
         for k, v in data.items():
@@ -35,18 +73,215 @@ def save(fname: str, data: Union[NDArray, List[NDArray], Dict[str, NDArray]]) ->
 
 
 def load(fname: str, ctx: Optional[Context] = None):
-    with _np.load(fname, allow_pickle=False) as z:
-        keys = list(z.keys())
-        if keys and all(k.startswith("__mx_list_") for k in keys):
-            keys.sort(key=lambda k: int(k.rsplit("_", 1)[1]))
-            return [array(z[k], ctx=ctx) for k in keys]
-        return {k: array(z[k], ctx=ctx) for k in keys}
+    with open(fname, "rb") as f:
+        head = f.read(8)
+        f.seek(0)
+        if len(head) == 8 and \
+                struct.unpack("<Q", head)[0] == _LIST_MAGIC:
+            return _read_dmlc(f, ctx)
+        buf = f.read()
+    return _load_npz(io.BytesIO(buf), ctx)
 
 
 def load_frombuffer(buf: bytes, ctx: Optional[Context] = None):
-    with _np.load(io.BytesIO(buf), allow_pickle=False) as z:
+    if len(buf) >= 8 and struct.unpack("<Q", buf[:8])[0] == _LIST_MAGIC:
+        return _read_dmlc(io.BytesIO(buf), ctx)
+    return _load_npz(io.BytesIO(buf), ctx)
+
+
+def _load_npz(f, ctx):
+    with _np.load(f, allow_pickle=False) as z:
         keys = list(z.keys())
         if keys and all(k.startswith("__mx_list_") for k in keys):
             keys.sort(key=lambda k: int(k.rsplit("_", 1)[1]))
             return [array(z[k], ctx=ctx) for k in keys]
         return {k: array(z[k], ctx=ctx) for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# reference dmlc container (src/ndarray/ndarray.cc:860-1100)
+# ---------------------------------------------------------------------------
+
+def _read_exact(f, n):
+    b = f.read(n)
+    if len(b) != n:
+        raise MXNetError("truncated NDArray container")
+    return b
+
+
+def _read_u32(f):
+    return struct.unpack("<I", _read_exact(f, 4))[0]
+
+
+def _read_i32(f):
+    return struct.unpack("<i", _read_exact(f, 4))[0]
+
+
+def _read_u64(f):
+    return struct.unpack("<Q", _read_exact(f, 8))[0]
+
+
+def _read_shape64(f):
+    """nnvm::Tuple<int64> Save layout: uint32 ndim + int64 dims."""
+    ndim = _read_u32(f)
+    if ndim == 0:
+        return ()
+    return struct.unpack("<%dq" % ndim, _read_exact(f, 8 * ndim))
+
+
+def _read_one_array(f):
+    magic = _read_u32(f)
+    if magic == _V2_MAGIC:
+        stype = _read_i32(f)
+        naux = {0: 0, 1: 1, 2: 2}.get(stype)
+        if naux is None:
+            raise MXNetError("unknown storage type %d in container"
+                             % stype)
+        sshape = _read_shape64(f) if naux else None
+        shape = _read_shape64(f)
+        if len(shape) == 0:
+            return None  # none-array slot
+        _read_i32(f), _read_i32(f)  # context (dev_type, dev_id): ignored
+        type_flag = _read_i32(f)
+        aux = []
+        for _ in range(naux):
+            aux_flag = _read_i32(f)
+            aux_shape = _read_shape64(f)
+            aux.append((aux_flag, aux_shape))
+        dtype = _FLAG_TO_DTYPE.get(type_flag)
+        if dtype is None:
+            raise MXNetError("unknown type flag %d" % type_flag)
+        data_shape = sshape if naux else shape
+        n = int(_np.prod(data_shape)) if len(data_shape) else 1
+        values = _np.frombuffer(
+            _read_exact(f, n * _np.dtype(dtype).itemsize),
+            dtype=dtype).reshape(data_shape)
+        aux_arrays = []
+        for aux_flag, aux_shape in aux:
+            adt = _FLAG_TO_DTYPE[aux_flag]
+            an = int(_np.prod(aux_shape)) if len(aux_shape) else 1
+            aux_arrays.append(_np.frombuffer(
+                _read_exact(f, an * _np.dtype(adt).itemsize),
+                dtype=adt).reshape(aux_shape))
+        if stype == 0:
+            return values
+        from . import sparse as _sp
+
+        if stype == 1:  # row_sparse: aux = [indices]
+            return _sp.row_sparse_array(
+                (array(values), array(aux_arrays[0])), shape=tuple(shape))
+        # csr: aux = [indptr, indices]
+        csr = _sp.csr_matrix(
+            (array(values), array(aux_arrays[1]), array(aux_arrays[0])),
+            shape=tuple(shape))
+        return csr
+    # V1 / legacy dense layouts
+    if magic == _V1_MAGIC:
+        shape = _read_shape64(f)
+    else:
+        # pre-V1: the magic itself is ndim, dims are uint32
+        ndim = magic
+        if ndim > 32:
+            raise MXNetError("corrupt NDArray container (ndim=%d)" % ndim)
+        shape = struct.unpack("<%dI" % ndim, _read_exact(f, 4 * ndim)) \
+            if ndim else ()
+    if len(shape) == 0:
+        return None
+    _read_i32(f), _read_i32(f)  # context
+    type_flag = _read_i32(f)
+    dtype = _FLAG_TO_DTYPE.get(type_flag)
+    if dtype is None:
+        raise MXNetError("unknown type flag %d" % type_flag)
+    n = int(_np.prod(shape))
+    return _np.frombuffer(_read_exact(f, n * _np.dtype(dtype).itemsize),
+                          dtype=dtype).reshape(shape)
+
+
+def _read_dmlc(f, ctx):
+    header = _read_u64(f)
+    if header != _LIST_MAGIC:
+        raise MXNetError("not an NDArray container (bad magic)")
+    _read_u64(f)  # reserved
+    count = _read_u64(f)
+    arrays = []
+    for _ in range(count):
+        a = _read_one_array(f)
+        arrays.append(a)
+    nname = _read_u64(f)
+    names = []
+    for _ in range(nname):
+        ln = _read_u64(f)
+        names.append(_read_exact(f, ln).decode())
+
+    def to_nd(a):
+        if a is None:
+            return None
+        if isinstance(a, _np.ndarray):
+            return array(a, ctx=ctx)
+        return a  # sparse NDArrays come back constructed
+
+    out = [to_nd(a) for a in arrays]
+    if names:
+        if len(names) != len(out):
+            raise MXNetError("container name/array count mismatch")
+        return dict(zip(names, out))
+    return out
+
+
+def _write_shape64(f, shape):
+    f.write(struct.pack("<I", len(shape)))
+    if shape:
+        f.write(struct.pack("<%dq" % len(shape), *shape))
+
+
+def _write_one_array(f, nd):
+    from . import sparse as _sp
+
+    if isinstance(nd, _sp.RowSparseNDArray):
+        stype = 1
+        values = _np.asarray(nd.data.asnumpy())
+        sshape = values.shape
+        aux_np = [_np.asarray(nd.indices.asnumpy(), _np.int64)]
+    elif isinstance(nd, _sp.CSRNDArray):
+        stype = 2
+        values = _np.asarray(nd.data.asnumpy())
+        sshape = values.shape
+        aux_np = [_np.asarray(nd.indptr.asnumpy(), _np.int64),
+                  _np.asarray(nd.indices.asnumpy(), _np.int64)]
+    else:
+        stype, aux_np = 0, []
+        values = nd.asnumpy()
+        sshape = None
+    if _np.dtype(values.dtype) not in _DTYPE_TO_FLAG:
+        # bfloat16 & friends have no reference flag: widen to float32
+        values = values.astype(_np.float32)
+    if len(nd.shape) == 0:
+        # ndim=0 is the container's none-array slot marker — a 0-d save
+        # would silently load back as None
+        raise MXNetError(
+            "the reference .params container cannot hold 0-d arrays; "
+            "reshape to (1,) before saving (or use format='npz')")
+    f.write(struct.pack("<I", _V2_MAGIC))
+    f.write(struct.pack("<i", stype))
+    if stype:
+        _write_shape64(f, sshape)
+    _write_shape64(f, tuple(nd.shape))
+    f.write(struct.pack("<ii", 1, 0))  # context: cpu(0)
+    f.write(struct.pack("<i", _DTYPE_TO_FLAG[_np.dtype(values.dtype)]))
+    for a in aux_np:
+        f.write(struct.pack("<i", _DTYPE_TO_FLAG[_np.dtype(a.dtype)]))
+        _write_shape64(f, a.shape)
+    f.write(_np.ascontiguousarray(values).tobytes())
+    for a in aux_np:
+        f.write(_np.ascontiguousarray(a).tobytes())
+
+
+def _write_dmlc(f, arrays, names):
+    f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+    f.write(struct.pack("<Q", len(arrays)))
+    for nd in arrays:
+        _write_one_array(f, nd)
+    f.write(struct.pack("<Q", len(names)))
+    for name in names:
+        b = name.encode()
+        f.write(struct.pack("<Q", len(b)) + b)

@@ -376,7 +376,9 @@ _make_regression(
 # outputs: out [, batch_mean, batch_var] + aux writebacks
 # ---------------------------------------------------------------------------
 @register("BatchNorm", aliases=("batch_norm", "BatchNorm_v1"),
-          mutate_aux=(3, 4), train_aware=True)
+          mutate_aux=(3, 4), train_aware=True,
+          input_names=("data", "gamma", "beta", "moving_mean",
+                       "moving_var"))
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                 momentum=0.9, fix_gamma=True, use_global_stats=False,
                 output_mean_var=False, axis=1, cudnn_off=False,

@@ -268,7 +268,12 @@ class Symbol:
         heads = [[node_ids[id(n)], int(i), 0] for n, i in self._flat_outputs()]
         return json.dumps({"nodes": out_nodes, "arg_nodes":
                            [i for i, n in enumerate(nodes) if n.is_variable],
-                           "heads": heads, "attrs": {"mxnet_version": ["int", 10000]}},
+                           "heads": heads,
+                           # mxnet_tpu marks a natively-saved graph; its
+                           # absence routes loads through the legacy
+                           # (reference-checkpoint) upgrade path
+                           "attrs": {"mxnet_version": ["int", 10000],
+                                     "mxnet_tpu": ["int", 1]}},
                           indent=2)
 
     def save(self, fname: str) -> None:
@@ -438,7 +443,16 @@ def load(fname: str) -> Symbol:
 
 
 def load_json(json_str: str) -> Symbol:
+    """Native graphs round-trip exactly; anything without the
+    ``mxnet_tpu`` stamp is treated as a reference/legacy checkpoint and
+    canonicalized first (string params -> typed, ``param``/``attr``
+    containers, hidden keys, pre-0.9 implicit inputs — ref:
+    src/nnvm/legacy_json_util.cc via symbol/legacy_json.py)."""
     data = json.loads(json_str)
+    if "mxnet_tpu" not in data.get("attrs", {}):
+        from .legacy_json import upgrade_json
+
+        data = upgrade_json(data)
     nodes: List[_Node] = []
     for spec in data["nodes"]:
         inputs = [(nodes[i], oi) for i, oi, _ in spec["inputs"]]

@@ -1,0 +1,233 @@
+"""Reference-checkpoint interop: legacy symbol JSON + dmlc .params.
+
+ref: src/nnvm/legacy_json_util.cc (JSON upgrade chain),
+src/ndarray/ndarray.cc:860-1100 (the .params container layout),
+python/mxnet/model.py:396 (load_checkpoint).
+
+The fixtures are built the way the *reference* would build them — JSON
+with all-string attrs under version-appropriate containers, and a
+byte-level dmlc container written here by an independent packer — so a
+real model-zoo checkpoint follows the same path."""
+import json
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import utils as nd_utils
+
+
+# ---------------------------------------------------------------------------
+# independent reference-layout packer (mirrors ndarray.cc Save, written
+# from the format spec, NOT via mxnet_tpu's writer — so reader bugs
+# can't cancel writer bugs)
+# ---------------------------------------------------------------------------
+
+def _pack_shape(shape):
+    out = struct.pack("<I", len(shape))
+    for d in shape:
+        out += struct.pack("<q", d)
+    return out
+
+
+def _pack_dense_v2(a):
+    flag = {"float32": 0, "float64": 1, "uint8": 3,
+            "int32": 4, "int64": 6}[str(a.dtype)]
+    out = struct.pack("<I", 0xF993FAC9)          # V2 magic
+    out += struct.pack("<i", 0)                   # dense storage
+    out += _pack_shape(a.shape)
+    out += struct.pack("<ii", 1, 0)               # cpu(0) context
+    out += struct.pack("<i", flag)
+    out += np.ascontiguousarray(a).tobytes()
+    return out
+
+
+def _pack_dense_v1(a):
+    out = struct.pack("<I", 0xF993FAC8)           # V1 magic
+    out += _pack_shape(a.shape)
+    out += struct.pack("<ii", 1, 0)
+    out += struct.pack("<i", 0)
+    return out + np.ascontiguousarray(a.astype(np.float32)).tobytes()
+
+
+def _pack_dense_legacy(a):
+    # pre-V1: leading uint32 is ndim, dims are uint32
+    out = struct.pack("<I", len(a.shape))
+    for d in a.shape:
+        out += struct.pack("<I", d)
+    out += struct.pack("<ii", 1, 0)
+    out += struct.pack("<i", 0)
+    return out + np.ascontiguousarray(a.astype(np.float32)).tobytes()
+
+
+def _pack_container(named, packer=_pack_dense_v2):
+    out = struct.pack("<QQ", 0x112, 0)
+    out += struct.pack("<Q", len(named))
+    for _, a in named:
+        out += packer(a)
+    out += struct.pack("<Q", len(named))
+    for name, _ in named:
+        b = name.encode()
+        out += struct.pack("<Q", len(b)) + b
+    return out
+
+
+@pytest.mark.parametrize("packer", [_pack_dense_v2, _pack_dense_v1,
+                                    _pack_dense_legacy])
+def test_params_container_reads_all_versions(tmp_path, packer):
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    blob = _pack_container([("arg:w", w), ("arg:b", b)], packer)
+    p = tmp_path / "ref.params"
+    p.write_bytes(blob)
+    loaded = nd_utils.load(str(p))
+    np.testing.assert_allclose(loaded["arg:w"].asnumpy(), w, rtol=1e-6)
+    np.testing.assert_allclose(loaded["arg:b"].asnumpy(), b, rtol=1e-6)
+
+
+def test_params_container_roundtrip_dmlc_writer(tmp_path):
+    """Our writer produces the same container our reference-layout
+    reader (and therefore the reference) parses."""
+    rng = np.random.RandomState(1)
+    data = {"a": nd.array(rng.randn(2, 5).astype(np.float32)),
+            "b": nd.array(rng.randint(0, 9, (3,)).astype(np.int32))}
+    p = str(tmp_path / "rt.params")  # .params => dmlc format by default
+    nd_utils.save(p, data)
+    with open(p, "rb") as f:
+        assert struct.unpack("<Q", f.read(8))[0] == 0x112
+    out = nd_utils.load(p)
+    np.testing.assert_allclose(out["a"].asnumpy(),
+                               data["a"].asnumpy(), rtol=1e-6)
+    np.testing.assert_array_equal(out["b"].asnumpy(),
+                                  data["b"].asnumpy())
+    assert out["b"].asnumpy().dtype == np.int32
+
+
+def _legacy_mlp_json():
+    """An MLP the way a 1.x reference save looks: attrs all strings,
+    cudnn/workspace knobs present, 2-element head entries."""
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "fc1_weight",
+         "attrs": {"lr_mult": "2.0"}, "inputs": []},
+        {"op": "null", "name": "fc1_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc1",
+         "attrs": {"num_hidden": "8", "no_bias": "False"},
+         "inputs": [[0, 0], [1, 0], [2, 0]]},
+        {"op": "Activation", "name": "relu1",
+         "attrs": {"act_type": "relu"}, "inputs": [[3, 0]]},
+        {"op": "null", "name": "fc2_weight", "inputs": []},
+        {"op": "null", "name": "fc2_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc2",
+         "attrs": {"num_hidden": "3"},
+         "inputs": [[4, 0], [5, 0], [6, 0]]},
+    ]
+    return json.dumps({
+        "nodes": nodes,
+        "arg_nodes": [0, 1, 2, 5, 6],
+        "node_row_ptr": list(range(9)),
+        "heads": [[7, 0]],
+        "attrs": {"mxnet_version": ["int", 10100]},
+    })
+
+
+def test_legacy_json_loads_and_matches_native_logits(tmp_path):
+    sym = mx.sym.load_json(_legacy_mlp_json())
+    assert sym.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias"]
+    rng = np.random.RandomState(2)
+    args = {
+        "data": nd.array(rng.randn(5, 7).astype(np.float32)),
+        "fc1_weight": nd.array(rng.randn(8, 7).astype(np.float32)),
+        "fc1_bias": nd.array(rng.randn(8).astype(np.float32)),
+        "fc2_weight": nd.array(rng.randn(3, 8).astype(np.float32)),
+        "fc2_bias": nd.array(rng.randn(3).astype(np.float32)),
+    }
+    out = sym.bind(args=dict(args)).forward()[0].asnumpy()
+
+    # natively-built ground truth
+    d = mx.sym.Variable("data")
+    n = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+    n = mx.sym.Activation(n, act_type="relu", name="relu1")
+    n = mx.sym.FullyConnected(n, num_hidden=3, name="fc2")
+    want = n.bind(args=dict(args)).forward()[0].asnumpy()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    # hidden key moved out of op params into __lr_mult__ on the var
+    loaded = json.loads(sym.tojson())
+    w1 = [nd_ for nd_ in loaded["nodes"] if nd_["name"] == "fc1_weight"][0]
+    assert w1["attrs"].get("__lr_mult__") == "2.0"
+
+
+def _pre09_json():
+    """0.8-era graph: ``param`` container, parameter inputs omitted
+    (the saver relied on runtime materialization)."""
+    nodes = [
+        {"op": "null", "name": "data", "param": {}, "inputs": []},
+        {"op": "FullyConnected", "name": "fc",
+         "param": {"num_hidden": "4"}, "inputs": [[0, 0]]},
+        {"op": "BatchNorm", "name": "bn",
+         "param": {"eps": "0.001", "momentum": "0.9",
+                   "fix_gamma": "True"},
+         "inputs": [[1, 0]]},
+    ]
+    return json.dumps({
+        "nodes": nodes,
+        "arg_nodes": [0],
+        "heads": [[2, 0]],
+        # no mxnet_version attr => treated as pre-0.9
+    })
+
+
+def test_pre09_json_materializes_missing_inputs():
+    sym = mx.sym.load_json(_pre09_json())
+    args = sym.list_arguments()
+    # fc weight/bias and bn gamma/beta materialized with reference names
+    assert args == ["data", "fc_weight", "fc_bias", "bn_gamma", "bn_beta"]
+    aux = sym.list_auxiliary_states()
+    assert aux == ["bn_moving_mean", "bn_moving_var"]
+    # and the graph runs
+    rng = np.random.RandomState(3)
+    ex = sym.simple_bind(data=(2, 6))
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            arr[:] = rng.randn(*arr.shape).astype(np.float32) * 0.1
+    ex.arg_dict["data"][:] = rng.randn(2, 6).astype(np.float32)
+    out = ex.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (2, 4)
+    assert np.isfinite(out).all()
+
+
+def test_full_checkpoint_roundtrip_reference_format(tmp_path):
+    """save_checkpoint -> files in the reference's on-disk formats ->
+    load_checkpoint -> identical logits."""
+    d = mx.sym.Variable("data")
+    n = mx.sym.FullyConnected(d, num_hidden=6, name="f1")
+    n = mx.sym.Activation(n, act_type="tanh", name="t")
+    n = mx.sym.FullyConnected(n, num_hidden=2, name="f2")
+
+    rng = np.random.RandomState(4)
+    arg_params = {
+        "f1_weight": nd.array(rng.randn(6, 4).astype(np.float32)),
+        "f1_bias": nd.zeros((6,)),
+        "f2_weight": nd.array(rng.randn(2, 6).astype(np.float32)),
+        "f2_bias": nd.zeros((2,)),
+    }
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 3, n, arg_params, {})
+    # .params is a dmlc container (reference tools can read it)
+    with open(prefix + "-0003.params", "rb") as f:
+        assert struct.unpack("<Q", f.read(8))[0] == 0x112
+
+    sym2, args2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    x = nd.array(rng.randn(3, 4).astype(np.float32))
+    args = dict(args2)
+    args["data"] = x
+    out = sym2.bind(args=args).forward()[0].asnumpy()
+    wargs = dict(arg_params)
+    wargs["data"] = x
+    want = n.bind(args=wargs).forward()[0].asnumpy()
+    np.testing.assert_allclose(out, want, rtol=1e-6)
