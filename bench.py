@@ -1,22 +1,136 @@
-"""Benchmark: ResNet-50 v1 training throughput, single chip.
+"""Benchmark suite: the BASELINE.md speed table, on one TPU chip.
 
-Baseline: 109 images/sec — the reference's published ResNet-50 training
-speed on 1x K80, batch 32, fp32
-(ref: /root/reference/example/image-classification/README.md:149-156,
-reproduced in BASELINE.md).
+Reference baselines (1x K80, batch 32 fp32 unless noted) come from
+/root/reference/example/image-classification/README.md:149-156 (single
+GPU training table) and :290-305 (alexnet b512 = 457.07 img/s at 1 GPU),
+reproduced in BASELINE.md.
 
-Measures the fused train step (forward + loss + backward + SGD momentum
-update in one XLA program) at batch 32 fp32 to match the baseline's
-training configuration.  Prints ONE JSON line.
+Per model we time the fused train step (forward + loss + backward + SGD
+momentum, one XLA program) and report:
+  - images/sec/chip (this host has exactly one chip; multi-chip scaling
+    is exercised separately by dryrun_multichip),
+  - dtype,
+  - MFU, two ways so the number is auditable:
+      * ``mfu`` — analytic model FLOPs (published 224x224 forward
+        GFLOPs, ALG_GFLOPS below, x3 for fwd+dgrad+wgrad) over the
+        chip's peak bf16 rate.  This is the standard MFU definition.
+      * ``hw_util_incl_padding`` — XLA's compiled-HLO cost analysis
+        over the same peak.  The compiled HLO counts MXU-padded
+        convolutions (channels pad to lane width), so this sits above
+        ``mfu``; the gap is padding waste, not useful work.
+    fp32 rows normalize against the bf16 peak too — the TPU has no
+    separate fp32 systolic rate, so this is the fraction of silicon
+    actually used.
+
+Timing discipline: the axon tunnel backend can acknowledge
+``block_until_ready`` before remote execution completes when the queue
+is deep, so every window drains the device with a value transfer
+(``loss.asnumpy()``) — enqueue-rate numbers would be fiction.
+
+Also benchmarked: ResNet-50 fed by ImageRecordIter over a generated
+.rec file (native C++ JPEG decode pipeline), so IO must keep up with
+compute end-to-end (ref: example/image-classification/common/data.py).
+
+Prints ONE JSON line; headline metric stays resnet50 fp32 img/s
+(vs_baseline vs the K80's 109) for cross-round continuity.
 """
 import json
+import os
 import sys
+import tempfile
 import time
 
 import numpy as np
 
+# (model, batch, K80 baseline img/s, dtype, bulk K).  Steps run K-at-a-
+# time inside one XLA program (FusedTrainStep.run_steps) — the bulk
+# path; K picked so a window is ~1-3s of device time.
+CONFIGS = [
+    ("resnet18_v1", 32, 185.0, "float32", 64),
+    ("resnet50_v1", 32, 109.0, "float32", 48),
+    ("resnet50_v1", 32, 109.0, "bfloat16", 48),
+    ("resnet152_v1", 32, 57.0, "float32", 24),
+    ("inception_bn", 32, 152.0, "float32", 48),
+    ("alexnet", 512, 457.07, "float32", 12),
+]
 
-def main():
+# published single-crop 224x224 forward GFLOPs (2*MACs): He et al. 2015
+# table 1 for resnets, Krizhevsky 2012 for alexnet, Ioffe&Szegedy 2015
+# topology for inception-bn.  Train step ~= 3x forward (dgrad+wgrad).
+ALG_GFLOPS = {
+    "resnet18_v1": 1.83, "resnet50_v1": 4.09, "resnet152_v1": 11.56,
+    "inception_bn": 2.03, "alexnet": 0.71,
+}
+_TRAIN_FACTOR = 3.0
+
+# peak dense matmul FLOP/s by device kind (bf16); public TPU specs
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _peak():
+    import jax
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v, kind
+    return None, kind
+
+
+def _drain(loss):
+    """A real device barrier: transfer the loss value to host.  (On the
+    tunnel backend block_until_ready can return before remote execution
+    finishes when the queue is deep.)"""
+    return float(np.asarray(loss.asnumpy()).reshape(-1)[0])
+
+
+def _time_step(step, X, y, bulk_k, windows=3):
+    # warmup: compile the K-step program + drain the queue completely
+    losses = step.run_steps(X, y, steps=bulk_k)
+    _drain(losses)
+    # the tunnel chip is shared: best of several windows so a noisy
+    # neighbour doesn't masquerade as a regression; each window starts
+    # from a drained queue and ends on a value transfer
+    best_dt = float("inf")
+    for _ in range(windows):
+        t0 = time.time()
+        losses = step.run_steps(X, y, steps=bulk_k)
+        _drain(losses)
+        best_dt = min(best_dt, time.time() - t0)
+    return best_dt / bulk_k
+
+
+def _step_flops(step, X, y, bulk_k):
+    """XLA's compiled cost analysis of the already-compiled K-step bulk
+    program (cache hit — no recompilation), per step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    raw_data = X._data
+    if step._dtype is not None:
+        raw_data = raw_data.astype(step._dtype)
+    raw_data = jax.device_put(raw_data, step._data_sh)
+    raw_label = jax.device_put(y._data, step._data_sh)
+    try:
+        compiled = step._multi_step_same[bulk_k].lower(
+            step._param_vals, step._moms, raw_data, raw_label,
+            step._key_root, step._key_ctr).compile()
+        # XLA cost analysis counts a While (scan) body ONCE, not
+        # per-iteration — the program's flops ARE one step's flops
+        return float(compiled.cost_analysis()["flops"])
+    except Exception:
+        return None
+
+
+def bench_model(name, batch, dtype, bulk_k):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd
     from mxnet_tpu.gluon.model_zoo import vision
@@ -25,8 +139,40 @@ def main():
 
     import jax
 
-    np.random.seed(0)
-    mx.random.seed(0)
+    net = vision.get_model(name, classes=1000)
+    net.initialize(mx.init.Xavier())
+    mesh = make_mesh((1,), ("dp",), jax.devices()[:1])
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, learning_rate=0.05, momentum=0.9,
+                          dtype=None if dtype == "float32" else dtype)
+    X = nd.random.uniform(shape=(batch, 3, 224, 224))
+    y = nd.array(np.random.randint(0, 1000, batch).astype("float32"))
+    sec_per_step = _time_step(step, X, y, bulk_k)
+    flops = _step_flops(step, X, y, bulk_k)
+    return batch / sec_per_step, flops, sec_per_step
+
+
+def bench_recordio_input():
+    """End-to-end: native-pipeline ImageRecordIter -> fused train step."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, io, nd, recordio
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.dp import FusedTrainStep
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="bench_rec_")
+    rec_path = os.path.join(tmp, "bench.rec")
+    idx_path = os.path.join(tmp, "bench.idx")
+    rng = np.random.RandomState(0)
+    n = 256
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (256, 256, 3), dtype=np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 1000), i, 0), img, quality=90))
+    w.close()
 
     batch = 32
     net = vision.resnet50_v1(classes=1000)
@@ -35,32 +181,110 @@ def main():
     step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                           mesh=mesh, learning_rate=0.05, momentum=0.9)
 
-    X = nd.random.uniform(shape=(batch, 3, 224, 224))
-    y = nd.array(np.random.randint(0, 1000, batch).astype("float32"))
+    base_it = io.ImageRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path,
+        data_shape=(3, 224, 224), batch_size=batch,
+        shuffle=True, rand_crop=True, rand_mirror=True,
+        preprocess_threads=8, dtype="uint8")
+    # uint8 batches: 4x less host->device traffic (the tunnel link is
+    # the constraint this config exists to expose); the train program
+    # casts on device.  PrefetchingIter overlaps decode + transfer with
+    # device compute.
+    it = io.PrefetchingIter(base_it)
 
-    # warmup / compile
-    for _ in range(3):
-        loss, _ = step(X, y)
-    loss.wait_to_read()
+    def run_epochs(k, stack=8):
+        """Stack `stack` batches from the pipeline into one K-step bulk
+        program — IO feeds the same bulk path the compute bench uses."""
+        import jax.numpy as jnp
 
-    # the tunnel chip is shared: take the best of several short timing
-    # windows so a noisy neighbour doesn't masquerade as a regression
-    iters = 15
-    best_dt = float("inf")
-    for _ in range(4):
+        seen = 0
         t0 = time.time()
-        for _ in range(iters):
-            loss, _ = step(X, y)
-        loss.wait_to_read()
-        best_dt = min(best_dt, time.time() - t0)
+        losses = None
+        for _ in range(k):
+            it.reset()
+            buf_d, buf_l = [], []
+            for b in it:
+                buf_d.append(b.data[0]._data)
+                buf_l.append(b.label[0]._data)
+                if len(buf_d) == stack:
+                    losses = step.run_steps(jnp.stack(buf_d),
+                                            jnp.stack(buf_l))
+                    seen += batch * stack
+                    buf_d, buf_l = [], []
+            if buf_d:
+                losses = step.run_steps(jnp.stack(buf_d),
+                                        jnp.stack(buf_l))
+                seen += batch * len(buf_d)
+        _drain(losses)
+        return seen / (time.time() - t0)
 
-    images_per_sec = iters * batch / best_dt
-    baseline = 109.0  # K80 fp32 batch 32 (BASELINE.md)
+    run_epochs(1)  # warmup/compile
+    e2e = max(run_epochs(2), run_epochs(2))
+    return e2e
+
+
+def main():
+    import mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    peak, kind = _peak()
+    table = []
+    headline = None
+    for name, batch, baseline, dtype, bulk_k in CONFIGS:
+        try:
+            ips, flops, sps = bench_model(name, batch, dtype, bulk_k)
+        except Exception as exc:
+            # one model must never cost the whole table (or the
+            # headline already measured)
+            table.append({"model": name, "batch": batch, "dtype": dtype,
+                          "error": repr(exc)})
+            print(json.dumps({"progress": table[-1]}), file=sys.stderr)
+            continue
+        row = {
+            "model": name, "batch": batch, "dtype": dtype,
+            "bulk_steps": bulk_k,
+            "images_per_sec_per_chip": round(ips, 2),
+            "vs_k80_baseline": round(ips / baseline, 2),
+        }
+        alg = ALG_GFLOPS.get(name)
+        if alg and peak:
+            alg_step = alg * 1e9 * _TRAIN_FACTOR * batch
+            row["alg_step_gflops"] = round(alg_step / 1e9, 1)
+            row["mfu"] = round(alg_step / sps / peak, 4)
+        if flops:
+            row["xla_step_gflops"] = round(flops / 1e9, 1)
+            if peak:
+                row["hw_util_incl_padding"] = round(flops / sps / peak, 4)
+        table.append(row)
+        if name == "resnet50_v1" and dtype == "float32":
+            headline = ips
+        print(json.dumps({"progress": row}), file=sys.stderr)
+
+    try:
+        e2e = bench_recordio_input()
+        io_row = {"pipeline": "ImageRecordIter->train", "model": "resnet50_v1",
+                  "images_per_sec": round(e2e, 2),
+                  "io_vs_compute": round(e2e / headline, 3) if headline else None}
+    except Exception as exc:  # never lose the headline to an IO failure
+        io_row = {"pipeline": "ImageRecordIter->train", "error": repr(exc)}
+
+    if headline is None:
+        # resnet50 fp32 itself failed: a different model's number would
+        # silently corrupt cross-round tracking — only another resnet50
+        # row may stand in; otherwise report 0 (an honest failure)
+        rn50 = [r for r in table if r.get("model") == "resnet50_v1"
+                and "images_per_sec_per_chip" in r]
+        headline = rn50[0]["images_per_sec_per_chip"] if rn50 else 0.0
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec",
-        "value": round(images_per_sec, 2),
+        "value": round(headline, 2),
         "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / baseline, 2),
+        "vs_baseline": round(headline / 109.0, 2),
+        "device_kind": kind,
+        "peak_bf16_tflops": peak / 1e12 if peak else None,
+        "table": table,
+        "io": io_row,
     }))
 
 

@@ -1,0 +1,129 @@
+"""VOC-style detection mAP metric.
+
+ref: example/ssd/evaluate/eval_metric.py (MApMetric / VOC07MApMetric) —
+implemented here from the published PASCAL VOC evaluation procedure:
+per-class greedy matching of score-ranked detections at an IoU
+threshold, then AP as either the 11-point interpolation (VOC07) or the
+area under the monotonized precision-recall curve.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from mxnet_tpu.metric import EvalMetric
+
+
+def _iou_matrix(boxes, gts):
+    """IoU between (n,4) detections and (m,4) ground truths (corner)."""
+    if len(boxes) == 0 or len(gts) == 0:
+        return np.zeros((len(boxes), len(gts)), np.float64)
+    lt = np.maximum(boxes[:, None, :2], gts[None, :, :2])
+    rb = np.minimum(boxes[:, None, 2:], gts[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    a = np.prod(np.clip(boxes[:, 2:] - boxes[:, :2], 0, None), axis=1)
+    b = np.prod(np.clip(gts[:, 2:] - gts[:, :2], 0, None), axis=1)
+    union = a[:, None] + b[None, :] - inter
+    return np.where(union > 0, inter / union, 0.0)
+
+
+class MApMetric(EvalMetric):
+    """Mean average precision over classes.
+
+    update() consumes one batch:
+      det:   (B, M, 6) rows [cls_id, score, x0, y0, x1, y1]; cls_id < 0
+             marks an invalid row (MultiBoxDetection's padding)
+      label: (B, K, 5) rows [cls_id, x0, y0, x1, y1]; cls_id < 0 pads
+    """
+
+    def __init__(self, iou_thresh=0.5, class_names=None,
+                 ovp_thresh=None, use_voc07=False, name="mAP"):
+        super().__init__(name)
+        self.iou_thresh = float(ovp_thresh if ovp_thresh is not None
+                                else iou_thresh)
+        self.class_names = class_names
+        self.use_voc07 = use_voc07
+        self.reset()
+
+    def reset(self):
+        # per class: list of (score, tp) over the epoch + total gt count
+        self._records: dict = {}
+        self._gt_counts: dict = {}
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        from mxnet_tpu.ndarray import NDArray
+
+        def np_of(x):
+            return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+        for label, det in zip(labels, preds):
+            label, det = np_of(label), np_of(det)
+            for b in range(label.shape[0]):
+                self._update_one(label[b], det[b])
+
+    def _update_one(self, gts, dets):
+        gts = gts[gts[:, 0] >= 0]
+        dets = dets[dets[:, 0] >= 0]
+        classes = set(gts[:, 0].astype(int)) | \
+            set(dets[:, 0].astype(int))
+        for c in classes:
+            gt_c = gts[gts[:, 0].astype(int) == c][:, 1:5]
+            dt_c = dets[dets[:, 0].astype(int) == c]
+            self._gt_counts[c] = self._gt_counts.get(c, 0) + len(gt_c)
+            if len(dt_c) == 0:
+                continue
+            order = np.argsort(-dt_c[:, 1])
+            dt_c = dt_c[order]
+            iou = _iou_matrix(dt_c[:, 2:6], gt_c)
+            taken = np.zeros(len(gt_c), bool)
+            rec = self._records.setdefault(c, [])
+            for i in range(len(dt_c)):
+                tp = 0
+                if len(gt_c):
+                    j = int(np.argmax(iou[i]))
+                    if iou[i, j] >= self.iou_thresh and not taken[j]:
+                        taken[j] = True
+                        tp = 1
+                rec.append((float(dt_c[i, 1]), tp))
+
+    def _ap(self, c):
+        npos = self._gt_counts.get(c, 0)
+        rec = self._records.get(c, [])
+        if npos == 0:
+            return None
+        if not rec:
+            return 0.0
+        rec = sorted(rec, key=lambda r: -r[0])
+        tps = np.array([r[1] for r in rec], np.float64)
+        tp_cum = np.cumsum(tps)
+        fp_cum = np.cumsum(1.0 - tps)
+        recall = tp_cum / npos
+        precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+        if self.use_voc07:
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precision[recall >= t].max() if \
+                    (recall >= t).any() else 0.0
+                ap += p / 11.0
+            return ap
+        # monotonize then integrate
+        for i in range(len(precision) - 2, -1, -1):
+            precision[i] = max(precision[i], precision[i + 1])
+        idx = np.where(recall[1:] != recall[:-1])[0] + 1
+        idx = np.concatenate(([0], idx))
+        return float(np.sum((recall[idx] - np.concatenate(
+            ([0.0], recall[idx][:-1]))) * precision[idx]))
+
+    def get(self):
+        aps = [self._ap(c) for c in sorted(self._gt_counts)]
+        aps = [a for a in aps if a is not None]
+        value = float(np.mean(aps)) if aps else 0.0
+        return self.name, value
+
+
+class VOC07MApMetric(MApMetric):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("name", "VOC07mAP")
+        super().__init__(use_voc07=True, **kwargs)

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""SSD training + VOC-mAP evaluation, end-to-end.
+
+ref: example/ssd/ — the reference's full pipeline is
+train/train_net.py (MultiBoxTarget-based training loop) +
+evaluate/eval_metric.py (mAP).  This is the download-free equivalent:
+a picklable synthetic shapes dataset rendered in DataLoader *process
+workers*, a multi-scale gluon SSD head (MultiBoxPrior anchors at two
+feature strides), the same target/loss chain
+(MultiBoxTarget -> cross-entropy + smooth-L1 with hard negative
+mining), and MultiBoxDetection -> VOC mAP evaluation.
+
+    python examples/ssd/train_ssd.py --epochs 5
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+from eval_metric import MApMetric
+
+CLASSES = ("square", "disk", "cross")
+MAX_OBJ = 3
+
+
+class ShapesDetDataset:
+    """Synthetic multi-object detection set: axis-aligned squares,
+    disks and crosses on noise.  Picklable => renders inside DataLoader
+    process workers.  Item: (C,H,W) float image, (MAX_OBJ,5) label rows
+    [cls, x0, y0, x1, y1] in relative coords, padded with -1."""
+
+    def __init__(self, n, size=64, seed=0):
+        self.n, self.size, self.seed = n, size, seed
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(self.seed * 100003 + i)
+        size = self.size
+        img = rng.uniform(0, 0.15, (1, size, size)).astype(np.float32)
+        label = np.full((MAX_OBJ, 5), -1, np.float32)
+        for k in range(rng.randint(1, MAX_OBJ + 1)):
+            cls = rng.randint(0, len(CLASSES))
+            s = rng.randint(size // 6, size // 3)
+            x0 = rng.randint(0, size - s)
+            y0 = rng.randint(0, size - s)
+            patch = img[0, y0:y0 + s, x0:x0 + s]
+            yy, xx = np.mgrid[0:s, 0:s]
+            if cls == 0:
+                patch[:] = 1.0
+            elif cls == 1:
+                r = s / 2.0
+                patch[(yy - r + .5) ** 2 + (xx - r + .5) ** 2 <= r * r] = 1.0
+            else:
+                w = max(1, s // 4)
+                patch[:, s // 2 - w // 2: s // 2 + (w + 1) // 2] = 1.0
+                patch[s // 2 - w // 2: s // 2 + (w + 1) // 2, :] = 1.0
+            label[k] = [cls, x0 / size, y0 / size,
+                        (x0 + s) / size, (y0 + s) / size]
+        return img, label
+
+
+class SSDNet(gluon.HybridBlock):
+    """Small multi-scale SSD: conv backbone with detection heads on the
+    stride-8 and stride-16 maps (the reference attaches heads to several
+    backbone scales the same way, example/ssd/symbol/symbol_builder.py).
+    """
+
+    def __init__(self, num_classes, num_anchors, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        na, nc = num_anchors, num_classes + 1
+        with self.name_scope():
+            def stage(c):
+                s = nn.HybridSequential(prefix="")
+                s.add(nn.Conv2D(c, 3, padding=1))
+                s.add(nn.BatchNorm())
+                s.add(nn.Activation("relu"))
+                s.add(nn.MaxPool2D(2))
+                return s
+
+            self.s1 = stage(16)   # /2
+            self.s2 = stage(32)   # /4
+            self.s3 = stage(64)   # /8  -> head A
+            self.s4 = stage(64)   # /16 -> head B
+            self.cls_a = nn.Conv2D(na * nc, 3, padding=1)
+            self.loc_a = nn.Conv2D(na * 4, 3, padding=1)
+            self.cls_b = nn.Conv2D(na * nc, 3, padding=1)
+            self.loc_b = nn.Conv2D(na * 4, 3, padding=1)
+
+    def hybrid_forward(self, F, x):
+        fa = self.s3(self.s2(self.s1(x)))
+        fb = self.s4(fa)
+        nc = self.num_classes + 1
+
+        def head(fm, cls_conv, loc_conv):
+            c = cls_conv(fm)
+            l = loc_conv(fm)
+            B = c.shape[0]
+            # (B, na*nc, H, W) -> (B, H*W*na, nc)
+            c = F.transpose(c, axes=(0, 2, 3, 1)).reshape((B, -1, nc))
+            l = F.transpose(l, axes=(0, 2, 3, 1)).reshape((B, -1))
+            return c, l
+
+        ca, la = head(fa, self.cls_a, self.loc_a)
+        cb, lb = head(fb, self.cls_b, self.loc_b)
+        cls = F.concat(ca, cb, dim=1)            # (B, N, nc)
+        cls = F.transpose(cls, axes=(0, 2, 1))   # (B, nc, N)
+        loc = F.concat(la, lb, dim=1)            # (B, N*4)
+        return cls, loc
+
+
+def build_anchors(net, image_size):
+    """MultiBoxPrior over each head's feature map, concatenated in the
+    same order the heads emit predictions."""
+    x = nd.zeros((1, 1, image_size, image_size))
+    fa = net.s3(net.s2(net.s1(x)))
+    fb = net.s4(fa)
+    aa = nd.contrib.MultiBoxPrior(fa, sizes=(0.2, 0.35), ratios=(1.0,))
+    ab = nd.contrib.MultiBoxPrior(fb, sizes=(0.5, 0.7), ratios=(1.0,))
+    return nd.concat(aa, ab, dim=1)
+
+
+def ssd_loss(cls, loc, anchors, labels):
+    """MultiBoxTarget with hard negative mining -> masked CE + smooth-L1
+    (ref: example/ssd/train/train_net.py loss composition)."""
+    cls_prob = nd.softmax(cls, axis=1)
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, labels, cls_prob, overlap_threshold=0.5,
+        negative_mining_ratio=3.0, negative_mining_thresh=0.3)
+    mask = (cls_t >= 0)
+    picked = nd.pick(cls_prob, nd.maximum(cls_t, 0), axis=1)
+    ce = -(nd.log(nd.maximum(picked, 1e-12)) * mask).sum() / \
+        nd.maximum(mask.sum(), 1)
+    sl1 = nd.smooth_l1(loc * loc_m - loc_t, scalar=1.0).sum() / \
+        nd.maximum(loc_m.sum(), 1)
+    return ce + sl1
+
+
+def evaluate(net, anchors, loader, metric):
+    metric.reset()
+    for img, label in loader:
+        cls, loc = net(img)
+        dets = nd.contrib.MultiBoxDetection(
+            nd.softmax(cls, axis=1), loc, anchors,
+            threshold=0.25, nms_threshold=0.45)
+        metric.update([label], [dets])
+    return metric.get()
+
+
+def train(epochs=5, batch_size=32, lr=0.05, image_size=64,
+          train_n=512, val_n=128, num_workers=2, log=True):
+    net = SSDNet(num_classes=len(CLASSES), num_anchors=2)
+    net.initialize(mx.init.Xavier())
+    anchors = build_anchors(net, image_size)
+
+    train_loader = gluon.data.DataLoader(
+        ShapesDetDataset(train_n, image_size, seed=1),
+        batch_size=batch_size, shuffle=True, num_workers=num_workers,
+        last_batch="discard")
+    val_loader = gluon.data.DataLoader(
+        ShapesDetDataset(val_n, image_size, seed=2),
+        batch_size=batch_size, num_workers=num_workers)
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9,
+                             "wd": 1e-4})
+    metric = MApMetric(iou_thresh=0.5, class_names=CLASSES)
+    history = []
+    for epoch in range(epochs):
+        total, nb = 0.0, 0
+        for img, label in train_loader:
+            with autograd.record():
+                cls, loc = net(img)
+                loss = ssd_loss(cls, loc, anchors, label)
+            loss.backward()
+            trainer.step(1)
+            total += float(loss.asnumpy())
+            nb += 1
+        name, mAP = evaluate(net, anchors, val_loader, metric)
+        history.append((total / max(nb, 1), mAP))
+        if log:
+            logging.info("epoch %d: loss %.4f, %s %.4f",
+                         epoch, total / max(nb, 1), name, mAP)
+    return net, anchors, history
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--num-workers", type=int, default=2)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    _, _, history = train(epochs=args.epochs, batch_size=args.batch_size,
+                          lr=args.lr, num_workers=args.num_workers)
+    print("final: loss %.4f mAP %.4f" % history[-1])
+
+
+if __name__ == "__main__":
+    main()

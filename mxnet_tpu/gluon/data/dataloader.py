@@ -1,14 +1,26 @@
 """DataLoader (ref: python/mxnet/gluon/data/dataloader.py:72-113).
 
-The reference forks worker processes and rebuilds NDArrays over POSIX shm
-(cpu_shared_storage_manager.h).  Host-side batching here is numpy; with
-``num_workers > 0`` batches are assembled by a thread pool (threads, not
-forks: the JAX runtime is not fork-safe, and batch assembly is
-numpy-bound which releases the GIL).  The device transfer happens once per
-batch at the end — the same pattern as the reference's pinned-memory copy.
+The reference forks worker processes and rebuilds NDArrays over POSIX
+shm (cpu_shared_storage_manager.h).  Here ``num_workers > 0`` runs
+**spawned** worker processes (fork is unsafe once the JAX runtime is
+live) that assemble batches and return them through
+``multiprocessing.shared_memory`` segments — python-side
+``Dataset.transform`` callables run truly in parallel, off the parent's
+GIL, and batch bytes cross process boundaries exactly once.  Workers
+run with ``JAX_PLATFORMS=cpu`` so they never contend for the TPU.
+
+``thread_pool=True`` selects the in-process thread pool instead (the
+reference has the same switch) — right when the per-item work is
+numpy/PIL-bound (releases the GIL) or the dataset doesn't pickle.
+Datasets that fail to pickle fall back to threads with a warning.
+
+The device transfer happens once per batch in the parent — the same
+pattern as the reference's pinned-memory copy.
 """
 from __future__ import annotations
 
+import logging
+import pickle
 import threading
 import queue as _queue
 from typing import Any, Callable, List, Optional, Sequence
@@ -19,6 +31,113 @@ from ...ndarray import NDArray, array as nd_array
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 __all__ = ["DataLoader", "default_batchify_fn"]
+
+_log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# process-worker plumbing.  Top-level (picklable) worker main; numpy
+# trees travel through shared_memory segments, specs through queues.
+# ---------------------------------------------------------------------------
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, NDArray):
+        return obj.asnumpy()
+    if isinstance(obj, (list, tuple)):
+        return [_to_numpy_tree(o) for o in obj]
+    return _np.asarray(obj)
+
+
+def _ship(tree, shm_mod):
+    """numpy tree -> (spec tree, [shm segments]); arrays land in shm.
+    On failure partway, already-created segments are unlinked (a full
+    /dev/shm must not leak what it did manage to allocate)."""
+    segs = []
+
+    def go(t):
+        if isinstance(t, list):
+            return [go(x) for x in t]
+        arr = _np.ascontiguousarray(t)
+        if arr.nbytes == 0:
+            return ("inline", arr)
+        seg = shm_mod.SharedMemory(create=True, size=arr.nbytes)
+        seg.buf[: arr.nbytes] = arr.tobytes()
+        segs.append(seg)
+        return ("shm", seg.name, arr.shape, str(arr.dtype))
+
+    try:
+        return go(tree), segs
+    except BaseException:
+        for seg in segs:
+            try:
+                seg.close()
+                seg.unlink()
+            except Exception:
+                pass
+        raise
+
+
+def _discard(spec, shm_mod):
+    """Unlink every shm segment named in a spec tree without reading it
+    (stale results from an abandoned iteration)."""
+    if isinstance(spec, list):
+        for s in spec:
+            _discard(s, shm_mod)
+        return
+    if isinstance(spec, tuple) and spec and spec[0] == "shm":
+        try:
+            seg = shm_mod.SharedMemory(name=spec[1])
+            seg.close()
+            seg.unlink()
+        except Exception:
+            pass
+
+
+def _receive(spec, shm_mod):
+    """spec tree -> NDArray tree; copies out of shm then unlinks."""
+    def go(s):
+        if isinstance(s, list):
+            return [go(x) for x in s]
+        if s[0] == "inline":
+            return nd_array(s[1])
+        _, name, shape, dtype = s
+        seg = shm_mod.SharedMemory(name=name)
+        try:
+            arr = _np.frombuffer(seg.buf, dtype=dtype)[
+                : int(_np.prod(shape))].reshape(shape).copy()
+        finally:
+            seg.close()
+            seg.unlink()
+        return nd_array(arr)
+
+    return go(spec)
+
+
+def _worker_main(dataset_pkl, batchify_pkl, task_q, result_q):
+    import os
+
+    # unconditional: an inherited JAX_PLATFORMS=tpu must not let a
+    # worker grab the parent's exclusive TPU
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    from multiprocessing import shared_memory as shm_mod
+
+    dataset = pickle.loads(dataset_pkl)
+    batchify = pickle.loads(batchify_pkl)
+    while True:
+        job = task_q.get()
+        if job is None:
+            return
+        epoch, jid, indices = job
+        try:
+            batch = batchify([dataset[i] for i in indices])
+            spec, segs = _ship(_to_numpy_tree(batch), shm_mod)
+            result_q.put((epoch, jid, "ok", spec))
+            for seg in segs:
+                seg.close()
+        except BaseException as e:
+            result_q.put((epoch, jid, "err",
+                          "%s: %s" % (type(e).__name__, e)))
 
 
 def default_batchify_fn(data):
@@ -32,13 +151,30 @@ def default_batchify_fn(data):
     return nd_array(arr)
 
 
+def _shutdown_pool(procs, task_q):
+    try:
+        for _ in procs:
+            task_q.put(None)
+    except Exception:
+        pass
+    for p in procs:
+        p.join(timeout=2)
+        if p.is_alive():
+            p.terminate()
+
+
 class DataLoader:
     """ref: dataloader.py DataLoader."""
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0, pin_memory=False, prefetch=None):
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
         self._dataset = dataset
+        self._thread_pool = bool(thread_pool)
+        self._pool = None  # lazily-spawned persistent process pool
+        self._epoch = 0
+        self._iter_active = False
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size is required when batch_sampler is None")
@@ -70,7 +206,94 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
+        if not self._thread_pool:
+            # one process-pool iterator at a time: a second concurrent
+            # iterator would race the shared result queue — it runs on
+            # the thread pool instead (same contract, no interference)
+            if not self._iter_active:
+                pool = self._ensure_pool()
+                if pool:  # False = unpicklable dataset: thread fallback
+                    self._iter_active = True
+                    yield from self._process_iter(pool)
+                    return
         yield from self._threaded_iter()
+
+    # -- process workers ----------------------------------------------
+    def _ensure_pool(self):
+        """Spawn the persistent worker pool once; None => dataset or
+        batchify doesn't pickle and we fall back to threads."""
+        if self._pool is not None:
+            return self._pool or None
+        try:
+            dataset_pkl = pickle.dumps(self._dataset)
+            batchify_pkl = pickle.dumps(self._batchify_fn)
+        except Exception as e:
+            _log.warning(
+                "DataLoader(num_workers=%d): dataset/batchify_fn does "
+                "not pickle (%s); falling back to the in-process thread "
+                "pool (pass thread_pool=True to silence this)",
+                self._num_workers, e)
+            self._pool = False
+            return None
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")  # fork is unsafe under JAX
+        task_q = ctx.SimpleQueue()
+        result_q = ctx.SimpleQueue()
+        procs = [ctx.Process(target=_worker_main,
+                             args=(dataset_pkl, batchify_pkl, task_q,
+                                   result_q),
+                             daemon=True)
+                 for _ in range(self._num_workers)]
+        for p in procs:
+            p.start()
+        self._pool = (procs, task_q, result_q)
+        import weakref
+
+        weakref.finalize(self, _shutdown_pool, procs, task_q)
+        return self._pool
+
+    def _process_iter(self, pool):
+        from multiprocessing import shared_memory as shm_mod
+
+        procs, task_q, result_q = pool
+        # epoch tag: results from an abandoned/errored earlier iteration
+        # must not masquerade as this epoch's batches (job ids restart
+        # at 0 every epoch)
+        self._epoch += 1
+        epoch = self._epoch
+        batches = list(self._batch_sampler)
+        inflight_cap = max(self._prefetch, self._num_workers)
+        results: dict = {}
+        submitted = 0
+        delivered = 0
+        try:
+            while delivered < len(batches):
+                while submitted < len(batches) and \
+                        submitted - delivered < inflight_cap:
+                    task_q.put((epoch, submitted,
+                                list(batches[submitted])))
+                    submitted += 1
+                while delivered not in results:
+                    r_epoch, jid, status, payload = result_q.get()
+                    if r_epoch != epoch:
+                        if status == "ok":
+                            _discard(payload, shm_mod)
+                        continue
+                    results[jid] = (status, payload)
+                status, payload = results.pop(delivered)
+                delivered += 1
+                if status == "err":
+                    raise RuntimeError("DataLoader worker failed: %s"
+                                       % payload)
+                yield _receive(payload, shm_mod)
+        finally:
+            # error or abandoned iteration: received-but-unread batches
+            # must not strand their shm segments
+            for status, payload in results.values():
+                if status == "ok":
+                    _discard(payload, shm_mod)
+            self._iter_active = False
 
     def _threaded_iter(self):
         batches = list(self._batch_sampler)

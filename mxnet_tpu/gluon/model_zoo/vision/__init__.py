@@ -6,6 +6,7 @@ from .squeezenet import *
 from .densenet import *
 from .mobilenet import *
 from .inception import *
+from .inception_bn import *
 
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
@@ -22,6 +23,7 @@ _models = {
     "densenet169": densenet169, "densenet201": densenet201,
     "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
     "inceptionv3": inception_v3,
+    "inception_bn": inception_bn, "inception-bn": inception_bn,
     "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
     "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,

@@ -1,0 +1,116 @@
+"""Inception-BN (GoogLeNet + batch normalization).
+
+The architecture from Ioffe & Szegedy 2015 ("Batch Normalization"),
+which is the reference's headline Inception benchmark network
+(ref: example/image-classification/symbols/inception-bn.py; the
+README.md:149-156 speed table's "Inception-BN" row).  Built here as a
+gluon HybridBlock from the published layer table rather than the
+reference's symbol-factory helpers.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["InceptionBN", "inception_bn"]
+
+
+def _conv_bn(channels, kernel, strides=1, padding=0):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(channels, kernel_size=kernel, strides=strides,
+                      padding=padding, use_bias=False))
+    out.add(nn.BatchNorm(epsilon=1e-3))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+class _Inception(HybridBlock):
+    """4-branch unit: 1x1 / 1x1-3x3 / 1x1-3x3-3x3 / pool-1x1proj."""
+
+    def __init__(self, c1, c3r, c3, cd3r, cd3, pool, proj, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.b1 = _conv_bn(c1, 1) if c1 > 0 else None
+            self.b3 = nn.HybridSequential(prefix="")
+            self.b3.add(_conv_bn(c3r, 1))
+            self.b3.add(_conv_bn(c3, 3, padding=1))
+            self.bd3 = nn.HybridSequential(prefix="")
+            self.bd3.add(_conv_bn(cd3r, 1))
+            self.bd3.add(_conv_bn(cd3, 3, padding=1))
+            self.bd3.add(_conv_bn(cd3, 3, padding=1))
+            self.bp = nn.HybridSequential(prefix="")
+            if pool == "max":
+                self.bp.add(nn.MaxPool2D(pool_size=3, strides=1, padding=1))
+            else:
+                self.bp.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+            if proj > 0:
+                self.bp.add(_conv_bn(proj, 1))
+
+    def hybrid_forward(self, F, x):
+        outs = []
+        if self.b1 is not None:
+            outs.append(self.b1(x))
+        outs.append(self.b3(x))
+        outs.append(self.bd3(x))
+        outs.append(self.bp(x))
+        return F.concat(*outs, dim=1)
+
+
+class _InceptionDown(HybridBlock):
+    """Stride-2 grid-reduction unit (no 1x1 branch; max-pool passthrough)."""
+
+    def __init__(self, c3r, c3, cd3r, cd3, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.b3 = nn.HybridSequential(prefix="")
+            self.b3.add(_conv_bn(c3r, 1))
+            self.b3.add(_conv_bn(c3, 3, strides=2, padding=1))
+            self.bd3 = nn.HybridSequential(prefix="")
+            self.bd3.add(_conv_bn(cd3r, 1))
+            self.bd3.add(_conv_bn(cd3, 3, padding=1))
+            self.bd3.add(_conv_bn(cd3, 3, strides=2, padding=1))
+            self.pool = nn.MaxPool2D(pool_size=3, strides=2, padding=1)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(self.b3(x), self.bd3(x), self.pool(x), dim=1)
+
+
+class InceptionBN(HybridBlock):
+    """Input (N, 3, 224, 224) -> (N, classes)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            f = nn.HybridSequential(prefix="")
+            # stem
+            f.add(_conv_bn(64, 7, strides=2, padding=3))
+            f.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            f.add(_conv_bn(64, 1))
+            f.add(_conv_bn(192, 3, padding=1))
+            f.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            # 3a / 3b / 3c(down)
+            f.add(_Inception(64, 64, 64, 64, 96, "avg", 32))
+            f.add(_Inception(64, 64, 96, 64, 96, "avg", 64))
+            f.add(_InceptionDown(128, 160, 64, 96))
+            # 4a-4d / 4e(down)
+            f.add(_Inception(224, 64, 96, 96, 128, "avg", 128))
+            f.add(_Inception(192, 96, 128, 96, 128, "avg", 128))
+            f.add(_Inception(160, 128, 160, 128, 160, "avg", 128))
+            f.add(_Inception(96, 128, 192, 160, 192, "avg", 128))
+            f.add(_InceptionDown(128, 192, 192, 256))
+            # 5a / 5b
+            f.add(_Inception(352, 192, 320, 160, 224, "avg", 128))
+            f.add(_Inception(352, 192, 320, 192, 224, "max", 128))
+            f.add(nn.GlobalAvgPool2D())
+            f.add(nn.Flatten())
+            self.features = f
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def inception_bn(pretrained=False, ctx=None, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (zero-egress build)")
+    return InceptionBN(**kwargs)

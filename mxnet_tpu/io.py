@@ -601,6 +601,7 @@ class ImageRecordIter(DataIter):
         self._L.MXTPUImageIterNumRecords(self._handle, _ct.byref(n))
         self.num_records = n.value
         self._first_batch = None
+        self._views = {}
 
     @property
     def provide_data(self):
@@ -616,6 +617,19 @@ class ImageRecordIter(DataIter):
     def reset(self):
         self._L.MXTPUImageIterReset(self._handle)
 
+    def _mapped_view(self, ptr, shape):
+        """Cache the numpy view over each recycled C ring-buffer slot —
+        ctypeslib.as_array construction costs ~1ms and the pipeline
+        cycles through a fixed set of slots."""
+        import ctypes as _ct
+
+        addr = _ct.cast(ptr, _ct.c_void_p).value
+        view = self._views.get((addr, shape))
+        if view is None:
+            view = _np.ctypeslib.as_array(ptr, shape=shape)
+            self._views[(addr, shape)] = view
+        return view
+
     def next(self) -> DataBatch:
         import ctypes as _ct
 
@@ -630,14 +644,21 @@ class ImageRecordIter(DataIter):
             raise StopIteration
         c, h, w = self._shape
         n = self.batch_size
-        data = _np.ctypeslib.as_array(data_p, shape=(n, c, h, w)).copy()
-        label = _np.ctypeslib.as_array(
-            label_p, shape=(n, self._label_width)).copy()
+        # fresh copies: jax.device_put may zero-copy an aligned numpy
+        # array (CPU) or hold it immutable until an async transfer
+        # completes (PJRT), so the C ring-buffer slot must never back a
+        # returned batch directly
+        dview = self._mapped_view(data_p, (n, c, h, w))
+        lview = self._mapped_view(label_p, (n, self._label_width))
+        data, label = dview.copy(), lview.copy()
         if self._label_width == 1:
             label = label.reshape(n)
         if self._dtype != "float32":
             data = data.astype(self._dtype)
-            label = label.astype(self._dtype)
+            if _np.dtype(self._dtype).kind == "f":
+                # labels stay float for integer data dtypes (a uint8
+                # image pipeline must not truncate class ids > 255)
+                label = label.astype(self._dtype)
         return DataBatch([array(data)], [array(label)], pad=pad.value)
 
     def iter_next(self):

@@ -690,12 +690,32 @@ def moveaxis(tensor: NDArray, source: int, destination: int) -> NDArray:
 
 
 def waitall() -> None:
-    """ref: Engine::WaitForAll — XLA equivalent is a no-op barrier; we keep
-    the call for API compat (blocks on nothing because each NDArray blocks
-    lazily)."""
-    import jax
+    """ref: Engine::WaitForAll (include/mxnet/engine.h).
 
+    Devices execute enqueued XLA programs in submission order, so
+    running one trivial program per device and transferring its result
+    to host is a true barrier on all previously dispatched work — the
+    value transfer matters: on some backends (the axon tunnel)
+    ``block_until_ready`` alone can acknowledge before remote execution
+    finishes."""
+    import jax
+    import jax.numpy as jnp
+
+    global _waitall_fence
     try:
         jax.effects_barrier()
     except Exception:
         pass
+    if _waitall_fence is None:
+        # module-level singleton: a fresh lambda per call would miss
+        # the jit cache and recompile the fence on every waitall()
+        _waitall_fence = jax.jit(lambda x: x + 1)
+    for d in jax.local_devices():
+        try:
+            jax.device_get(_waitall_fence(jax.device_put(
+                jnp.zeros((), jnp.int32), d)))
+        except Exception:
+            pass
+
+
+_waitall_fence = None

@@ -30,6 +30,7 @@ from ..context import Context, cpu
 from .ndarray import NDArray, array
 
 _LIST_PREFIX = "__mx_list_%d"
+_BF16_TAG = "__mx_bf16"
 
 # src/ndarray/ndarray.cc:1062 / :861-864
 _LIST_MAGIC = 0x112
@@ -52,7 +53,17 @@ def save(fname: str,
     if isinstance(data, NDArray):
         data = [data]
     if format == "auto":
-        format = "dmlc" if fname.endswith(".params") else "npz"
+        # dmlc for .params (the reference checkpoint convention) — but
+        # only when the payload is representable there: bf16 would be
+        # silently widened and 0-d arrays cannot be expressed, so those
+        # keep the lossless npz path
+        arrays = data.values() if isinstance(data, dict) else data
+        representable = all(
+            len(v.shape) > 0 and
+            _np.dtype(v.asnumpy().dtype) in _DTYPE_TO_FLAG
+            for v in arrays)
+        format = "dmlc" if fname.endswith(".params") and representable \
+            else "npz"
     if format == "dmlc":
         if isinstance(data, dict):
             names, arrays = list(data.keys()), list(data.values())
@@ -63,11 +74,17 @@ def save(fname: str,
         return
     payload = {}
     if isinstance(data, dict):
-        for k, v in data.items():
-            payload[k] = v.asnumpy()
+        items = data.items()
     else:
-        for i, v in enumerate(data):
-            payload[_LIST_PREFIX % i] = v.asnumpy()
+        items = ((_LIST_PREFIX % i, v) for i, v in enumerate(data))
+    for k, v in items:
+        arr = v.asnumpy()
+        if arr.dtype.name == "bfloat16":
+            # numpy's zip format mangles ml_dtypes' bfloat16 to raw
+            # void: store the bit pattern + a name tag instead
+            payload[k + _BF16_TAG] = arr.view(_np.uint16)
+        else:
+            payload[k] = arr
     with open(fname, "wb") as f:
         _np.savez(f, **payload)
 
@@ -79,8 +96,9 @@ def load(fname: str, ctx: Optional[Context] = None):
         if len(head) == 8 and \
                 struct.unpack("<Q", head)[0] == _LIST_MAGIC:
             return _read_dmlc(f, ctx)
-        buf = f.read()
-    return _load_npz(io.BytesIO(buf), ctx)
+    # npz: hand np.load the path so zip members stream lazily instead
+    # of slurping the archive into RAM first
+    return _load_npz(fname, ctx)
 
 
 def load_frombuffer(buf: bytes, ctx: Optional[Context] = None):
@@ -90,12 +108,22 @@ def load_frombuffer(buf: bytes, ctx: Optional[Context] = None):
 
 
 def _load_npz(f, ctx):
+    def decode(z, k):
+        if k.endswith(_BF16_TAG):
+            import ml_dtypes
+
+            return array(z[k].view(ml_dtypes.bfloat16), ctx=ctx)
+        return array(z[k], ctx=ctx)
+
+    def name(k):
+        return k[: -len(_BF16_TAG)] if k.endswith(_BF16_TAG) else k
+
     with _np.load(f, allow_pickle=False) as z:
         keys = list(z.keys())
-        if keys and all(k.startswith("__mx_list_") for k in keys):
-            keys.sort(key=lambda k: int(k.rsplit("_", 1)[1]))
-            return [array(z[k], ctx=ctx) for k in keys]
-        return {k: array(z[k], ctx=ctx) for k in keys}
+        if keys and all(name(k).startswith("__mx_list_") for k in keys):
+            keys.sort(key=lambda k: int(name(k).rsplit("_", 1)[1]))
+            return [decode(z, k) for k in keys]
+        return {name(k): decode(z, k) for k in keys}
 
 
 # ---------------------------------------------------------------------------

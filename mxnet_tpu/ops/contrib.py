@@ -786,3 +786,4 @@ def _ifft(data, compute_size=128, **_):
     z = c[..., 0] + 1j * c[..., 1]
     out = jnp.fft.ifft(z, axis=-1) * n
     return out.real.astype(data.dtype)
+

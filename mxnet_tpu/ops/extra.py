@@ -269,3 +269,11 @@ def _register_aliases():
 
 
 _register_aliases()
+
+
+
+# ------------------------------------------------------ HardSigmoid
+@register("hard_sigmoid", aliases=("HardSigmoid",))
+def _hard_sigmoid(data, alpha=0.2, beta=0.5, **_):
+    """Piecewise-linear sigmoid y = clip(alpha*x + beta, 0, 1)."""
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)

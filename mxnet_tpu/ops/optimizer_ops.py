@@ -233,3 +233,26 @@ def _sparse_adagrad_update(weight, gdata, rows, history, lr=0.01, epsilon=1e-7,
     hrows = jnp.take(history, rows, axis=0) + jnp.square(g)
     return (weight.at[rows].add(-lr * g / jnp.sqrt(hrows + epsilon)),
             history.at[rows].set(hrows))
+
+
+@register("lars_sgd_mom_update", nondiff=True, mutate_aux=(2,))
+def _lars_sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                         eta=0.001, eps=1e-9, rescale_grad=1.0,
+                         clip_gradient=-1.0, **_):
+    """LARS (layer-wise adaptive rate scaling) momentum SGD — the
+    large-batch update rule of You et al. 2017.  The trust ratio
+    ``eta * ||w|| / (||g|| + wd*||w|| + eps)`` rescales this layer's lr
+    so every layer moves a proportionate distance, which is what keeps
+    batch sizes in the 8k-32k range (TPU pod data-parallel scale)
+    converging."""
+    g = grad * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w_norm = jnp.sqrt(jnp.sum(weight.astype(jnp.float32) ** 2))
+    g_norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+    trust = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        eta * w_norm / (g_norm + wd * w_norm + eps), 1.0).astype(weight.dtype)
+    local_lr = lr * trust
+    new_mom = momentum * mom + local_lr * (g + wd * weight)
+    return weight - new_mom, new_mom

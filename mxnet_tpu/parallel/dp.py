@@ -144,11 +144,10 @@ class FusedTrainStep:
         momentum = self._momentum_cfg
         weight_decay = self._weight_decay
         with autograd.pause():
-            # settle deferred shapes with the params' current dtype —
-            # the user may hand a bf16 batch before the cast happens
+            # settle deferred shapes in float32 — the user may hand a
+            # bf16 or uint8 batch before the in-program cast happens
             settle = sample_data
-            if self._dtype is not None and \
-                    str(sample_data.dtype) != "float32":
+            if str(sample_data.dtype) != "float32":
                 settle = sample_data.astype("float32")
             block(settle)  # settles deferred initialization
         if self._dtype is not None:
@@ -180,7 +179,17 @@ class FusedTrainStep:
         aux_idx = self._aux_idx
         lr, mom_c, wd = learning_rate, momentum, weight_decay
 
+        import jax.numpy as _jnp
+
+        compute_dtype = _jnp.dtype(self._dtype) if self._dtype else \
+            _jnp.float32
+
         def step(param_vals, mom_vals, data, label, key_root, ctr):
+            # integer batches (uint8 pipelines — 4x less host->device
+            # traffic) cast to the compute dtype INSIDE the program,
+            # where XLA fuses the cast into the first conv
+            if data.dtype != compute_dtype:
+                data = data.astype(compute_dtype)
             # fold the per-step counter inside the fused program: no
             # separate host-side fold_in dispatch per step
             key = jax.random.fold_in(key_root, ctr)
@@ -227,6 +236,61 @@ class FusedTrainStep:
             donate_argnums=donate,
         )
 
+        # K steps inside ONE program via lax.scan — the TPU analogue of
+        # the reference engine's bulk execution (engine.set_bulk_size):
+        # per-dispatch host/tunnel latency amortizes over K, which
+        # dominates at small batch.  Batches carry a leading K dim.
+        from jax import lax as _lax
+
+        def multi_step(param_vals, mom_vals, datas, labels, key_root,
+                       ctr0):
+            def body(carry, xs):
+                params, moms, ctr = carry
+                data, label = xs
+                new_params, new_moms, loss_val, _ = step(
+                    params, moms, data, label, key_root, ctr)
+                return (new_params, new_moms, ctr + 1), loss_val
+
+            (fparams, fmoms, _), losses = _lax.scan(
+                body, (param_vals, mom_vals, ctr0), (datas, labels))
+            return fparams, fmoms, losses
+
+        from jax.sharding import PartitionSpec as _P
+
+        kdata_sh = NamedSharding(self.mesh, _P(None, "dp"))
+        self._multi_step = jax.jit(
+            multi_step,
+            in_shardings=(self._param_sh, self._param_sh, kdata_sh,
+                          kdata_sh, rep, rep),
+            out_shardings=(self._param_sh, self._param_sh, rep),
+            donate_argnums=donate,
+        )
+
+        # same-batch variant: the batch is closed over once instead of
+        # materializing K copies in HBM (bench/burn-in path)
+        def multi_step_same(k):
+            def fn(param_vals, mom_vals, data, label, key_root, ctr0):
+                def body(carry, _):
+                    params, moms, ctr = carry
+                    new_params, new_moms, loss_val, _ = step(
+                        params, moms, data, label, key_root, ctr)
+                    return (new_params, new_moms, ctr + 1), loss_val
+
+                (fparams, fmoms, _), losses = _lax.scan(
+                    body, (param_vals, mom_vals, ctr0), None, length=k)
+                return fparams, fmoms, losses
+
+            return jax.jit(
+                fn,
+                in_shardings=(self._param_sh, self._param_sh, data_sh,
+                              data_sh, rep, rep),
+                out_shardings=(self._param_sh, self._param_sh, rep),
+                donate_argnums=donate,
+            )
+
+        self._multi_step_same = {}
+        self._multi_step_same_fn = multi_step_same
+
         import jax.numpy as jnp
 
         from .. import random as _random
@@ -247,6 +311,71 @@ class FusedTrainStep:
         self._param_vals = [p.data()._data for p in self._cells]
         self._param_vt = [p.data()._vt for p in self._cells]
         self._placed = True
+
+    def run_steps(self, data, label, steps=None):
+        """Run K optimizer steps as ONE compiled program (lax.scan).
+
+        ``data``/``label`` either carry a leading K dimension (one batch
+        per step) or are single batches reused ``steps`` times (bench /
+        burn-in).  Returns the per-step losses as an NDArray of shape
+        (K,).  Amortizes per-dispatch latency — the reference's bulk
+        path (engine.set_bulk_size, MXNET_ENGINE_BULK_SIZE), TPU-style.
+        """
+        jax = _jax()
+        import jax.numpy as jnp
+
+        if not self._built:
+            d0 = data if isinstance(data, NDArray) else NDArray(data)
+            if steps is None:  # leading dim is K: build on one batch
+                d0 = NDArray.from_raw(d0._data[0])
+            self._build(d0)
+        if not self._placed:
+            self._place_params()
+        raw_data = data._data if isinstance(data, NDArray) else data
+        raw_label = label._data if isinstance(label, NDArray) else label
+        if self._dtype is not None:
+            raw_data = raw_data.astype(self._dtype)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if steps is not None:
+            # same batch every step: close over ONE on-device copy
+            # instead of materializing K in HBM
+            k = int(steps)
+            raw_data = jax.device_put(raw_data, self._data_sh)
+            raw_label = jax.device_put(raw_label, self._data_sh)
+            runner = self._multi_step_same.get(k)
+            if runner is None:
+                runner = self._multi_step_same_fn(k)
+                self._multi_step_same[k] = runner
+        else:
+            k = raw_data.shape[0]
+            kdata_sh = NamedSharding(self.mesh, P(None, "dp"))
+            raw_data = jax.device_put(raw_data, kdata_sh)
+            raw_label = jax.device_put(raw_label, kdata_sh)
+            runner = self._multi_step
+        params = self._param_vals
+        for i, p in enumerate(self._cells):
+            cell = p.data()
+            if cell._vt is not self._param_vt[i]:
+                params[i] = cell._data
+        from .. import random as _random
+
+        if self._key_gen != _random._generation:
+            self._key_root = jax.device_put(_random._next_key(), self._rep)
+            self._key_gen = _random._generation
+            self._key_ctr = 0
+        ctr0 = self._key_ctr + 1
+        self._key_ctr += k
+        new_params, self._moms, losses = runner(
+            params, self._moms, raw_data, raw_label, self._key_root, ctr0)
+        self._param_vals = new_params
+        for i, (p, v) in enumerate(zip(self._cells, new_params)):
+            cell = p.data()
+            cell._data = v
+            token = object()
+            cell._vt = token
+            self._param_vt[i] = token
+        return NDArray.from_raw(losses)
 
     def __call__(self, data, label):
         """Run one optimizer step; returns (loss, logits) NDArrays."""

@@ -208,3 +208,97 @@ def test_aliases_present():
     # symbol layer too
     s = mx.sym.MakeLoss(mx.sym.Variable("x"))
     assert s.infer_shape(x=(2, 2))[1] == [(2, 2)]
+
+
+def test_khatri_rao_matches_reference_example():
+    """The worked example from src/operator/contrib/krprod.cc:94-105."""
+    A = nd.array(np.array([[1, -1], [2, -3]], np.float32))
+    B = nd.array(np.array([[1, 4], [2, 5], [3, 6]], np.float32))
+    C = nd.khatri_rao(A, B)
+    want = np.array([[1, -4], [2, -5], [3, -6],
+                     [2, -12], [4, -15], [6, -18]], np.float32)
+    np.testing.assert_allclose(C.asnumpy(), want)
+    # n=3 fold: columns are triple outer products
+    D = nd.array(np.array([[2, 1]], np.float32))
+    E = nd.khatri_rao(A, B, D)
+    np.testing.assert_allclose(E.asnumpy(), want * np.array([2, 1]))
+
+
+def test_hard_sigmoid():
+    x = nd.array(np.array([-10, -1, 0, 1, 10], np.float32))
+    y = nd.hard_sigmoid(x, alpha=0.2, beta=0.5)
+    np.testing.assert_allclose(y.asnumpy(), [0, 0.3, 0.5, 0.7, 1.0],
+                               rtol=1e-6)
+    # differentiable inside the linear region
+    from mxnet_tpu import autograd
+    x2 = nd.array(np.array([0.5], np.float32))
+    x2.attach_grad()
+    with autograd.record():
+        out = nd.hard_sigmoid(x2, alpha=0.25)
+    out.backward()
+    np.testing.assert_allclose(x2.grad.asnumpy(), [0.25], rtol=1e-6)
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-3, 5, (4, 6)).astype(np.float32)
+    mn = nd.array(np.array([-3.0], np.float32))
+    mx_ = nd.array(np.array([5.0], np.float32))
+    q, qmin, qmax = nd.contrib.quantize(nd.array(x), mn, mx_,
+                                        out_type="uint8")
+    assert q.asnumpy().dtype == np.uint8
+    np.testing.assert_allclose(qmin.asnumpy(), [-3.0])
+    np.testing.assert_allclose(qmax.asnumpy(), [5.0])
+    back = nd.contrib.dequantize(q, qmin, qmax)
+    # uint8 over an 8-unit range: max error = half a step
+    assert np.abs(back.asnumpy() - x).max() <= (8.0 / 255.0) / 2 + 1e-5
+
+    q8, a, b = nd.contrib.quantize(nd.array(x), mn, mx_, out_type="int8")
+    assert q8.asnumpy().dtype == np.int8
+    back8 = nd.contrib.dequantize(q8, a, b)
+    assert np.abs(back8.asnumpy() - x).max() <= (8.0 / 254.0) / 2 + 1e-5
+
+
+def test_lbsgd_lars_converges_and_scales_rates():
+    """The trust ratio must equalize step magnitude across wildly
+    different layer scales (the point of LARS)."""
+    import mxnet_tpu as mx
+
+    opt = mx.optimizer.LBSGD(learning_rate=0.1, momentum=0.9, eta=0.01,
+                             warmup_steps=5, warmup_init=0.1)
+    big = nd.array(np.full((4,), 100.0, np.float32))
+    small = nd.array(np.full((4,), 0.01, np.float32))
+    sb = opt.create_state(0, big)
+    ss = opt.create_state(1, small)
+    gb = nd.array(np.full((4,), 50.0, np.float32))
+    gs = nd.array(np.full((4,), 0.005, np.float32))
+    b0, s0 = big.asnumpy().copy(), small.asnumpy().copy()
+    opt.update(0, big, gb, sb)
+    opt.update(1, small, gs, ss)
+    db = np.abs(big.asnumpy() - b0).mean() / 100.0
+    ds = np.abs(small.asnumpy() - s0).mean() / 0.01
+    # relative movement within 1.5x of each other despite 1e4 scale gap
+    assert 0.6 < db / ds < 1.5, (db, ds)
+
+    # and it optimizes: LARS is scale-invariant, so on a quadratic bowl
+    # the step is a constant *relative* shrink — verify geometric decay
+    # toward the optimum (eta*lr/(1-momentum)*2 per step analytically)
+    w = nd.array(np.array([5.0, -3.0], np.float32))
+    st = opt.create_state(2, w)
+    n0 = float(np.linalg.norm(w.asnumpy()))
+    for _ in range(60):
+        g = 2 * w  # d/dw ||w||^2
+        opt.update(2, w, g, st)
+    n1 = float(np.linalg.norm(w.asnumpy()))
+    assert n1 < 0.7 * n0, (n0, n1)
+    ratio = w.asnumpy() / np.array([5.0, -3.0])
+    np.testing.assert_allclose(ratio[0], ratio[1], rtol=1e-3)
+
+
+def test_waitall_blocks():
+    from mxnet_tpu import ndarray as ndmod
+
+    x = nd.random.uniform(shape=(64, 64))
+    y = nd.dot(x, x)
+    ndmod.waitall()  # must not raise; acts as a device barrier
+    assert np.isfinite(y.asnumpy()).all()

@@ -231,3 +231,19 @@ def test_full_checkpoint_roundtrip_reference_format(tmp_path):
     wargs["data"] = x
     want = n.bind(args=wargs).forward()[0].asnumpy()
     np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_auto_format_preserves_unrepresentable_payloads(tmp_path):
+    """bf16 and 0-d payloads must not be silently widened/dropped by
+    the .params container default — they keep the lossless npz path."""
+    p = str(tmp_path / "w.params")
+    scalar = nd.array(np.float32(3.25)).reshape(())
+    nd_utils.save(p, {"s": scalar})
+    back = nd_utils.load(p)
+    assert back["s"].shape == ()
+    np.testing.assert_allclose(back["s"].asnumpy(), 3.25)
+
+    bf = nd.array(np.ones((2, 2), np.float32)).astype("bfloat16")
+    nd_utils.save(p, {"w": bf})
+    back = nd_utils.load(p)
+    assert str(back["w"].dtype) == "bfloat16"
