@@ -248,7 +248,10 @@ class HybridBlock(Block):
     def _deferred_infer_shape(self, *args):
         from .. import symbol as sym_mod
 
-        data_syms = [sym_mod.Variable("__data%d" % i) for i in range(len(args))]
+        # carry the real input dtypes into the trace: a bf16 batch into
+        # a cast("bfloat16") net must not infer against f32 data vars
+        data_syms = [sym_mod.Variable("__data%d" % i, dtype=str(a.dtype))
+                     for i, a in enumerate(args)]
         out = self._symbolic_forward(*data_syms)
         shapes = {"__data%d" % i: a.shape for i, a in enumerate(args)}
         arg_shapes, _, aux_shapes = out.infer_shape_partial(**shapes)

@@ -346,3 +346,21 @@ def test_image_record_and_folder_datasets(tmp_path):
     assert len(fds) == 6
     img, label = fds[5]
     assert img.shape == (32, 32, 3) and label == 1
+
+
+def test_hybridize_bf16_cast_forward():
+    """cast('bfloat16') + hybridize + bf16 batch: the deferred-shape
+    trace must carry the input dtype (a f32 data var would fail conv
+    dtype checks against bf16 weights)."""
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.GlobalAvgPool2D(), nn.Flatten(),
+            nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    net.hybridize()
+    x = nd.random.uniform(shape=(2, 3, 16, 16)).astype("bfloat16")
+    out = net(x)
+    assert str(out.dtype) == "bfloat16"
+    assert out.shape == (2, 4)
+    assert np.isfinite(out.asnumpy().astype(np.float32)).all()
