@@ -36,3 +36,37 @@ concat = globals()["Concat"]
 stack = globals()["stack"]
 dot = globals()["dot"]
 batch_dot = globals()["batch_dot"]
+
+
+def _scalar_aware_binary(array_op, scalar_op, rscalar_op=None):
+    """The reference's free functions (nd.add/subtract/multiply/divide/
+    power) accept NDArray or python scalars on either side
+    (ref: python/mxnet/ndarray/ndarray.py add/divide module fns)."""
+    bcast = globals()[array_op]
+    sca = globals()[scalar_op]
+    rsca = globals()[rscalar_op] if rscalar_op else sca
+
+    def fn(lhs, rhs):
+        l_nd = isinstance(lhs, NDArray)
+        r_nd = isinstance(rhs, NDArray)
+        if l_nd and r_nd:
+            return bcast(lhs, rhs)
+        if l_nd:
+            return sca(lhs, scalar=float(rhs))
+        if r_nd:
+            return rsca(rhs, scalar=float(lhs))
+        raise TypeError("at least one operand must be an NDArray")
+
+    return fn
+
+
+add = _scalar_aware_binary("broadcast_add", "_plus_scalar")
+subtract = _scalar_aware_binary("broadcast_sub", "_minus_scalar",
+                                "_rminus_scalar")
+multiply = _scalar_aware_binary("broadcast_mul", "_mul_scalar")
+divide = _scalar_aware_binary("broadcast_div", "_div_scalar",
+                              "_rdiv_scalar")
+power = _scalar_aware_binary("broadcast_power", "_power_scalar",
+                             "_rpower_scalar")
+modulo = _scalar_aware_binary("broadcast_mod", "_mod_scalar",
+                              "_rmod_scalar")

@@ -162,31 +162,46 @@ def _custom_fn(*arrays, op_type: str, _training: bool = False, **kwargs):
     n_out = len(prop.list_outputs())
     in_shapes = [tuple(a.shape) for a in arrays]
     in_dtypes = [a.dtype for a in arrays]
-    ishapes, oshapes, _ = prop.infer_shape([list(s) for s in in_shapes])
-    itypes, otypes, _ = prop.infer_type(list(in_dtypes))
+    # the reference contract allows (in, out) or (in, out, aux) returns
+    # (python/mxnet/operator.py infer_shape_entry handles both)
+    inferred = prop.infer_shape([list(s) for s in in_shapes])
+    ishapes, oshapes = inferred[0], inferred[1]
+    inferred_t = prop.infer_type(list(in_dtypes))
+    otypes = inferred_t[1]
     result_spec = [jax.ShapeDtypeStruct(tuple(s), _np.dtype(t))
                    for s, t in zip(oshapes, otypes)]
 
     def host_forward(*np_in):
+        from .ndarray import array as _nd_array
+
         op = prop.create_operator(None, [list(a.shape) for a in np_in],
                                   [a.dtype for a in np_in])
-        in_data = [_np.asarray(a) for a in np_in]
-        out_data = [_np.zeros(tuple(s), dtype=_np.dtype(t))
+        # user forward/backward code receives NDArrays (the reference
+        # hands mx.nd arrays into CustomOp), not bare numpy
+        in_data = [_nd_array(_np.asarray(a)) for a in np_in]
+        out_data = [_nd_array(_np.zeros(tuple(s), dtype=_np.dtype(t)))
                     for s, t in zip(oshapes, otypes)]
         op.forward(is_train=is_train, req=["write"] * len(in_data),
                    in_data=in_data, out_data=out_data, aux=[])
-        return tuple(out_data)
+        return tuple(_np.asarray(o.asnumpy(), dtype=_np.dtype(t))
+                     for o, t in zip(out_data, otypes))
 
     def host_backward(*np_args):
-        grads = list(np_args[:n_out])
-        ins = list(np_args[n_out:n_out + len(arrays)])
-        outs = list(np_args[n_out + len(arrays):])
-        op = prop.create_operator(None, [list(a.shape) for a in ins],
-                                  [a.dtype for a in ins])
-        in_grad = [_np.zeros(a.shape, dtype=a.dtype) for a in ins]
+        from .ndarray import array as _nd_array
+
+        grads = [_nd_array(_np.asarray(g)) for g in np_args[:n_out]]
+        ins_np = list(np_args[n_out:n_out + len(arrays)])
+        outs = [_nd_array(_np.asarray(o))
+                for o in np_args[n_out + len(arrays):]]
+        op = prop.create_operator(None, [list(a.shape) for a in ins_np],
+                                  [a.dtype for a in ins_np])
+        ins = [_nd_array(_np.asarray(a)) for a in ins_np]
+        in_grad = [_nd_array(_np.zeros(a.shape, dtype=a.dtype))
+                   for a in ins_np]
         op.backward(req=["write"] * len(ins), out_grad=grads,
                     in_data=ins, out_data=outs, in_grad=in_grad, aux=[])
-        return tuple(in_grad)
+        return tuple(_np.asarray(g.asnumpy(), dtype=a.dtype)
+                     for g, a in zip(in_grad, ins_np))
 
     @jax.custom_vjp
     def call(*xs):

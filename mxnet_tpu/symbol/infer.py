@@ -131,7 +131,30 @@ def _rnn_rule(p, s):
     }
 
 
+def _custom_rule(p, s):
+    """A Custom op's prop declares every input's shape through its own
+    infer_shape (python/mxnet/operator.py infer_shape_entry) — the
+    reference back-propagates those to auto-created label variables."""
+    if "op_type" not in p:
+        return {}
+    from .. import operator as _operator
+
+    try:
+        prop = _operator._get_prop(
+            p["op_type"], _operator._freeze_kwargs(
+                {k: v for k, v in p.items() if k != "op_type"}))
+        n = len(prop.list_arguments())
+        in_shapes = [list(s.get("arg%d" % i)) if s.get("arg%d" % i)
+                     else None for i in range(n)]
+        inferred = prop.infer_shape(in_shapes)
+    except Exception:
+        return {}
+    return {"arg%d" % i: tuple(sh)
+            for i, sh in enumerate(inferred[0]) if sh is not None}
+
+
 PARAM_SHAPE_RULES = {
+    "Custom": _custom_rule,
     "FullyConnected": _fc_rule,
     "Convolution": _conv_rule,
     "Convolution_v1": _conv_rule,

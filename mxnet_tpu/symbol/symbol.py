@@ -364,7 +364,19 @@ def create(op_name: str, *args, name: Optional[str] = None, **kwargs) -> Symbol:
 
     consumed = 0
     input_names = op.input_names or tuple("arg%d" % i for i in range(len(pos_syms)))
-    if op.input_names:
+    custom_named = op_name == "Custom" and "op_type" in kwargs
+    if custom_named:
+        # a Custom op's inputs come from its prop's list_arguments —
+        # unfilled ones (labels) auto-create as "{name}_{arg}" variables
+        # exactly like built-in ops (ref: CustomOpProp + compose)
+        from .. import operator as _operator
+
+        prop = _operator._get_prop(
+            kwargs["op_type"], _operator._freeze_kwargs(
+                {k: v for k, v in kwargs.items()
+                 if k != "op_type" and not isinstance(v, Symbol)}))
+        input_names = tuple(prop.list_arguments())
+    if op.input_names or custom_named:
         for iname in input_names:
             if consumed < len(pos_syms):
                 sym_inputs.append(pos_syms[consumed]._entries[0])

@@ -151,3 +151,42 @@ def test_reference_gluon_image_classification_unmodified(tmp_path):
                 "--batch-size", "2", "--log-interval", "50"],
                cwd=str(tmp_path), timeout=1500)
     assert "validation: accuracy=" in log, log[-2000:]
+
+
+def test_reference_weighted_logistic_regression_unmodified(tmp_path):
+    """example/numpy-ops: the CustomOp bridge driven by the reference's
+    own script — symbol Custom with an auto-created label variable,
+    simple_bind, forward and exact backward."""
+    script = os.path.join(REFERENCE, "example", "numpy-ops",
+                          "weighted_logistic_regression.py")
+    log = _run(script, [], cwd=str(tmp_path))
+    assert "Weighted Logistic Regression gradients:" in log
+    # the weighted negative-class gradient is exactly 0.1x the plain one
+    assert "0.01462117" in log and "0.14621173" in log, log[-2000:]
+
+
+def test_reference_gluon_lr_manipulation_unmodified(tmp_path):
+    """example/gluon/learning_rate_manipulation.py: Trainer lr getters/
+    setters + NDArrayIter, converging to the synthetic ground truth."""
+    script = os.path.join(REFERENCE, "example", "gluon",
+                          "learning_rate_manipulation.py")
+    log = _run(script, [], cwd=str(tmp_path))
+    assert "Learning rate: 0.1" in log
+    assert "0.0729" in log  # 0.1 * 0.9^3 after per-epoch decay
+    # regression weights converge near (2, -3.4), bias near 4.2
+    assert "dense0_bias 4.1" in log or "dense0_bias 4.2" in log, \
+        log[-2000:]
+
+
+@pytest.mark.slow
+def test_reference_gluon_mnist_unmodified(tmp_path):
+    """example/gluon/mnist.py: gluon.data.vision.MNIST + DataLoader +
+    Trainer, byte-identical."""
+    data = tmp_path / "data"
+    data.mkdir()
+    _write_mnist(str(data))
+    script = os.path.join(REFERENCE, "example", "gluon", "mnist.py")
+    log = _run(script, ["--epochs", "1"], cwd=str(tmp_path))
+    assert "Validation: accuracy=" in log, log[-2000:]
+    acc = float(log.rsplit("Validation: accuracy=", 1)[1].split()[0])
+    assert acc > 0.9, log[-2000:]
