@@ -1,0 +1,371 @@
+#!/usr/bin/env python
+"""Single-file, dependency-light predictor — the amalgamation analogue.
+
+ref: amalgamation/ in the reference tree builds the whole predict path
+into one C file (mxnet_predict-all.cc) so models deploy where the full
+framework can't go.  The TPU framework's equivalent deployment unit is
+this ONE python file: stdlib + numpy only — no jax, no mxnet_tpu — able
+to load a checkpoint (symbol JSON + .params in either the dmlc
+container or npz form) and run inference for the common vision op set.
+
+    from mxnet_predict import Predictor
+    p = Predictor("model-symbol.json", "model-0001.params")
+    probs = p.forward(data=batch)          # {output_name: ndarray}
+
+Numerics match the framework's executor to float tolerance
+(tests/test_amalgamation.py pins this).
+"""
+from __future__ import annotations
+
+import ast
+import gzip
+import io
+import json
+import struct
+
+import numpy as np
+
+__all__ = ["Predictor", "load_params", "load_symbol"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint loading (formats: src/ndarray/ndarray.cc:860-1100 container,
+# or the framework's npz)
+# ---------------------------------------------------------------------------
+
+_LIST_MAGIC = 0x112
+_V1_MAGIC = 0xF993FAC8
+_V2_MAGIC = 0xF993FAC9
+_FLAG_DT = {0: np.float32, 1: np.float64, 2: np.float16, 3: np.uint8,
+            4: np.int32, 5: np.int8, 6: np.int64}
+
+
+def _r(f, n):
+    b = f.read(n)
+    if len(b) != n:
+        raise IOError("truncated container")
+    return b
+
+
+def _shape64(f):
+    (nd,) = struct.unpack("<I", _r(f, 4))
+    return struct.unpack("<%dq" % nd, _r(f, 8 * nd)) if nd else ()
+
+
+def _one_array(f):
+    (magic,) = struct.unpack("<I", _r(f, 4))
+    if magic == _V2_MAGIC:
+        (stype,) = struct.unpack("<i", _r(f, 4))
+        if stype != 0:
+            raise IOError("sparse arrays unsupported in the predictor")
+        shape = _shape64(f)
+        if not shape:
+            return None
+        _r(f, 8)  # context
+        (flag,) = struct.unpack("<i", _r(f, 4))
+        dt = _FLAG_DT[flag]
+        n = int(np.prod(shape))
+        return np.frombuffer(_r(f, n * np.dtype(dt).itemsize),
+                             dtype=dt).reshape(shape)
+    if magic == _V1_MAGIC:
+        shape = _shape64(f)
+    else:
+        nd = magic
+        shape = struct.unpack("<%dI" % nd, _r(f, 4 * nd)) if nd else ()
+    if not shape:
+        return None
+    _r(f, 8)
+    (flag,) = struct.unpack("<i", _r(f, 4))
+    dt = _FLAG_DT[flag]
+    n = int(np.prod(shape))
+    return np.frombuffer(_r(f, n * np.dtype(dt).itemsize),
+                         dtype=dt).reshape(shape)
+
+
+def load_params(path):
+    """-> dict name -> ndarray, 'arg:'/'aux:' prefixes stripped into
+    (args, auxs)."""
+    with open(path, "rb") as f:
+        head = f.read(8)
+        f.seek(0)
+        if len(head) == 8 and struct.unpack("<Q", head)[0] == _LIST_MAGIC:
+            _r(f, 16)
+            (count,) = struct.unpack("<Q", _r(f, 8))
+            arrays = [_one_array(f) for _ in range(count)]
+            (nname,) = struct.unpack("<Q", _r(f, 8))
+            names = []
+            for _ in range(nname):
+                (ln,) = struct.unpack("<Q", _r(f, 8))
+                names.append(_r(f, ln).decode())
+            named = dict(zip(names, arrays))
+        else:
+            with np.load(path, allow_pickle=False) as z:
+                named = {k: z[k] for k in z.keys()}
+    args, auxs = {}, {}
+    for k, v in named.items():
+        if k.startswith("arg:"):
+            args[k[4:]] = v
+        elif k.startswith("aux:"):
+            auxs[k[4:]] = v
+        else:
+            args[k] = v
+    return args, auxs
+
+
+def _parse(v):
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    if s in ("True", "true"):
+        return True
+    if s in ("False", "false"):
+        return False
+    try:
+        out = ast.literal_eval(s)
+        return tuple(out) if isinstance(out, list) else out
+    except (ValueError, SyntaxError):
+        return v
+
+
+def load_symbol(path_or_json):
+    """-> (nodes, heads) with typed attrs; accepts reference JSON."""
+    text = path_or_json
+    if not text.lstrip().startswith("{"):
+        with open(path_or_json) as f:
+            text = f.read()
+    g = json.loads(text)
+    nodes = []
+    for spec in g["nodes"]:
+        attrs = {}
+        for key in ("param", "attr", "attrs"):
+            if isinstance(spec.get(key), dict):
+                attrs.update(spec[key])
+        attrs = {k: (_parse(v) if not isinstance(v, dict)
+                     else _parse(v.get("py")))
+                 for k, v in attrs.items() if not k.startswith("__")}
+        nodes.append({"op": spec["op"], "name": spec["name"],
+                      "attrs": attrs,
+                      "inputs": [list(e) + [0] * (3 - len(e))
+                                 for e in spec.get("inputs", [])]})
+    heads = [list(e) + [0] * (3 - len(e)) for e in g["heads"]]
+    return nodes, heads
+
+
+# ---------------------------------------------------------------------------
+# numpy op kernels (inference semantics; shapes NCHW like the reference)
+# ---------------------------------------------------------------------------
+
+def _pad4(x, ph, pw):
+    if ph == 0 and pw == 0:
+        return x
+    return np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+
+
+def _im2col(x, kh, kw, sh, sw):
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    s = x.strides
+    view = np.lib.stride_tricks.as_strided(
+        x, (n, c, oh, ow, kh, kw),
+        (s[0], s[1], s[2] * sh, s[3] * sw, s[2], s[3]))
+    return view.reshape(n, c, oh * ow, kh * kw), oh, ow
+
+
+def conv(x, w, b, kernel, stride=(1, 1), pad=(0, 0), num_filter=0,
+         no_bias=False, num_group=1, **_):
+    kh, kw = kernel
+    x = _pad4(np.asarray(x, np.float32), *pad)
+    n, c, _, _ = x.shape
+    cols, oh, ow = _im2col(x, kh, kw, *stride)
+    cols = cols.transpose(0, 2, 1, 3).reshape(n, oh * ow, c * kh * kw)
+    if num_group == 1:
+        wmat = w.reshape(w.shape[0], -1)
+        out = cols @ wmat.T
+    else:
+        cg = c // num_group
+        fg = w.shape[0] // num_group
+        outs = []
+        for gi in range(num_group):
+            wg = w[gi * fg:(gi + 1) * fg].reshape(fg, -1)
+            colg = cols[:, :, gi * cg * kh * kw:(gi + 1) * cg * kh * kw]
+            outs.append(colg @ wg.T)
+        out = np.concatenate(outs, axis=2)
+    out = out.transpose(0, 2, 1).reshape(n, -1, oh, ow)
+    if not no_bias and b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+def pooling(x, kernel=(2, 2), stride=None, pad=(0, 0), pool_type="max",
+            global_pool=False, **_):
+    x = np.asarray(x, np.float32)
+    if global_pool:
+        return x.mean(axis=(2, 3), keepdims=True) if pool_type == "avg" \
+            else x.max(axis=(2, 3), keepdims=True)
+    stride = stride or kernel
+    if pool_type == "max":
+        x = np.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]),
+                       (pad[1], pad[1])), constant_values=-np.inf)
+    else:
+        x = _pad4(x, *pad)
+    cols, oh, ow = _im2col(x, kernel[0], kernel[1], *stride)
+    red = cols.max(axis=3) if pool_type == "max" else cols.mean(axis=3)
+    return red.reshape(x.shape[0], x.shape[1], oh, ow)
+
+
+def batchnorm(x, gamma, beta, mean, var, eps=1e-3, fix_gamma=True,
+              **_):
+    g = np.ones_like(gamma) if fix_gamma else gamma
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return ((x - mean.reshape(shape)) /
+            np.sqrt(var.reshape(shape) + eps)) * g.reshape(shape) + \
+        beta.reshape(shape)
+
+
+def fullyconnected(x, w, b, num_hidden=0, no_bias=False, flatten=True,
+                   **_):
+    if flatten:
+        x = x.reshape(x.shape[0], -1)
+    out = x @ w.T
+    if not no_bias and b is not None:
+        out = out + b
+    return out
+
+
+def activation(x, act_type="relu", **_):
+    if act_type == "relu":
+        return np.maximum(x, 0)
+    if act_type == "sigmoid":
+        return 1.0 / (1.0 + np.exp(-x))
+    if act_type == "tanh":
+        return np.tanh(x)
+    if act_type == "softrelu":
+        return np.log1p(np.exp(x))
+    raise ValueError("unsupported act_type %r" % act_type)
+
+
+def softmax(x, axis=-1, **_):
+    x = x - x.max(axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+_OPS = {
+    "Convolution": lambda ins, a: conv(ins[0], ins[1],
+                                       ins[2] if len(ins) > 2 else None,
+                                       **a),
+    "FullyConnected": lambda ins, a: fullyconnected(
+        ins[0], ins[1], ins[2] if len(ins) > 2 else None, **a),
+    "BatchNorm": lambda ins, a: batchnorm(*ins[:5], **a),
+    "Activation": lambda ins, a: activation(ins[0], **a),
+    "relu": lambda ins, a: np.maximum(ins[0], 0),
+    "Pooling": lambda ins, a: pooling(ins[0], **a),
+    "Flatten": lambda ins, a: ins[0].reshape(ins[0].shape[0], -1),
+    "flatten": lambda ins, a: ins[0].reshape(ins[0].shape[0], -1),
+    "Reshape": lambda ins, a: _reshape(ins[0], a),
+    "transpose": lambda ins, a: np.transpose(
+        ins[0], a.get("axes") or None),
+    "Dropout": lambda ins, a: ins[0],
+    "softmax": lambda ins, a: softmax(ins[0], a.get("axis", -1)),
+    "SoftmaxOutput": lambda ins, a: softmax(ins[0], -1),
+    "SoftmaxActivation": lambda ins, a: softmax(ins[0], -1),
+    "log_softmax": lambda ins, a: np.log(softmax(ins[0],
+                                                 a.get("axis", -1))),
+    "Concat": lambda ins, a: np.concatenate(ins, axis=a.get("dim", 1)),
+    "concat": lambda ins, a: np.concatenate(ins, axis=a.get("dim", 1)),
+    "elemwise_add": lambda ins, a: ins[0] + ins[1],
+    "_Plus": lambda ins, a: ins[0] + ins[1],
+    "broadcast_add": lambda ins, a: ins[0] + ins[1],
+    "elemwise_mul": lambda ins, a: ins[0] * ins[1],
+    "broadcast_mul": lambda ins, a: ins[0] * ins[1],
+    "_plus_scalar": lambda ins, a: ins[0] + a.get("scalar", 0.0),
+    "_mul_scalar": lambda ins, a: ins[0] * a.get("scalar", 1.0),
+    "mean": lambda ins, a: _reduce(np.mean, ins[0], a),
+    "sum": lambda ins, a: _reduce(np.sum, ins[0], a),
+    "LeakyReLU": lambda ins, a: _leaky(ins, a),
+    "clip": lambda ins, a: np.clip(ins[0], a.get("a_min"),
+                                   a.get("a_max")),
+    "identity": lambda ins, a: ins[0],
+    "BlockGrad": lambda ins, a: ins[0],
+}
+
+
+def _reshape(x, a):
+    shape = a.get("shape")
+    out = []
+    for i, d in enumerate(shape):
+        if d == 0:
+            out.append(x.shape[i])
+        elif d == -1:
+            out.append(-1)
+        else:
+            out.append(int(d))
+    return x.reshape(out)
+
+
+def _reduce(fn, x, a):
+    axis = a.get("axis")
+    keep = bool(a.get("keepdims", False))
+    return fn(x, axis=axis if axis is None else tuple(
+        [axis] if isinstance(axis, int) else axis), keepdims=keep)
+
+
+def _leaky(ins, a):
+    t = a.get("act_type", "leaky")
+    x = ins[0]
+    if t == "leaky":
+        return np.where(x > 0, x, a.get("slope", 0.25) * x)
+    if t == "prelu":
+        g = ins[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return np.where(x > 0, x, g * x)
+    raise ValueError("unsupported LeakyReLU %r" % t)
+
+
+class Predictor:
+    """Graph-walking numpy executor over a checkpoint (inference)."""
+
+    def __init__(self, symbol, params):
+        self.nodes, self.heads = load_symbol(symbol)
+        self.args, self.auxs = (params if isinstance(params, tuple)
+                                else load_params(params))
+
+    def forward(self, **inputs):
+        vals = [None] * len(self.nodes)
+        for i, nd_ in enumerate(self.nodes):
+            if nd_["op"] == "null":
+                name = nd_["name"]
+                if name in inputs:
+                    vals[i] = [np.asarray(inputs[name], np.float32)]
+                elif name in self.args:
+                    vals[i] = [np.asarray(self.args[name])]
+                elif name in self.auxs:
+                    vals[i] = [np.asarray(self.auxs[name])]
+                elif name.endswith("label"):
+                    vals[i] = [None]  # unused at inference
+                else:
+                    raise KeyError("no value for input %r" % name)
+                continue
+            op = _OPS.get(nd_["op"])
+            if op is None:
+                raise NotImplementedError(
+                    "op %r not in the amalgamated predictor" % nd_["op"])
+            ins = [vals[e[0]][e[1]] for e in nd_["inputs"]]
+            out = op(ins, nd_["attrs"])
+            vals[i] = list(out) if isinstance(out, tuple) else [out]
+        return [vals[e[0]][e[1]] for e in self.heads]
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("symbol")
+    ap.add_argument("params")
+    ap.add_argument("--shape", default="1,3,224,224")
+    args = ap.parse_args()
+    shape = tuple(int(s) for s in args.shape.split(","))
+    p = Predictor(args.symbol, args.params)
+    rng = np.random.RandomState(0)
+    out = p.forward(data=rng.uniform(size=shape).astype(np.float32))
+    print("outputs:", [o.shape for o in out])

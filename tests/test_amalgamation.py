@@ -1,0 +1,123 @@
+"""The single-file numpy predictor (amalgamation analogue) matches the
+framework's executor on real checkpoints.
+
+ref: amalgamation/ in the reference tree — the deployment unit that
+runs the predict path without the framework.  Here: a checkpoint
+written by mxnet_tpu loads in amalgamation/mxnet_predict.py (stdlib +
+numpy only) and produces the same logits."""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_PRED = os.path.join(ROOT, "amalgamation", "mxnet_predict.py")
+
+
+def _load_predictor_module():
+    spec = importlib.util.spec_from_file_location("mxnet_predict", _PRED)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def _lenet():
+    d = mx.sym.Variable("data")
+    n = mx.sym.Convolution(d, kernel=(5, 5), num_filter=6, name="c1")
+    n = mx.sym.Activation(n, act_type="tanh", name="a1")
+    n = mx.sym.Pooling(n, kernel=(2, 2), stride=(2, 2),
+                       pool_type="max", name="p1")
+    n = mx.sym.Convolution(n, kernel=(3, 3), num_filter=12, name="c2")
+    n = mx.sym.BatchNorm(n, name="bn1")
+    n = mx.sym.Activation(n, act_type="relu", name="a2")
+    n = mx.sym.Pooling(n, kernel=(2, 2), stride=(2, 2),
+                       pool_type="avg", name="p2")
+    n = mx.sym.Flatten(n, name="fl")
+    n = mx.sym.FullyConnected(n, num_hidden=24, name="f1")
+    n = mx.sym.Activation(n, act_type="relu", name="a3")
+    n = mx.sym.FullyConnected(n, num_hidden=10, name="f2")
+    return mx.sym.softmax(n, name="out")
+
+
+def test_predictor_matches_executor(tmp_path):
+    sym = _lenet()
+    ex = sym.simple_bind(data=(2, 1, 20, 20), grad_req="null")
+    rng = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            arr[:] = rng.randn(*arr.shape).astype(np.float32) * 0.2
+    # give the BN aux states non-trivial values
+    for name, arr in ex.aux_dict.items():
+        arr[:] = np.abs(rng.randn(*arr.shape).astype(np.float32)) + 0.5
+
+    x = rng.randn(2, 1, 20, 20).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    want = ex.forward(is_train=False)[0].asnumpy()
+
+    prefix = str(tmp_path / "lenet")
+    args = {k: v for k, v in ex.arg_dict.items() if k != "data"}
+    mx.model.save_checkpoint(prefix, 1, sym, args, dict(ex.aux_dict))
+
+    mp = _load_predictor_module()
+    p = mp.Predictor(prefix + "-symbol.json", prefix + "-0001.params")
+    got = p.forward(data=x)[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_file_is_standalone(tmp_path):
+    """The file must run in an interpreter where mxnet_tpu and jax are
+    unimportable — that's the deployment contract."""
+    sym = _lenet()
+    ex = sym.simple_bind(data=(1, 1, 20, 20), grad_req="null")
+    rng = np.random.RandomState(1)
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            arr[:] = rng.randn(*arr.shape).astype(np.float32) * 0.2
+    prefix = str(tmp_path / "m")
+    args = {k: v for k, v in ex.arg_dict.items() if k != "data"}
+    mx.model.save_checkpoint(prefix, 1, sym, args, dict(ex.aux_dict))
+
+    code = (
+        "import sys\n"
+        # poison framework imports: standalone means standalone
+        "sys.modules['jax'] = None\n"
+        "sys.modules['mxnet_tpu'] = None\n"
+        "sys.path.insert(0, %r)\n"
+        "import numpy as np\n"
+        "from mxnet_predict import Predictor\n"
+        "p = Predictor(%r, %r)\n"
+        "out = p.forward(data=np.zeros((1, 1, 20, 20), np.float32))\n"
+        "assert out[0].shape == (1, 10)\n"
+        "assert abs(out[0].sum() - 1.0) < 1e-5\n"
+        "print('STANDALONE OK')\n"
+        % (os.path.dirname(_PRED), prefix + "-symbol.json",
+           prefix + "-0001.params"))
+    env = {k: v for k, v in os.environ.items()}
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "STANDALONE OK" in proc.stdout
+
+
+def test_zoo_model_export_to_predictor(tmp_path):
+    """gluon zoo model -> export() -> standalone predictor, logits
+    match (the full deployment round trip)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model("squeezenet1.1", classes=10)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.random.uniform(shape=(1, 3, 64, 64))
+    want = net(x).asnumpy()
+    net.export(str(tmp_path / "sq"), epoch=0)
+    mp = _load_predictor_module()
+    p = mp.Predictor(str(tmp_path / "sq-symbol.json"),
+                     str(tmp_path / "sq-0000.params"))
+    got = p.forward(data=x.asnumpy())[0]
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
