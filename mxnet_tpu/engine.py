@@ -3,9 +3,14 @@ bulk context manager).
 
 The reference's dependency engine batches small ops into bulk segments
 (MXNET_EXEC_BULK_EXEC_*, threaded_engine.h:386-458). Under XLA every
-jitted program is already one fused "bulk segment", so these knobs are
-accepted and recorded but change nothing — kept so reference tuning
-code runs unmodified.
+jitted program is already one fused "bulk segment", so per-op bulking
+is the compiler's job; these knobs are accepted and recorded so
+reference tuning code runs unmodified.  The step-level translation of
+bulk execution lives in ``FusedTrainStep.run_steps`` (parallel/dp.py):
+K optimizer steps inside one XLA program via ``lax.scan``, amortizing
+per-dispatch latency the way the reference amortizes per-op engine
+pushes; ``current_bulk_size()`` exposes the recorded setting for such
+bulk-capable runners.
 """
 from __future__ import annotations
 
@@ -33,3 +38,9 @@ def bulk(size: int):
         yield
     finally:
         set_bulk_size(prev)
+
+
+def current_bulk_size() -> int:
+    """The configured bulk segment size (consumed by bulk-capable
+    runners like FusedTrainStep.run_steps)."""
+    return _bulk_size

@@ -70,3 +70,17 @@ power = _scalar_aware_binary("broadcast_power", "_power_scalar",
                              "_rpower_scalar")
 modulo = _scalar_aware_binary("broadcast_mod", "_mod_scalar",
                               "_rmod_scalar")
+
+
+# reference names reachable at the nd namespace (ref: cast_storage.cc,
+# sparse_retain.cc; _grad_add is the gradient-accumulation elemwise add)
+from .sparse import cast_storage  # noqa: E402
+
+
+def _sparse_retain(data, indices):
+    """ref: src/operator/tensor/sparse_retain.cc — keep only the listed
+    rows of a row_sparse array."""
+    return data.retain(indices)
+
+
+_grad_add = globals()["elemwise_add"]
