@@ -26,7 +26,7 @@ for _sub in (
     "gluon.model_zoo", "gluon.model_zoo.vision", "gluon.data",
     "gluon.loss", "gluon.utils", "autograd", "random", "test_utils",
     "context", "executor", "rnn", "contrib", "profiler",
-    "visualization", "engine", "attribute",
+    "visualization", "engine", "attribute", "dist", "operator",
 ):
     try:
         importlib.import_module("mxnet_tpu." + _sub)
