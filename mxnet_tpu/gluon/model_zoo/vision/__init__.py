@@ -7,6 +7,7 @@ from .densenet import *
 from .mobilenet import *
 from .inception import *
 from .inception_bn import *
+from .resnext import *
 
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
@@ -24,6 +25,9 @@ _models = {
     "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
     "inceptionv3": inception_v3,
     "inception_bn": inception_bn, "inception-bn": inception_bn,
+    "resnext50_32x4d": resnext50_32x4d,
+    "resnext101_32x4d": resnext101_32x4d,
+    "resnext101_64x4d": resnext101_64x4d,
     "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
     "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,

@@ -1,0 +1,98 @@
+"""ResNeXt (Xie et al. 2016, "Aggregated Residual Transformations").
+
+The reference ships ResNeXt in its pretrained zoo
+(imagenet1k-resnext-101-64x4d in the BASELINE accuracy table; symbol
+builder at example/image-classification/symbols/resnext.py).  Built
+here as a gluon HybridBlock from the paper's block table: each
+bottleneck's middle 3x3 is a grouped convolution with ``cardinality``
+groups of ``bottleneck_width`` channels.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["ResNext", "resnext50_32x4d", "resnext101_32x4d",
+           "resnext101_64x4d"]
+
+
+class _Block(HybridBlock):
+    def __init__(self, channels, cardinality, bottleneck_width, stride,
+                 downsample=False, **kwargs):
+        super().__init__(**kwargs)
+        D = int(channels * bottleneck_width / 64) * cardinality // 4
+        with self.name_scope():
+            body = nn.HybridSequential(prefix="")
+            body.add(nn.Conv2D(D, kernel_size=1, use_bias=False))
+            body.add(nn.BatchNorm())
+            body.add(nn.Activation("relu"))
+            body.add(nn.Conv2D(D, kernel_size=3, strides=stride,
+                               padding=1, groups=cardinality,
+                               use_bias=False))
+            body.add(nn.BatchNorm())
+            body.add(nn.Activation("relu"))
+            body.add(nn.Conv2D(channels, kernel_size=1, use_bias=False))
+            body.add(nn.BatchNorm())
+            self.body = body
+            if downsample:
+                ds = nn.HybridSequential(prefix="")
+                ds.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
+                                 use_bias=False))
+                ds.add(nn.BatchNorm())
+                self.downsample = ds
+            else:
+                self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x if self.downsample is None else self.downsample(x)
+        return F.Activation(self.body(x) + residual, act_type="relu")
+
+
+class ResNext(HybridBlock):
+    """Input (N, 3, 224, 224) -> (N, classes)."""
+
+    def __init__(self, layers, cardinality=32, bottleneck_width=4,
+                 classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            f = nn.HybridSequential(prefix="")
+            f.add(nn.Conv2D(64, kernel_size=7, strides=2, padding=3,
+                            use_bias=False))
+            f.add(nn.BatchNorm())
+            f.add(nn.Activation("relu"))
+            f.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            channels = 256
+            for i, n_blocks in enumerate(layers):
+                stride = 1 if i == 0 else 2
+                f.add(_Block(channels, cardinality, bottleneck_width,
+                             stride, downsample=True))
+                for _ in range(n_blocks - 1):
+                    f.add(_Block(channels, cardinality,
+                                 bottleneck_width, 1))
+                channels *= 2
+            f.add(nn.GlobalAvgPool2D())
+            f.add(nn.Flatten())
+            self.features = f
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def _make(layers, cardinality, width, **kwargs):
+    if kwargs.pop("pretrained", False):
+        raise RuntimeError("pretrained weights unavailable (zero-egress build)")
+    kwargs.pop("ctx", None)
+    return ResNext(layers, cardinality, width, **kwargs)
+
+
+def resnext50_32x4d(**kwargs):
+    return _make([3, 4, 6, 3], 32, 4, **kwargs)
+
+
+def resnext101_32x4d(**kwargs):
+    return _make([3, 4, 23, 3], 32, 4, **kwargs)
+
+
+def resnext101_64x4d(**kwargs):
+    return _make([3, 4, 23, 3], 64, 4, **kwargs)

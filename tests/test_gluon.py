@@ -276,7 +276,9 @@ def test_model_zoo_families():
     for name, shape in [("resnet18_v1", (1, 3, 32, 32)),
                         ("resnet18_v2", (1, 3, 32, 32)),
                         ("mobilenet0.25", (1, 3, 32, 32)),
-                        ("squeezenet1.1", (1, 3, 64, 64))]:
+                        ("squeezenet1.1", (1, 3, 64, 64)),
+                        ("inception_bn", (1, 3, 64, 64)),
+                        ("resnext50_32x4d", (1, 3, 64, 64))]:
         net = vision.get_model(name, classes=10)
         net.initialize(mx.init.Xavier())
         out = net(nd.random.uniform(shape=shape))
