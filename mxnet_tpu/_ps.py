@@ -63,9 +63,24 @@ def heartbeat_interval() -> float:
 
 def bind_host() -> str:
     """The interface servers/scheduler listen on: loopback for loopback
-    clusters, all interfaces only when the cluster spans hosts."""
+    clusters, all interfaces only when the cluster spans hosts.
+
+    Listening beyond loopback without frame authentication would hand
+    pickle.loads to any peer that can reach the port, so a multi-host
+    bind REQUIRES ``MXNET_PS_SECRET`` — the secure configuration is the
+    default, not opt-in."""
     root = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-    return "127.0.0.1" if root in ("127.0.0.1", "localhost") else "0.0.0.0"
+    if root in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    if _secret() is None:
+        raise RuntimeError(
+            "refusing to listen on a non-loopback interface "
+            "(DMLC_PS_ROOT_URI=%s) without MXNET_PS_SECRET: frames are "
+            "pickled, and unauthenticated pickle from the network is "
+            "arbitrary code execution.  Generate a shared secret (e.g. "
+            "`openssl rand -hex 16`) and export MXNET_PS_SECRET with "
+            "the same value on every node before launching." % root)
+    return "0.0.0.0"
 
 
 def send_msg(sock: socket.socket, obj: Any) -> None:
@@ -286,7 +301,8 @@ class Client:
             if self.broken:
                 raise ConnectionError(
                     "connection to %s:%d was aborted after an earlier "
-                    "timeout" % self.addr)
+                    "failure (timeout, authentication rejection, or "
+                    "interrupted exchange)" % self.addr)
             try:
                 self.sock.settimeout(t)
                 send_msg(self.sock, msg)
@@ -295,16 +311,23 @@ class Client:
                 # the peer's late response would desync request/response
                 # pairing — this connection is unusable from here on
                 self.broken = True
-                try:
-                    self.sock.close()
-                except OSError:
-                    pass
                 raise ConnectionError(
                     "no response from %s:%d within %.0fs for %r (peer "
                     "dead or hung)" % (self.addr[0], self.addr[1], t,
                                        msg.get("op")))
+            except BaseException:
+                # ANY mid-exchange failure (HMAC rejection, partial
+                # write, interrupt) leaves the stream position unknown:
+                # a later request could pair with this exchange's reply
+                self.broken = True
+                raise
             finally:
-                if not self.broken:
+                if self.broken:
+                    try:
+                        self.sock.close()
+                    except OSError:
+                        pass
+                else:
                     try:
                         self.sock.settimeout(None)
                     except OSError:

@@ -239,7 +239,10 @@ class DataLoader:
 
         ctx = mp.get_context("spawn")  # fork is unsafe under JAX
         task_q = ctx.SimpleQueue()
-        result_q = ctx.SimpleQueue()
+        # a real Queue (not SimpleQueue): get(timeout=) lets the consumer
+        # interleave worker-liveness checks — a segfaulted/OOM-killed
+        # worker must raise, not hang the training process
+        result_q = ctx.Queue()
         procs = [ctx.Process(target=_worker_main,
                              args=(dataset_pkl, batchify_pkl, task_q,
                                    result_q),
@@ -250,8 +253,23 @@ class DataLoader:
         self._pool = (procs, task_q, result_q)
         import weakref
 
-        weakref.finalize(self, _shutdown_pool, procs, task_q)
+        self._finalizer = weakref.finalize(self, _shutdown_pool, procs,
+                                           task_q)
         return self._pool
+
+    def _teardown_pool(self):
+        """Discard a pool with dead workers: the next iteration respawns
+        a fresh one instead of nondeterministically reusing survivors."""
+        if not self._pool:
+            return
+        procs, _, _ = self._pool
+        fin = getattr(self, "_finalizer", None)
+        if fin is not None:
+            fin.detach()
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        self._pool = None
 
     def _process_iter(self, pool):
         from multiprocessing import shared_memory as shm_mod
@@ -275,7 +293,21 @@ class DataLoader:
                                 list(batches[submitted])))
                     submitted += 1
                 while delivered not in results:
-                    r_epoch, jid, status, payload = result_q.get()
+                    try:
+                        r_epoch, jid, status, payload = \
+                            result_q.get(timeout=2.0)
+                    except _queue.Empty:
+                        # in-band "err" covers Python exceptions only;
+                        # a worker killed by the OS reports nothing
+                        dead = [p for p in procs if not p.is_alive()]
+                        if dead:
+                            codes = [p.exitcode for p in dead]
+                            self._teardown_pool()
+                            raise RuntimeError(
+                                "DataLoader worker(s) exited "
+                                "unexpectedly (exitcodes %s) — likely "
+                                "killed (segfault/OOM)" % codes)
+                        continue
                     if r_epoch != epoch:
                         if status == "ok":
                             _discard(payload, shm_mod)

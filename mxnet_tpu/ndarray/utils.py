@@ -58,9 +58,11 @@ def save(fname: str,
         # silently widened and 0-d arrays cannot be expressed, so those
         # keep the lossless npz path
         arrays = data.values() if isinstance(data, dict) else data
+        # dtype attribute, not asnumpy(): the check must not transfer
+        # the whole parameter set device→host a second time
         representable = all(
             len(v.shape) > 0 and
-            _np.dtype(v.asnumpy().dtype) in _DTYPE_TO_FLAG
+            _np.dtype(v.dtype) in _DTYPE_TO_FLAG
             for v in arrays)
         format = "dmlc" if fname.endswith(".params") and representable \
             else "npz"

@@ -167,9 +167,14 @@ def upgrade_json(data: Dict[str, Any]) -> Dict[str, Any]:
                 if k in _BACKEND_ONLY or \
                         (accepted is not None and k not in accepted):
                     if k not in _BACKEND_ONLY:
-                        logging.getLogger(__name__).debug(
+                        # loud: a semantic parameter the op body doesn't
+                        # take would otherwise be silently ignored and
+                        # produce wrong numerics, not an error
+                        logging.getLogger(__name__).warning(
                             "legacy load: dropping param %s=%r of %s "
-                            "(not used by the TPU op)", k, attrs[k], op)
+                            "(not accepted by the TPU op body — verify "
+                            "the loaded model does not rely on it)",
+                            k, attrs[k], op)
                     attrs.pop(k)
 
         # --- 0.9.4 -> 0.9.5: argmin/argmax axis=-1 meant "flatten" ---

@@ -136,7 +136,7 @@ def launch_jax(num_processes: int, cmd, env=None, hosts=None,
                 "%s=%s" % (k, _shquote(e[k]))
                 for k in ("MXNET_COORDINATOR_ADDRESS",
                           "MXNET_NUM_PROCESSES", "MXNET_PROCESS_ID",
-                          "PYTHONPATH") if k in e)
+                          "MXNET_PS_SECRET", "PYTHONPATH") if k in e)
             remote = "cd %s && env %s %s" % (
                 _shquote(os.getcwd()), exports,
                 " ".join(_shquote(c) for c in cmd))
