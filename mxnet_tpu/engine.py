@@ -14,20 +14,38 @@ bulk-capable runners.
 """
 from __future__ import annotations
 
+import os
 from contextlib import contextmanager
 
 __all__ = ["set_bulk_size", "bulk"]
 
 _bulk_size = 15  # the reference default
+# Step-level bulking in Module.fit only activates on an EXPLICIT opt-in
+# (set_bulk_size call or MXNET_MODULE_BULK_SIZE env): it quantizes
+# lr-scheduler updates to K batches and skips grad_dict materialization,
+# which existing per-batch scripts must not inherit silently.
+_bulk_explicit = False
+if os.environ.get("MXNET_MODULE_BULK_SIZE"):
+    _bulk_size = int(os.environ["MXNET_MODULE_BULK_SIZE"])
+    _bulk_explicit = True
 
 
 def set_bulk_size(size: int) -> int:
     """Set the bulk-execution segment limit; returns the previous value
-    (ref: engine.py:26). No-op on XLA — fusion is the compiler's job."""
-    global _bulk_size
+    (ref: engine.py:26).  Per-op fusion is XLA's job; the value is
+    consumed at STEP granularity by Module.fit (K steps per dispatch,
+    module/bulk.py) once this has been called."""
+    global _bulk_size, _bulk_explicit
     prev = _bulk_size
     _bulk_size = int(size)
+    _bulk_explicit = True
     return prev
+
+
+def fit_bulk_size() -> int:
+    """K for Module.fit's bulk path: 1 (per-batch) unless the user
+    explicitly opted in via set_bulk_size / MXNET_MODULE_BULK_SIZE."""
+    return _bulk_size if _bulk_explicit else 1
 
 
 @contextmanager

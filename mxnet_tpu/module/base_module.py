@@ -181,34 +181,75 @@ class BaseModule:
             validation_metric = eval_metric
         eval_metric = _as_metric(eval_metric)
 
+        # bulk fit: an explicit engine.set_bulk_size(K) groups K batches
+        # into one compiled dispatch when the module supports it (Module
+        # does; a monitor forces per-batch so its taps see every step).
+        # ref: the engine's bulk segments, MXNET_EXEC_BULK_EXEC_TRAIN
+        # (threaded_engine.h:386-458) — here the segment is K whole steps.
+        from .. import engine as _engine
+
+        bulk_k = max(1, _engine.fit_bulk_size()) if monitor is None else 1
+        can_bulk = bulk_k > 1 and hasattr(self, "_bulk_fit_steps")
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                          eval_metric=eval_metric,
-                                          locals=locals())
-                    for cb in _as_list(batch_end_callback):
-                        cb(param)
-                nbatch += 1
+
+            if can_bulk:
+                pending = []
+                end = False
+                while not end:
+                    batch = None
+                    try:
+                        batch = next(data_iter)
+                    except StopIteration:
+                        end = True
+                    if batch is not None:
+                        pending.append(batch)
+                    if not pending or (len(pending) < bulk_k and not end):
+                        continue
+                    group, pending = pending, []
+                    outs = self._bulk_fit_steps(group) if can_bulk else None
+                    if outs is None:
+                        can_bulk = False  # permanent per-batch fallback
+                        for b in group:
+                            self.forward_backward(b)
+                            self.update()
+                            self.update_metric(eval_metric, b.label)
+                            nbatch = self._fit_batch_end(
+                                epoch, nbatch, eval_metric,
+                                batch_end_callback)
+                        continue
+                    for b, outs_b in zip(group, outs):
+                        eval_metric.update(b.label, outs_b)
+                        nbatch = self._fit_batch_end(
+                            epoch, nbatch, eval_metric, batch_end_callback)
+            else:
+                end_of_batch = False
+                next_data_batch = next(data_iter)
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    try:
+                        next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch)
+                    except StopIteration:
+                        end_of_batch = True
+                    self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                              eval_metric=eval_metric,
+                                              locals=locals())
+                        for cb in _as_list(batch_end_callback):
+                            cb(param)
+                    nbatch += 1
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -232,6 +273,17 @@ class BaseModule:
             train_data.reset()
 
     # ------------------------------------------------------------------
+    def _fit_batch_end(self, epoch, nbatch, eval_metric,
+                       batch_end_callback):
+        """Fire per-batch callbacks (shared by the bulk and fallback fit
+        paths); returns the incremented batch counter."""
+        if batch_end_callback is not None:
+            param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                  eval_metric=eval_metric, locals=locals())
+            for cb in _as_list(batch_end_callback):
+                cb(param)
+        return nbatch + 1
+
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass
 

@@ -79,6 +79,7 @@ class Module(BaseModule):
         self._dp = None  # data-parallel runner (parallel/dp.py) when #ctx > 1
         self._preloaded_params = None  # set by Module.load
         self._preloaded_states = None
+        self._bulk_loop = None  # K-steps-per-dispatch fit path (bulk.py)
 
     # ------------------------------------------------------------------
     @property
@@ -328,6 +329,19 @@ class Module(BaseModule):
                 if grad is None:
                     continue
                 self._updater(i, grad, self._exec.arg_dict[name])
+
+    def _bulk_fit_steps(self, batches):
+        """K train steps in one compiled dispatch (engine.set_bulk_size
+        consumed by fit; the reference's bulk-exec segments,
+        threaded_engine.h:386-458).  Returns per-batch outputs, or None
+        to signal the standard per-batch path."""
+        if self._dp is not None:
+            return None  # multi-context DP re-places cells per batch
+        if self._bulk_loop is None:
+            from .bulk import BulkTrainLoop
+
+            self._bulk_loop = BulkTrainLoop(self)
+        return self._bulk_loop.run(batches)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded

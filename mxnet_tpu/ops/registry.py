@@ -114,7 +114,15 @@ class Op:
     # by input shape/dtype automatically once we pin the static params.
     # ------------------------------------------------------------------
     def bound(self, **params) -> Callable:
-        return _bind_cached(self, _freeze(params))
+        try:
+            return _bind_cached(self, _freeze(params))
+        except TypeError:
+            # a param is a tracer (e.g. a scan-carried learning rate in
+            # the bulk fit program): unhashable, so no cache and no
+            # nested jit — the caller is already inside a trace where
+            # the "param" is really an operand
+            return functools.partial(
+                self.fn, **{k: coerce_attr(v) for k, v in params.items()})
 
     def __call__(self, *arrays, **params):
         return self.fn(*arrays, **params)

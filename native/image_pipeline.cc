@@ -122,13 +122,15 @@ struct ImageIterCfg {
   int shuffle, rand_crop, rand_mirror;
   float mean[3], std[3];
   int nthreads, seed, label_width;
-  int resize_shorter;  // 0 = force resize to (w,h) directly
+  int resize_shorter;  // 0 = crop when source >= target, else resize
   int round_batch;
+  int out_u8;  // emit raw uint8 CHW (normalization deferred to device)
 };
 
 struct BatchBuf {
-  std::vector<float> data;   // batch*c*h*w
-  std::vector<float> label;  // batch*label_width
+  std::vector<float> data;      // batch*c*h*w (float path)
+  std::vector<uint8_t> data_u8; // batch*c*h*w (uint8 path)
+  std::vector<float> label;     // batch*label_width
   int filled = 0;
   bool ready = false;
 };
@@ -384,10 +386,31 @@ struct ImageIter {
     std::mt19937 rng(uint32_t(cfg.seed) ^ (uint32_t(epoch) << 20) ^
                      uint32_t(order[item]));
 
-    // resize / crop to (h, w)
+    // geometry to (h, w).  When no shorter-side resize is requested and
+    // the source is at least target-sized, CROP directly from the
+    // decoded pixels (random or center) — this is both the reference
+    // augmenter's semantic (rand_crop crops, it does not squash) and
+    // ~10x cheaper than the bilinear resample it replaces: the resample
+    // is only paid when the geometry actually requires one.
     int tw = cfg.w, th = cfg.h;
     const unsigned char *plane = src;
-    if (sw != tw || sh != th) {
+    if (cfg.resize_shorter == 0 && sw >= tw && sh >= th && src_ch == 3) {
+      if (sw != tw || sh != th) {
+        int x0, y0;
+        if (cfg.rand_crop) {
+          x0 = sw > tw ? int(rng() % uint32_t(sw - tw + 1)) : 0;
+          y0 = sh > th ? int(rng() % uint32_t(sh - th + 1)) : 0;
+        } else {
+          x0 = (sw - tw) / 2;
+          y0 = (sh - th) / 2;
+        }
+        cropped->resize(size_t(tw) * th * 3);
+        for (int y = 0; y < th; ++y)
+          memcpy(cropped->data() + size_t(y) * tw * 3,
+                 src + (size_t(y + y0) * sw + x0) * 3, size_t(tw) * 3);
+        plane = cropped->data();
+      }
+    } else if (sw != tw || sh != th) {
       int rw, rh;
       if (cfg.resize_shorter > 0) {
         // scale shorter side to resize_shorter, keep aspect
@@ -428,6 +451,41 @@ struct ImageIter {
 
     bool mirror = cfg.rand_mirror && (rng() & 1u);
 
+    if (cfg.out_u8) {
+      // HWC u8 → CHW u8, no float math: normalization happens on the
+      // accelerator where the cast fuses into the first conv (and the
+      // host->device transfer is 4x smaller than float32)
+      uint8_t *dst8 = bb.data_u8.data() + in_batch * size_t(cfg.c) * th * tw;
+      if (cfg.c == 1 && src_ch >= 3) {
+        // same BT.601 luma as the float path: dtype must never change
+        // what pixels a grayscale pipeline sees
+        for (int y = 0; y < th; ++y) {
+          for (int x = 0; x < tw; ++x) {
+            int sx = mirror ? tw - 1 - x : x;
+            const uint8_t *px = plane + (size_t(y) * tw + sx) * src_ch;
+            float luma = 0.299f * px[0] + 0.587f * px[1] + 0.114f * px[2];
+            dst8[size_t(y) * tw + x] = uint8_t(luma + 0.5f);
+          }
+        }
+        return true;
+      }
+      for (int ch = 0; ch < cfg.c; ++ch) {
+        int sc = std::min(ch, src_ch - 1);
+        for (int y = 0; y < th; ++y) {
+          const uint8_t *row = plane + size_t(y) * tw * src_ch;
+          uint8_t *orow = dst8 + (size_t(ch) * th + y) * tw;
+          if (mirror) {
+            for (int x = 0; x < tw; ++x)
+              orow[x] = row[size_t(tw - 1 - x) * src_ch + sc];
+          } else {
+            for (int x = 0; x < tw; ++x)
+              orow[x] = row[size_t(x) * src_ch + sc];
+          }
+        }
+      }
+      return true;
+    }
+
     // HWC u8 → CHW f32 normalized into the batch buffer
     float *dst = bb.data.data() + in_batch * size_t(cfg.c) * th * tw;
     if (cfg.c == 1 && src_ch >= 3) {
@@ -460,7 +518,7 @@ struct ImageIter {
   }
 
   /* returns 1 with pointers, 0 at epoch end, -1 error */
-  int Next(float **data, float **label, int *pad) {
+  int Next(void **data, float **label, int *pad) {
     std::unique_lock<std::mutex> l(mu);
     // release the buffer from the previous Next()
     if (handed_out >= 0) {
@@ -480,7 +538,8 @@ struct ImageIter {
       return -1;
     }
     handed_out = slot;
-    *data = buffers[slot].data.data();
+    *data = cfg.out_u8 ? static_cast<void *>(buffers[slot].data_u8.data())
+                       : static_cast<void *>(buffers[slot].data.data());
     *label = buffers[slot].label.data();
     *pad = (consumed + 1 == n_batches) ? int(last_pad) : 0;
     return 1;
@@ -501,20 +560,29 @@ typedef void *ImageIterHandle;
 
 const char *MXTPUImageIterGetLastError(void) { return g_iter_error.c_str(); }
 
-int MXTPUImageIterCreate(const char *rec_path, const char *idx_path,
-                         int batch, int c, int h, int w,
-                         int shuffle, int rand_crop, int rand_mirror,
-                         const float *mean, const float *std_, int nthreads,
-                         int seed, int label_width, int resize_shorter,
-                         int round_batch, int prefetch_buffers,
-                         ImageIterHandle *out) {
+int MXTPUImageIterCreateEx(const char *rec_path, const char *idx_path,
+                           int batch, int c, int h, int w,
+                           int shuffle, int rand_crop, int rand_mirror,
+                           const float *mean, const float *std_, int nthreads,
+                           int seed, int label_width, int resize_shorter,
+                           int round_batch, int prefetch_buffers,
+                           int out_u8, ImageIterHandle *out) {
+  if (out_u8) {
+    for (int i = 0; i < 3; ++i) {
+      if (mean[i] != 0.f || std_[i] != 1.f) {
+        g_iter_error = "uint8 output requires identity normalization "
+                       "(mean=0, std=1): normalize on the accelerator";
+        return -1;
+      }
+    }
+  }
   auto *it = new ImageIter();
   it->cfg = ImageIterCfg{batch,     c,         h,
                          w,         shuffle,   rand_crop,
                          rand_mirror, {mean[0], mean[1], mean[2]},
                          {std_[0], std_[1], std_[2]},
                          nthreads,  seed,      label_width,
-                         resize_shorter, round_batch};
+                         resize_shorter, round_batch, out_u8};
   it->rec_path = rec_path;
   it->idx_path = idx_path ? idx_path : "";
   if (!it->ScanOffsets()) {
@@ -525,12 +593,28 @@ int MXTPUImageIterCreate(const char *rec_path, const char *idx_path,
   it->n_buffers = std::max(2, prefetch_buffers);
   it->buffers.resize(it->n_buffers);
   for (auto &b : it->buffers) {
-    b.data.resize(size_t(batch) * c * h * w);
+    if (out_u8)
+      b.data_u8.resize(size_t(batch) * c * h * w);
+    else
+      b.data.resize(size_t(batch) * c * h * w);
     b.label.resize(size_t(batch) * label_width);
   }
   it->Start();
   *out = it;
   return 0;
+}
+
+int MXTPUImageIterCreate(const char *rec_path, const char *idx_path,
+                         int batch, int c, int h, int w,
+                         int shuffle, int rand_crop, int rand_mirror,
+                         const float *mean, const float *std_, int nthreads,
+                         int seed, int label_width, int resize_shorter,
+                         int round_batch, int prefetch_buffers,
+                         ImageIterHandle *out) {
+  return MXTPUImageIterCreateEx(rec_path, idx_path, batch, c, h, w, shuffle,
+                                rand_crop, rand_mirror, mean, std_, nthreads,
+                                seed, label_width, resize_shorter, round_batch,
+                                prefetch_buffers, /*out_u8=*/0, out);
 }
 
 int MXTPUImageIterNumRecords(ImageIterHandle h, size_t *n) {
@@ -540,6 +624,13 @@ int MXTPUImageIterNumRecords(ImageIterHandle h, size_t *n) {
 
 int MXTPUImageIterNext(ImageIterHandle h, float **data, float **label,
                        int *pad) {
+  return static_cast<ImageIter *>(h)->Next(
+      reinterpret_cast<void **>(data), label, pad);
+}
+
+/* like Next but typeless data pointer (uint8 pipelines) */
+int MXTPUImageIterNextEx(ImageIterHandle h, void **data, float **label,
+                         int *pad) {
   return static_cast<ImageIter *>(h)->Next(data, label, pad);
 }
 

@@ -1,0 +1,134 @@
+"""Bulk fit (K steps per dispatch) vs the per-batch path.
+
+The bulk loop (mxnet_tpu/module/bulk.py) must be an *invisible*
+optimization: same parameter trajectory, same metric values, same
+callback sequence as the reference per-batch fit
+(ref: python/mxnet/module/base_module.py:487-496; bulk segments
+src/engine/threaded_engine.h:386-458).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine
+
+
+def _mlp():
+    x = mx.sym.Variable("data")
+    x = mx.sym.FullyConnected(x, num_hidden=32, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.FullyConnected(x, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 4.0).astype(np.float32)
+    return X, y
+
+
+def _fit(bulk, optimizer="sgd", opt_params=(("learning_rate", 0.1),),
+         n=64, num_epoch=2, batch=8, callbacks=None):
+    X, y = _data(n)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp())
+    np.random.seed(7)
+    mx.random.seed(7)
+    prev = engine.set_bulk_size(bulk)
+    try:
+        mod.fit(it, num_epoch=num_epoch, optimizer=optimizer,
+                optimizer_params=opt_params,
+                initializer=mx.init.Xavier(rnd_type="gaussian",
+                                           magnitude=2.0),
+                batch_end_callback=callbacks)
+    finally:
+        engine.set_bulk_size(prev)
+    return mod.get_params()[0]
+
+
+@pytest.mark.parametrize("optimizer,params", [
+    ("sgd", (("learning_rate", 0.1), ("momentum", 0.9))),
+    ("adam", (("learning_rate", 0.01),)),
+])
+def test_bulk_matches_per_batch(optimizer, params):
+    ref = _fit(1, optimizer, params)
+    bulk = _fit(4, optimizer, params)
+    for k in ref:
+        np.testing.assert_allclose(bulk[k].asnumpy(), ref[k].asnumpy(),
+                                   rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_bulk_tail_group():
+    # 10 batches with K=4 -> groups of 4,4,2; trajectory must still match
+    ref = _fit(1, n=80)
+    bulk = _fit(4, n=80)
+    for k in ref:
+        np.testing.assert_allclose(bulk[k].asnumpy(), ref[k].asnumpy(),
+                                   rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_bulk_callback_sequence():
+    seen = []
+
+    def cb(param):
+        seen.append((param.epoch, param.nbatch))
+
+    _fit(4, callbacks=cb, n=64, num_epoch=2, batch=8)
+    assert seen == [(e, b) for e in range(2) for b in range(8)]
+
+
+def test_bulk_metric_matches():
+    accs = {}
+    for bulk in (1, 4):
+        X, y = _data(64)
+        it = mx.io.NDArrayIter(X, y, batch_size=8,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(_mlp())
+        np.random.seed(7)
+        mx.random.seed(7)
+        vals = []
+
+        def cb(param, _vals=vals):
+            _vals.append(param.eval_metric.get()[1])
+
+        prev = engine.set_bulk_size(bulk)
+        try:
+            mod.fit(it, num_epoch=1, optimizer="sgd",
+                    optimizer_params=(("learning_rate", 0.1),),
+                    initializer=mx.init.Xavier(), batch_end_callback=cb)
+        finally:
+            engine.set_bulk_size(prev)
+        accs[bulk] = vals
+    assert accs[1] == pytest.approx(accs[4], abs=1e-12)
+
+
+def test_bulk_lr_scheduler_quantized():
+    """An lr_scheduler still applies, at K-batch granularity."""
+    sched = mx.lr_scheduler.FactorScheduler(step=4, factor=0.5)
+    p = _fit(4, "sgd", (("learning_rate", 0.1),
+                        ("lr_scheduler", sched)), n=64)
+    assert all(np.isfinite(v.asnumpy()).all() for v in p.values())
+
+
+def test_bulk_dist_kvstore_falls_back():
+    """A dist kvstore must take the per-batch path, not silently change
+    aggregation semantics."""
+    from mxnet_tpu.module.bulk import BulkTrainLoop
+
+    X, y = _data(32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer()
+
+    class _FakeDist:
+        pass
+
+    loop = BulkTrainLoop(mod)
+    from mxnet_tpu import kvstore as kvmod
+
+    mod._kvstore = kvmod.KVStoreDist.__new__(kvmod.KVStoreDist)
+    assert loop.available() is False

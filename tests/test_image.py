@@ -291,3 +291,32 @@ def test_image_iter_roll_over(tmp_path):
     assert b.pad == 0  # 2 carried + 2 fresh
     labels = b.label[0].asnumpy()
     assert labels.shape[0] == 4
+
+
+def test_record_iter_u8_grayscale_luma_parity(tmp_path):
+    """dtype='uint8' must not change what pixels a grayscale pipeline
+    sees: both paths emit BT.601 luma (ref: grayscale imdecode,
+    src/io/iter_image_recordio_2.cc)."""
+    import numpy as np
+
+    from mxnet_tpu import io, recordio
+
+    rec = str(tmp_path / "g.rec")
+    idx = str(tmp_path / "g.idx")
+    rng = np.random.RandomState(3)
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(8):
+        img = rng.randint(0, 255, (32, 32, 3), dtype=np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=100))
+    w.close()
+
+    def batch(dtype):
+        it = io.ImageRecordIter(
+            path_imgrec=rec, path_imgidx=idx, data_shape=(1, 32, 32),
+            batch_size=8, shuffle=False, preprocess_threads=1, dtype=dtype)
+        return it.next().data[0].asnumpy()
+
+    f32 = batch("float32")
+    u8 = batch("uint8").astype(np.float32)
+    assert np.abs(f32 - u8).max() <= 1.0  # rounding only

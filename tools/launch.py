@@ -136,12 +136,22 @@ def launch_jax(num_processes: int, cmd, env=None, hosts=None,
                 "%s=%s" % (k, _shquote(e[k]))
                 for k in ("MXNET_COORDINATOR_ADDRESS",
                           "MXNET_NUM_PROCESSES", "MXNET_PROCESS_ID",
-                          "MXNET_PS_SECRET", "PYTHONPATH") if k in e)
-            remote = "cd %s && env %s %s" % (
-                _shquote(os.getcwd()), exports,
+                          "PYTHONPATH") if k in e)
+            # the PS shared secret rides stdin, NEVER the command line:
+            # /proc/<pid>/cmdline is world-readable on the remote host
+            secret = e.get("MXNET_PS_SECRET")
+            prefix = ("IFS= read -r MXNET_PS_SECRET && "
+                      "export MXNET_PS_SECRET && " if secret else "")
+            remote = "%scd %s && env %s %s" % (
+                prefix, _shquote(os.getcwd()), exports,
                 " ".join(_shquote(c) for c in cmd))
             argv = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
-            procs.append(subprocess.Popen(argv, env=base_env))
+            p = subprocess.Popen(argv, env=base_env,
+                                 stdin=subprocess.PIPE if secret else None)
+            if secret:
+                p.stdin.write((secret + "\n").encode())
+                p.stdin.close()
+            procs.append(p)
         else:
             procs.append(subprocess.Popen(list(cmd), env=e))
     return [p.wait() for p in procs]
