@@ -1,0 +1,20 @@
+/* ref: cpp-package/include/mxnet-cpp/MxNetCpp.h — the one include
+ * reference cpp examples use; pulls the whole frontend. */
+#ifndef MXNET_CPP_MXNETCPP_H_
+#define MXNET_CPP_MXNETCPP_H_
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/executor.h"
+#include "mxnet-cpp/initializer.h"
+#include "mxnet-cpp/io.h"
+#include "mxnet-cpp/lr_scheduler.h"
+#include "mxnet-cpp/metric.h"
+#include "mxnet-cpp/model.h"
+#include "mxnet-cpp/monitor.h"
+#include "mxnet-cpp/ndarray.h"
+#include "mxnet-cpp/op.h"
+#include "mxnet-cpp/optimizer.h"
+#include "mxnet-cpp/shape.h"
+#include "mxnet-cpp/symbol.h"
+
+#endif  // MXNET_CPP_MXNETCPP_H_

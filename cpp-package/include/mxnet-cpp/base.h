@@ -1,0 +1,58 @@
+/* mxnet-cpp compat frontend over the TPU build's C ABI.
+ *
+ * ref: cpp-package/include/mxnet-cpp/base.h — same namespace + core
+ * types so reference cpp-package examples COMPILE BYTE-IDENTICAL (the
+ * C++ analogue of the compat/mxnet python shim).  A fresh
+ * implementation over include/mxnet_tpu/c_api.h: shared_ptr-owned
+ * handles, exceptions carrying MXGetLastError.
+ */
+#ifndef MXNET_CPP_BASE_H_
+#define MXNET_CPP_BASE_H_
+
+#include <cstdint>
+#include <iostream>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu/c_api.h"
+
+namespace mxnet {
+namespace cpp {
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+
+inline void Check(int rc, const char *where) {
+  if (rc != 0)
+    throw std::runtime_error(std::string(where) + ": " + MXGetLastError());
+}
+#define MXCPP_CHECK(call) ::mxnet::cpp::Check((call), #call)
+
+enum DeviceType { kCPU = 1, kGPU = 2, kCPUPinned = 3 };
+
+class Context {
+ public:
+  Context(const DeviceType &type, int id) : type_(type), id_(id) {}
+  DeviceType GetDeviceType() const { return type_; }
+  int GetDeviceId() const { return id_; }
+  static Context cpu(int device_id = 0) { return Context(kCPU, device_id); }
+  static Context gpu(int device_id = 0) { return Context(kGPU, device_id); }
+
+ private:
+  DeviceType type_;
+  int id_;
+};
+
+/* dmlc LOG(INFO)-style stream: one line per statement */
+struct LogBlob {
+  std::ostringstream ss;
+  ~LogBlob() { std::cout << ss.str() << std::endl; }
+};
+#define LG ::mxnet::cpp::LogBlob().ss
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_BASE_H_

@@ -1,0 +1,119 @@
+/* ref: cpp-package/include/mxnet-cpp/executor.h(pp). */
+#ifndef MXNET_CPP_EXECUTOR_H_
+#define MXNET_CPP_EXECUTOR_H_
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/ndarray.h"
+#include "mxnet-cpp/symbol.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Executor {
+ public:
+  Executor(void *handle, std::vector<NDArray> args,
+           std::vector<NDArray> grads, std::vector<NDArray> auxs)
+      : arg_arrays(std::move(args)), grad_arrays(std::move(grads)),
+        aux_arrays(std::move(auxs)),
+        h_(handle, [](void *p) {
+          if (p) MXExecutorFree(p);
+        }) {
+    RefreshOutputs();
+  }
+
+  void Forward(bool is_train) {
+    MXCPP_CHECK(MXExecutorForward(h_.get(), is_train));
+    RefreshOutputs();
+  }
+  void Backward(const std::vector<NDArray> &head_grads = {}) {
+    std::vector<void *> hs;
+    for (auto &g : head_grads) hs.push_back(g.GetHandle());
+    MXCPP_CHECK(MXExecutorBackwardEx(
+        h_.get(), static_cast<mx_uint>(hs.size()),
+        hs.empty() ? nullptr : hs.data(), 1));
+  }
+
+  std::vector<NDArray> arg_arrays;
+  std::vector<NDArray> grad_arrays;
+  std::vector<NDArray> aux_arrays;
+  std::vector<NDArray> outputs;
+
+ private:
+  void RefreshOutputs() {
+    mx_uint n = 0;
+    NDArrayHandle *arr = nullptr;
+    MXCPP_CHECK(MXExecutorOutputs(h_.get(), &n, &arr));
+    outputs.clear();
+    for (mx_uint i = 0; i < n; ++i) outputs.push_back(NDArray(arr[i]));
+  }
+  std::shared_ptr<void> h_;
+};
+
+inline Executor *Symbol::SimpleBind(
+    const Context &ctx, const std::map<std::string, NDArray> &args_map) {
+  /* reference cpp SimpleBind binds the CALLER's arrays (writes into
+   * args_map feed the executor), so this routes through BindEX with
+   * grads allocated per argument */
+  auto names = ListArguments();
+  std::map<std::string, NDArray> full(args_map);
+  InferArgsMap(ctx, &full, args_map);
+  std::vector<void *> args, grads;
+  std::vector<mx_uint> reqs;
+  std::vector<NDArray> arg_vec, grad_vec;
+  for (auto &n : names) {
+    NDArray &a = full.at(n);
+    NDArray g(a.GetShape(), ctx);
+    arg_vec.push_back(a);
+    grad_vec.push_back(g);
+    args.push_back(a.GetHandle());
+    grads.push_back(g.GetHandle());
+    reqs.push_back(1); /* write */
+  }
+  /* aux states from shape inference */
+  std::vector<NDArray> aux_vec;
+  std::vector<void *> auxs;
+  {
+    auto aux_names = ListAuxiliaryStates();
+    if (!aux_names.empty()) {
+      /* re-run infer for aux shapes */
+      std::vector<const char *> keys;
+      std::vector<mx_uint> ind = {0}, data;
+      for (auto &kv : full) {
+        keys.push_back(kv.first.c_str());
+        Shape s = kv.second.GetShape();
+        for (mx_uint d = 0; d < s.ndim(); ++d) data.push_back(s[d]);
+        ind.push_back(static_cast<mx_uint>(data.size()));
+      }
+      mx_uint ni = 0, no = 0, na = 0;
+      const mx_uint *ndi = nullptr, *ndo = nullptr, *nda = nullptr;
+      const mx_uint **di = nullptr, **dout = nullptr, **da = nullptr;
+      int complete = 0;
+      MXCPP_CHECK(MXSymbolInferShape(
+          h_.get(), static_cast<mx_uint>(keys.size()), keys.data(),
+          ind.data(), data.data(), &ni, &ndi, &di, &no, &ndo, &dout, &na,
+          &nda, &da, &complete));
+      for (mx_uint i = 0; i < na; ++i) {
+        std::vector<mx_uint> dims(da[i], da[i] + nda[i]);
+        NDArray a(Shape(dims), ctx);
+        aux_vec.push_back(a);
+        auxs.push_back(a.GetHandle());
+      }
+    }
+  }
+  void *out = nullptr;
+  MXCPP_CHECK(MXExecutorBindEX(
+      h_.get(), ctx.GetDeviceType(), ctx.GetDeviceId(), 0, nullptr, nullptr,
+      nullptr, static_cast<mx_uint>(args.size()), args.data(), grads.data(),
+      reqs.data(), static_cast<mx_uint>(auxs.size()),
+      auxs.empty() ? nullptr : auxs.data(), nullptr, &out));
+  return new Executor(out, std::move(arg_vec), std::move(grad_vec),
+                      std::move(aux_vec));
+}
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_EXECUTOR_H_

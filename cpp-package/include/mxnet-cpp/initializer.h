@@ -1,0 +1,115 @@
+/* ref: cpp-package/include/mxnet-cpp/initializer.h — name-dispatched
+ * weight initializers (bias→0, gamma→1, etc.). */
+#ifndef MXNET_CPP_INITIALIZER_H_
+#define MXNET_CPP_INITIALIZER_H_
+
+#include <cmath>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/ndarray.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Initializer {
+ public:
+  virtual ~Initializer() = default;
+  virtual void operator()(const std::string &name, NDArray *arr) {
+    if (EndsWith(name, "bias") || EndsWith(name, "beta") ||
+        EndsWith(name, "moving_mean")) {
+      Fill(arr, 0.0f);
+    } else if (EndsWith(name, "gamma") || EndsWith(name, "moving_var")) {
+      Fill(arr, 1.0f);
+    } else {
+      InitWeight(arr);
+    }
+  }
+
+ protected:
+  virtual void InitWeight(NDArray *arr) { Fill(arr, 0.0f); }
+  static void Fill(NDArray *arr, mx_float v) {
+    std::vector<mx_float> buf(arr->Size(), v);
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+  static bool EndsWith(const std::string &s, const std::string &t) {
+    return s.size() >= t.size() &&
+           s.compare(s.size() - t.size(), t.size(), t) == 0;
+  }
+  std::mt19937 rng_{5489u};
+};
+
+class Uniform : public Initializer {
+ public:
+  explicit Uniform(float scale) : lo_(-scale), hi_(scale) {}
+  Uniform(float lo, float hi) : lo_(lo), hi_(hi) {}
+
+ protected:
+  void InitWeight(NDArray *arr) override {
+    std::uniform_real_distribution<float> d(lo_, hi_);
+    std::vector<mx_float> buf(arr->Size());
+    for (auto &x : buf) x = d(rng_);
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+  float lo_, hi_;
+};
+
+class Normal : public Initializer {
+ public:
+  Normal(float mu, float sigma) : mu_(mu), sigma_(sigma) {}
+
+ protected:
+  void InitWeight(NDArray *arr) override {
+    std::normal_distribution<float> d(mu_, sigma_);
+    std::vector<mx_float> buf(arr->Size());
+    for (auto &x : buf) x = d(rng_);
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+  float mu_, sigma_;
+};
+
+class Xavier : public Initializer {
+ public:
+  enum RandType { gaussian, uniform };
+  enum FactorType { avg, in, out };
+  explicit Xavier(RandType rand_type = uniform,
+                  FactorType factor_type = avg, float magnitude = 3)
+      : rand_type_(rand_type), factor_type_(factor_type),
+        magnitude_(magnitude) {}
+
+  void operator()(const std::string &name, NDArray *arr) override {
+    if (!EndsWith(name, "weight")) {
+      Initializer::operator()(name, arr);
+      return;
+    }
+    Shape s = arr->GetShape();
+    float hw = 1.0f;
+    for (mx_uint d = 2; d < s.ndim(); ++d) hw *= s[d];
+    float fan_in = (s.ndim() > 1 ? s[1] : 1) * hw;
+    float fan_out = s[0] * hw;
+    float factor = factor_type_ == avg ? (fan_in + fan_out) / 2.0f
+                   : factor_type_ == in ? fan_in
+                                        : fan_out;
+    float scale = std::sqrt(magnitude_ / factor);
+    std::vector<mx_float> buf(arr->Size());
+    if (rand_type_ == uniform) {
+      std::uniform_real_distribution<float> d(-scale, scale);
+      for (auto &x : buf) x = d(rng_);
+    } else {
+      std::normal_distribution<float> d(0.0f, scale);
+      for (auto &x : buf) x = d(rng_);
+    }
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+
+ private:
+  RandType rand_type_;
+  FactorType factor_type_;
+  float magnitude_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_INITIALIZER_H_

@@ -1,0 +1,95 @@
+/* ref: cpp-package/include/mxnet-cpp/io.h(pp) — fluent MXDataIter over
+ * the MXDataIter* C surface. */
+#ifndef MXNET_CPP_IO_H_
+#define MXNET_CPP_IO_H_
+
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/ndarray.h"
+
+namespace mxnet {
+namespace cpp {
+
+struct DataBatch {
+  NDArray data;
+  NDArray label;
+  int pad_num = 0;
+};
+
+class MXDataIter {
+ public:
+  explicit MXDataIter(const std::string &mxdataiter_type)
+      : type_(mxdataiter_type) {}
+
+  template <typename T>
+  MXDataIter &SetParam(const std::string &key, const T &value) {
+    std::ostringstream os;
+    os << value;
+    params_[key] = os.str();
+    return *this;
+  }
+
+  MXDataIter CreateDataIter() {
+    mx_uint n = 0;
+    DataIterHandle *arr = nullptr;
+    MXCPP_CHECK(MXListDataIters(&n, &arr));
+    void *creator = nullptr;
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *name = nullptr;
+      const char *desc = nullptr;
+      mx_uint na = 0;
+      MXCPP_CHECK(MXDataIterGetIterInfo(arr[i], &name, &desc, &na, nullptr,
+                                        nullptr, nullptr));
+      if (type_ == name) {
+        creator = arr[i];
+        break;
+      }
+    }
+    if (!creator) throw std::runtime_error("no such DataIter: " + type_);
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    void *out = nullptr;
+    MXCPP_CHECK(MXDataIterCreateIter(creator,
+                                     static_cast<mx_uint>(keys.size()),
+                                     keys.data(), vals.data(), &out));
+    MXDataIter it = *this;
+    it.h_.reset(out, [](void *p) {
+      if (p) MXDataIterFree(p);
+    });
+    return it;
+  }
+
+  void Reset() { MXCPP_CHECK(MXDataIterBeforeFirst(h_.get())); }
+  bool Next() {
+    int has = 0;
+    MXCPP_CHECK(MXDataIterNext(h_.get(), &has));
+    return has != 0;
+  }
+  DataBatch GetDataBatch() {
+    DataBatch b;
+    void *d = nullptr, *l = nullptr;
+    MXCPP_CHECK(MXDataIterGetData(h_.get(), &d));
+    MXCPP_CHECK(MXDataIterGetLabel(h_.get(), &l));
+    b.data = NDArray(d);
+    b.label = NDArray(l);
+    MXCPP_CHECK(MXDataIterGetPadNum(h_.get(), &b.pad_num));
+    return b;
+  }
+
+ private:
+  std::string type_;
+  std::map<std::string, std::string> params_;
+  std::shared_ptr<void> h_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_IO_H_

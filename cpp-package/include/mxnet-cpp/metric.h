@@ -1,0 +1,83 @@
+/* ref: cpp-package/include/mxnet-cpp/metric.h — EvalMetric family. */
+#ifndef MXNET_CPP_METRIC_H_
+#define MXNET_CPP_METRIC_H_
+
+#include <cmath>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/ndarray.h"
+
+namespace mxnet {
+namespace cpp {
+
+class EvalMetric {
+ public:
+  explicit EvalMetric(const std::string &name) : name_(name) {}
+  virtual ~EvalMetric() = default;
+  virtual void Update(NDArray labels, NDArray preds) = 0;
+  void Reset() {
+    num_inst_ = 0;
+    sum_metric_ = 0.0f;
+  }
+  float Get() const {
+    return num_inst_ ? sum_metric_ / num_inst_ : NAN;
+  }
+
+ protected:
+  std::string name_;
+  float sum_metric_ = 0.0f;
+  int num_inst_ = 0;
+};
+
+class Accuracy : public EvalMetric {
+ public:
+  Accuracy() : EvalMetric("accuracy") {}
+  void Update(NDArray labels, NDArray preds) override {
+    auto lab = labels.Copy();
+    auto prd = preds.Copy();
+    Shape ps = preds.GetShape();
+    size_t n = ps[0], c = ps.ndim() > 1 ? ps.Size() / ps[0] : 1;
+    for (size_t i = 0; i < n; ++i) {
+      size_t best = 0;
+      for (size_t k = 1; k < c; ++k)
+        if (prd[i * c + k] > prd[i * c + best]) best = k;
+      sum_metric_ += (static_cast<size_t>(lab[i]) == best) ? 1.0f : 0.0f;
+      num_inst_ += 1;
+    }
+  }
+};
+
+class MAE : public EvalMetric {
+ public:
+  MAE() : EvalMetric("mae") {}
+  void Update(NDArray labels, NDArray preds) override {
+    auto lab = labels.Copy();
+    auto prd = preds.Copy();
+    for (size_t i = 0; i < lab.size() && i < prd.size(); ++i) {
+      sum_metric_ += std::fabs(lab[i] - prd[i]);
+      num_inst_ += 1;
+    }
+  }
+};
+
+class LogLoss : public EvalMetric {
+ public:
+  LogLoss() : EvalMetric("logloss") {}
+  void Update(NDArray labels, NDArray preds) override {
+    auto lab = labels.Copy();
+    auto prd = preds.Copy();
+    Shape ps = preds.GetShape();
+    size_t n = ps[0], c = ps.Size() / ps[0];
+    for (size_t i = 0; i < n; ++i) {
+      float p = prd[i * c + static_cast<size_t>(lab[i])];
+      sum_metric_ += -std::log(p > 1e-10f ? p : 1e-10f);
+      num_inst_ += 1;
+    }
+  }
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_METRIC_H_

@@ -1,0 +1,66 @@
+/* ref: cpp-package/include/mxnet-cpp/model.h — FeedForward config and
+ * checkpoint plumbing (the reference's model.h is likewise a thin
+ * aggregate; training loops live in examples). */
+#ifndef MXNET_CPP_MODEL_H_
+#define MXNET_CPP_MODEL_H_
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/ndarray.h"
+#include "mxnet-cpp/symbol.h"
+
+namespace mxnet {
+namespace cpp {
+
+struct FeedForwardConfig {
+  Symbol symbol;
+  std::vector<Context> ctx = {Context::cpu()};
+  int num_epoch = 0;
+  int epoch_size = 0;
+  int batch_size = 128;
+  float learning_rate = 1e-4f;
+  float weight_decay = 1e-4f;
+  FeedForwardConfig() {}
+};
+
+inline void SaveCheckpoint(const std::string &prefix, int epoch,
+                           const Symbol &sym,
+                           const std::map<std::string, NDArray> &args) {
+  sym.Save(prefix + "-symbol.json");
+  std::vector<NDArrayHandle> handles;
+  std::vector<std::string> names;
+  std::vector<const char *> keys;
+  for (auto &kv : args) {
+    names.push_back("arg:" + kv.first);
+    handles.push_back(kv.second.GetHandle());
+  }
+  for (auto &n : names) keys.push_back(n.c_str());
+  char fname[512];
+  snprintf(fname, sizeof(fname), "%s-%04d.params", prefix.c_str(), epoch);
+  MXCPP_CHECK(MXNDArraySave(fname, static_cast<mx_uint>(handles.size()),
+                            handles.data(), keys.data()));
+}
+
+inline std::map<std::string, NDArray> LoadCheckpointArgs(
+    const std::string &prefix, int epoch) {
+  char fname[512];
+  snprintf(fname, sizeof(fname), "%s-%04d.params", prefix.c_str(), epoch);
+  mx_uint n = 0, nk = 0;
+  NDArrayHandle *arrs = nullptr;
+  const char **names = nullptr;
+  MXCPP_CHECK(MXNDArrayLoad(fname, &n, &arrs, &nk, &names));
+  std::map<std::string, NDArray> out;
+  for (mx_uint i = 0; i < n; ++i) {
+    std::string key = i < nk ? names[i] : std::to_string(i);
+    if (key.rfind("arg:", 0) == 0) key = key.substr(4);
+    out[key] = NDArray(arrs[i]);
+  }
+  return out;
+}
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_MODEL_H_

@@ -1,0 +1,76 @@
+/* ref: cpp-package/include/mxnet-cpp/monitor.h(pp) — per-node output
+ * statistics via MXExecutorSetMonitorCallback. */
+#ifndef MXNET_CPP_MONITOR_H_
+#define MXNET_CPP_MONITOR_H_
+
+#include <cmath>
+#include <functional>
+#include <regex>
+#include <string>
+#include <tuple>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/executor.h"
+#include "mxnet-cpp/ndarray.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Monitor {
+ public:
+  typedef std::function<NDArray(const NDArray &)> StatFunc;
+
+  explicit Monitor(int interval, std::regex pattern = std::regex(".*"))
+      : interval(interval), pattern(pattern) {}
+
+  void install(Executor *exe) {
+    exes.push_back(exe);
+  }
+
+  void tic() {
+    if (step % interval == 0) {
+      activated = true;
+      stats.clear();
+    }
+  }
+
+  std::vector<std::tuple<int, std::string, NDArray>> toc() {
+    std::vector<std::tuple<int, std::string, NDArray>> results;
+    if (activated) {
+      activated = false;
+      for (auto *exe : exes) {
+        size_t i = 0;
+        for (auto &out : exe->outputs) {
+          std::string name = "output" + std::to_string(i++);
+          if (std::regex_match(name, pattern))
+            results.emplace_back(step, name, out);
+        }
+      }
+    }
+    ++step;
+    return results;
+  }
+
+  void toc_print() {
+    for (auto &r : toc()) {
+      auto data = std::get<2>(r).Copy();
+      float mean_abs = 0;
+      for (auto v : data) mean_abs += std::fabs(v);
+      if (!data.empty()) mean_abs /= data.size();
+      LG << "Batch: " << std::get<0>(r) << ' ' << std::get<1>(r)
+         << " mean|x|=" << mean_abs;
+    }
+  }
+
+  int interval;
+  std::regex pattern;
+  int step = 0;
+  bool activated = false;
+  std::vector<Executor *> exes;
+  std::vector<std::tuple<int, std::string, NDArray>> stats;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_MONITOR_H_

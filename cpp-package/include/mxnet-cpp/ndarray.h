@@ -1,0 +1,77 @@
+/* ref: cpp-package/include/mxnet-cpp/ndarray.h(pp) — NDArray value
+ * type over the MXNDArray* ABI; handles are shared_ptr-owned. */
+#ifndef MXNET_CPP_NDARRAY_H_
+#define MXNET_CPP_NDARRAY_H_
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/shape.h"
+
+namespace mxnet {
+namespace cpp {
+
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(void *handle)
+      : h_(handle, [](void *p) {
+          if (p) MXNDArrayFree(p);
+        }) {}
+  NDArray(const Shape &shape, const Context &ctx, bool delay_alloc = false,
+          int dtype = 0) {
+    void *out = nullptr;
+    MXCPP_CHECK(MXNDArrayCreateEx(shape.data(), shape.ndim(),
+                                  ctx.GetDeviceType(), ctx.GetDeviceId(),
+                                  delay_alloc, dtype, &out));
+    h_.reset(out, [](void *p) {
+      if (p) MXNDArrayFree(p);
+    });
+  }
+  NDArray(const std::vector<mx_float> &data, const Shape &shape,
+          const Context &ctx)
+      : NDArray(shape, ctx) {
+    SyncCopyFromCPU(data.data(), data.size());
+  }
+
+  void *GetHandle() const { return h_.get(); }
+  explicit operator bool() const { return static_cast<bool>(h_); }
+
+  Shape GetShape() const {
+    mx_uint ndim = 0;
+    const mx_uint *pdata = nullptr;
+    MXCPP_CHECK(MXNDArrayGetShape(h_.get(), &ndim, &pdata));
+    return Shape(std::vector<mx_uint>(pdata, pdata + ndim));
+  }
+  size_t Size() const { return GetShape().Size(); }
+
+  void SyncCopyFromCPU(const mx_float *data, size_t size) {
+    MXCPP_CHECK(MXNDArraySyncCopyFromCPU(h_.get(), data, size));
+  }
+  void SyncCopyToCPU(std::vector<mx_float> *out) const {
+    out->resize(Size());
+    MXCPP_CHECK(MXNDArraySyncCopyToCPU(h_.get(), out->data(), out->size()));
+  }
+  std::vector<mx_float> Copy() const {
+    std::vector<mx_float> out;
+    SyncCopyToCPU(&out);
+    return out;
+  }
+  void CopyTo(NDArray *other) const {
+    std::vector<mx_float> host;
+    SyncCopyToCPU(&host);
+    other->SyncCopyFromCPU(host.data(), host.size());
+  }
+  mx_float At(size_t i) const { return Copy()[i]; }
+  void WaitToRead() const { MXCPP_CHECK(MXNDArrayWaitToRead(h_.get())); }
+  static void WaitAll() { MXCPP_CHECK(MXNDArrayWaitAll()); }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_NDARRAY_H_

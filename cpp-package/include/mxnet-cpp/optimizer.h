@@ -1,0 +1,100 @@
+/* ref: cpp-package/include/mxnet-cpp/optimizer.h(pp) — registry +
+ * fused-op updates through MXImperativeInvoke. */
+#ifndef MXNET_CPP_OPTIMIZER_H_
+#define MXNET_CPP_OPTIMIZER_H_
+
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/ndarray.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Optimizer {
+ public:
+  virtual ~Optimizer() = default;
+
+  template <typename T>
+  Optimizer *SetParam(const std::string &name, const T &value) {
+    std::ostringstream os;
+    os << value;
+    params_[name] = os.str();
+    return this;
+  }
+
+  virtual void Update(int index, NDArray weight, NDArray grad) = 0;
+
+ protected:
+  void *Creator(const std::string &op) {
+    mx_uint n = 0;
+    void **arr = nullptr;
+    MXCPP_CHECK(MXSymbolListAtomicSymbolCreators(&n, &arr));
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *name = nullptr;
+      MXCPP_CHECK(MXSymbolGetAtomicSymbolName(arr[i], &name));
+      if (op == name) return arr[i];
+    }
+    throw std::runtime_error("optimizer op not found: " + op);
+  }
+  void Invoke(const std::string &op, std::vector<NDArrayHandle> ins,
+              NDArrayHandle out,
+              const std::map<std::string, std::string> &extra) {
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params_) {
+      if (kv.first == "momentum") continue; /* state op selection only */
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    for (auto &kv : extra) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int n_out = 1;
+    NDArrayHandle *outs = &out;
+    MXCPP_CHECK(MXImperativeInvoke(
+        Creator(op), static_cast<int>(ins.size()), ins.data(), &n_out,
+        &outs, static_cast<int>(keys.size()), keys.data(), vals.data()));
+  }
+  std::map<std::string, std::string> params_;
+};
+
+class SGDOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray weight, NDArray grad) override {
+    auto it = params_.find("momentum");
+    if (it != params_.end() && it->second != "0" && it->second != "0.0") {
+      NDArray &mom = states_[index];
+      if (!mom) {
+        mom = NDArray(weight.GetShape(), Context::cpu());
+        std::vector<mx_float> z(weight.Size(), 0.0f);
+        mom.SyncCopyFromCPU(z.data(), z.size());
+      }
+      Invoke("sgd_mom_update",
+             {weight.GetHandle(), grad.GetHandle(), mom.GetHandle()},
+             weight.GetHandle(), {{"momentum", it->second}});
+    } else {
+      Invoke("sgd_update", {weight.GetHandle(), grad.GetHandle()},
+             weight.GetHandle(), {});
+    }
+  }
+
+ private:
+  std::map<int, NDArray> states_;
+};
+
+class OptimizerRegistry {
+ public:
+  static Optimizer *Find(const std::string &name) {
+    if (name == "sgd" || name == "ccsgd") return new SGDOptimizer();
+    throw std::runtime_error("unknown optimizer: " + name);
+  }
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_OPTIMIZER_H_

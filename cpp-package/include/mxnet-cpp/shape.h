@@ -1,0 +1,59 @@
+/* ref: cpp-package/include/mxnet-cpp/shape.h — tuple-of-dims value
+ * type used across the frontend. */
+#ifndef MXNET_CPP_SHAPE_H_
+#define MXNET_CPP_SHAPE_H_
+
+#include <initializer_list>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Shape {
+ public:
+  Shape() = default;
+  Shape(std::initializer_list<mx_uint> dims) : dims_(dims) {}
+  explicit Shape(const std::vector<mx_uint> &dims) : dims_(dims) {}
+  explicit Shape(mx_uint d0) : dims_{d0} {}
+  Shape(mx_uint d0, mx_uint d1) : dims_{d0, d1} {}
+  Shape(mx_uint d0, mx_uint d1, mx_uint d2) : dims_{d0, d1, d2} {}
+  Shape(mx_uint d0, mx_uint d1, mx_uint d2, mx_uint d3)
+      : dims_{d0, d1, d2, d3} {}
+  Shape(mx_uint d0, mx_uint d1, mx_uint d2, mx_uint d3, mx_uint d4)
+      : dims_{d0, d1, d2, d3, d4} {}
+
+  mx_uint ndim() const { return static_cast<mx_uint>(dims_.size()); }
+  mx_uint operator[](int i) const { return dims_[i]; }
+  const mx_uint *data() const { return dims_.data(); }
+  size_t Size() const {
+    size_t n = 1;
+    for (auto d : dims_) n *= d;
+    return n;
+  }
+  std::string Str() const {
+    std::ostringstream os;
+    os << "(";
+    for (size_t i = 0; i < dims_.size(); ++i) {
+      if (i) os << ",";
+      os << dims_[i];
+    }
+    if (dims_.size() == 1) os << ",";
+    os << ")";
+    return os.str();
+  }
+
+ private:
+  std::vector<mx_uint> dims_;
+};
+
+inline std::ostream &operator<<(std::ostream &os, const Shape &s) {
+  return os << s.Str();
+}
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_SHAPE_H_

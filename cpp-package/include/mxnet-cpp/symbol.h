@@ -1,0 +1,147 @@
+/* ref: cpp-package/include/mxnet-cpp/symbol.h(pp) — Symbol compose /
+ * infer / bind over the MXSymbol* + MXExecutor* ABI. */
+#ifndef MXNET_CPP_SYMBOL_H_
+#define MXNET_CPP_SYMBOL_H_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/ndarray.h"
+#include "mxnet-cpp/shape.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Executor;
+
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(void *handle)
+      : h_(handle, [](void *p) {
+          if (p) MXSymbolFree(p);
+        }) {}
+
+  static Symbol Variable(const std::string &name) {
+    void *out = nullptr;
+    MXCPP_CHECK(MXSymbolCreateVariable(name.c_str(), &out));
+    return Symbol(out);
+  }
+
+  static Symbol CreateAtomic(const std::string &op,
+                             const std::vector<const char *> &keys,
+                             const std::vector<const char *> &vals) {
+    void *creator = FindCreator(op);
+    void *out = nullptr;
+    MXCPP_CHECK(MXSymbolCreateAtomicSymbol(
+        creator, static_cast<mx_uint>(keys.size()),
+        const_cast<const char **>(keys.data()),
+        const_cast<const char **>(vals.data()), &out));
+    return Symbol(out);
+  }
+
+  Symbol Compose(const std::string &name,
+                 const std::vector<const char *> &input_names,
+                 const std::vector<Symbol> &inputs) const {
+    std::vector<void *> handles;
+    for (auto &s : inputs) handles.push_back(s.GetHandle());
+    MXCPP_CHECK(MXSymbolCompose(h_.get(),
+                                name.empty() ? nullptr : name.c_str(),
+                                static_cast<mx_uint>(handles.size()),
+                                const_cast<const char **>(input_names.data()),
+                                handles.data()));
+    return *this;
+  }
+
+  void *GetHandle() const { return h_.get(); }
+
+  std::vector<std::string> ListArguments() const {
+    return StrVec("MXSymbolListArguments", &MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return StrVec("MXSymbolListOutputs", &MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return StrVec("MXSymbolListAuxiliaryStates",
+                  &MXSymbolListAuxiliaryStates);
+  }
+
+  std::string ToJSON() const {
+    const char *out = nullptr;
+    MXCPP_CHECK(MXSymbolSaveToJSON(h_.get(), &out));
+    return out;
+  }
+  void Save(const std::string &fname) const {
+    MXCPP_CHECK(MXSymbolSaveToFile(h_.get(), fname.c_str()));
+  }
+  static Symbol Load(const std::string &fname) {
+    void *out = nullptr;
+    MXCPP_CHECK(MXSymbolCreateFromFile(fname.c_str(), &out));
+    return Symbol(out);
+  }
+
+  /* infer every argument's shape from the ones pinned in ``known``,
+   * allocating missing entries of args_map (ref: symbol.hpp
+   * InferArgsMap) */
+  void InferArgsMap(const Context &ctx,
+                    std::map<std::string, NDArray> *args_map,
+                    const std::map<std::string, NDArray> &known) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> arg_ind = {0};
+    std::vector<mx_uint> arg_data;
+    for (auto &kv : known) {
+      keys.push_back(kv.first.c_str());
+      Shape s = kv.second.GetShape();
+      for (mx_uint d = 0; d < s.ndim(); ++d) arg_data.push_back(s[d]);
+      arg_ind.push_back(static_cast<mx_uint>(arg_data.size()));
+    }
+    mx_uint in_size = 0, out_size = 0, aux_size = 0;
+    const mx_uint *in_ndim = nullptr, *out_ndim = nullptr,
+                  *aux_ndim = nullptr;
+    const mx_uint **in_data = nullptr, **out_data = nullptr,
+                  **aux_data = nullptr;
+    int complete = 0;
+    MXCPP_CHECK(MXSymbolInferShape(
+        h_.get(), static_cast<mx_uint>(keys.size()), keys.data(),
+        arg_ind.data(), arg_data.data(), &in_size, &in_ndim, &in_data,
+        &out_size, &out_ndim, &out_data, &aux_size, &aux_ndim, &aux_data,
+        &complete));
+    auto names = ListArguments();
+    for (mx_uint i = 0; i < in_size && i < names.size(); ++i) {
+      if (args_map->count(names[i])) continue;
+      std::vector<mx_uint> dims(in_data[i], in_data[i] + in_ndim[i]);
+      (*args_map)[names[i]] = NDArray(Shape(dims), ctx);
+    }
+  }
+
+  Executor *SimpleBind(const Context &ctx,
+                       const std::map<std::string, NDArray> &args_map);
+
+ private:
+  typedef int (*ListFn)(SymbolHandle, mx_uint *, const char ***);
+  std::vector<std::string> StrVec(const char *where, ListFn fn) const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    Check(fn(h_.get(), &n, &arr), where);
+    return std::vector<std::string>(arr, arr + n);
+  }
+  static void *FindCreator(const std::string &op) {
+    mx_uint n = 0;
+    void **arr = nullptr;
+    MXCPP_CHECK(MXSymbolListAtomicSymbolCreators(&n, &arr));
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *name = nullptr;
+      MXCPP_CHECK(MXSymbolGetAtomicSymbolName(arr[i], &name));
+      if (op == name) return arr[i];
+    }
+    throw std::runtime_error("operator not found: " + op);
+  }
+  std::shared_ptr<void> h_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_SYMBOL_H_

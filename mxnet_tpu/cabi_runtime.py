@@ -345,7 +345,7 @@ def exec_backward(ex: Executor, head_grads: Sequence[NDArray]) -> None:
 
 
 def exec_outputs(ex: Executor) -> List[NDArray]:
-    if not ex.outputs:
+    if not ex.outputs or not getattr(ex, "_forward_done", True):
         ex.forward()
     return list(ex.outputs)
 
@@ -407,3 +407,509 @@ def kv_set_updater(kv, trampoline) -> None:
     into the C function pointer (ref: MXKVStoreSetUpdater)."""
     kv.set_updater(lambda key, recv, local: trampoline(int(key), recv,
                                                        local))
+
+
+# ---------------------------------------------------------------------------
+# Autograd (ref: src/c_api/c_api_ndarray.cc MXAutograd*)
+# ---------------------------------------------------------------------------
+def ag_set_recording(flag: int) -> int:
+    from . import autograd
+
+    prev = autograd.set_recording(bool(flag))
+    return int(prev)
+
+
+def ag_set_training(flag: int) -> int:
+    from . import autograd
+
+    prev = autograd.set_training(bool(flag))
+    return int(prev)
+
+
+def ag_is_recording() -> int:
+    from . import autograd
+
+    return int(autograd.is_recording())
+
+
+def ag_is_training() -> int:
+    from . import autograd
+
+    return int(autograd.is_training())
+
+
+def ag_mark_variables(arrs: Sequence[NDArray], reqs: Sequence[int],
+                      grads: Sequence[NDArray]) -> None:
+    """ref: MXAutogradMarkVariables — attach gradient buffers."""
+    from . import autograd
+
+    autograd.mark_variables(list(arrs),
+                            list(grads),
+                            [_GRAD_REQ[int(r)] for r in reqs])
+
+
+def ag_backward(outputs: Sequence[NDArray],
+                out_grads: Sequence[Optional[NDArray]],
+                retain_graph: int, train_mode: int) -> None:
+    """ref: MXAutogradBackwardEx."""
+    from . import autograd
+
+    autograd.backward(list(outputs),
+                      list(out_grads) if out_grads else None,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+def ag_get_grad(arr: NDArray) -> NDArray:
+    if arr.grad is None:
+        raise MXNetError("array has no grad buffer attached")
+    return arr.grad
+
+
+# ---------------------------------------------------------------------------
+# CachedOp (ref: src/c_api/c_api_ndarray.cc MXCreateCachedOp/MXInvokeCachedOp)
+# ---------------------------------------------------------------------------
+class CCachedOp:
+    """C-ABI cached op: a bound symbol specialized + jitted per input
+    shape set (the reference's CachedOp re-executor)."""
+
+    def __init__(self, h: "CSymbol"):
+        self.sym = h.built()
+        self._arg_names = self.sym.list_arguments()
+        self._exec = None
+        self._shapes = None
+
+    def invoke(self, inputs: Sequence[NDArray]) -> List[NDArray]:
+        if len(inputs) != len(self._arg_names):
+            raise MXNetError("CachedOp: %d inputs given, %d expected"
+                             % (len(inputs), len(self._arg_names)))
+        shapes = tuple(tuple(a.shape) for a in inputs)
+        if self._exec is None or shapes != self._shapes:
+            kwargs = {n: tuple(a.shape) for n, a in
+                      zip(self._arg_names, inputs)}
+            self._exec = Executor.simple_bind(self.sym, grad_req="null",
+                                              **kwargs)
+            self._shapes = shapes
+        for n, a in zip(self._arg_names, inputs):
+            self._exec.arg_dict[n]._data = a._data.astype(
+                self._exec.arg_dict[n].dtype)
+        return list(self._exec.forward(is_train=False))
+
+
+def cachedop_create(h: "CSymbol") -> CCachedOp:
+    return CCachedOp(h)
+
+
+def cachedop_invoke(co: CCachedOp,
+                    inputs: Sequence[NDArray]) -> List[NDArray]:
+    return co.invoke(inputs)
+
+
+# ---------------------------------------------------------------------------
+# DataIter C surface (ref: src/c_api/c_api.cc MXDataIter*, registered
+# iterators listed by MXListDataIters)
+# ---------------------------------------------------------------------------
+_DATAITERS = None
+
+
+def _dataiter_registry():
+    global _DATAITERS
+    if _DATAITERS is None:
+        from . import io as _io
+
+        _DATAITERS = {
+            "MNISTIter": _io.MNISTIter,
+            "ImageRecordIter": _io.ImageRecordIter,
+            "ImageDetRecordIter": _io.ImageDetRecordIter,
+            "CSVIter": _io.CSVIter,
+            "LibSVMIter": _io.LibSVMIter,
+        }
+    return _DATAITERS
+
+
+def di_list() -> List[str]:
+    return sorted(_dataiter_registry())
+
+
+def di_info(name: str) -> Tuple[str, str]:
+    cls = _dataiter_registry()[name]
+    return name, (cls.__doc__ or "").strip()
+
+
+class CDataIter:
+    """Holds the iterator + the current batch (the C getters hand out
+    NDArray handles from the last MXDataIterNext)."""
+
+    def __init__(self, name: str, params: Dict[str, str]):
+        cls = _dataiter_registry()[name]
+        kwargs: Dict[str, object] = {}
+        for k, v in params.items():
+            kwargs[k] = _coerce_iter_param(k, v)
+        self.it = cls(**kwargs)
+        self.batch = None
+
+    def next(self) -> int:
+        try:
+            self.batch = self.it.next()
+            return 1
+        except StopIteration:
+            self.batch = None
+            return 0
+
+    def before_first(self) -> None:
+        self.it.reset()
+        self.batch = None
+
+
+def _coerce_iter_param(key: str, val: str):
+    s = str(val).strip()
+    if s.startswith("(") and s.endswith(")"):
+        # fractional tuples (crop scales, overlaps, mean/std) must
+        # survive; only integral values collapse to int (shape dims)
+        out = []
+        for p in s[1:-1].split(","):
+            if not p.strip():
+                continue
+            f = float(p)
+            out.append(int(f) if f == int(f) else f)
+        return tuple(out)
+    for conv in (int, float):
+        try:
+            return conv(s)
+        except ValueError:
+            pass
+    if s in ("True", "true"):
+        return True
+    if s in ("False", "false"):
+        return False
+    return s
+
+
+def di_create(name: str, keys: Sequence[str],
+              vals: Sequence[str]) -> CDataIter:
+    return CDataIter(name, dict(zip(keys, vals)))
+
+
+def di_next(h: CDataIter) -> int:
+    return h.next()
+
+
+def di_before_first(h: CDataIter) -> None:
+    h.before_first()
+
+
+def di_get_data(h: CDataIter) -> NDArray:
+    return h.batch.data[0]
+
+
+def di_get_label(h: CDataIter) -> NDArray:
+    return h.batch.label[0]
+
+
+def di_get_pad(h: CDataIter) -> int:
+    return int(h.batch.pad or 0)
+
+
+def di_get_index(h: CDataIter) -> List[int]:
+    idx = h.batch.index
+    return [int(i) for i in idx] if idx is not None else []
+
+
+# ---------------------------------------------------------------------------
+# SimpleBind (ref: src/c_api/c_api_executor.cc MXExecutorSimpleBind —
+# what every reference binding actually calls)
+# ---------------------------------------------------------------------------
+def exec_simple_bind(h: "CSymbol", dev_type: int, dev_id: int,
+                     g2c_keys: Sequence[str],
+                     g2c_dev_types: Sequence[int],
+                     g2c_dev_ids: Sequence[int],
+                     shape_keys: Sequence[str],
+                     shapes: Sequence[Sequence[int]],
+                     dtype_keys: Sequence[str], dtype_vals: Sequence[int],
+                     grad_req_keys: Sequence[str],
+                     grad_req_vals: Sequence[str],
+                     shared_exec: Optional[Executor]):
+    """Returns (executor, in_args, arg_grads_or_None, aux_states) — the
+    reference's out-parameter set."""
+    sym = h.built()
+    ctx = _device(dev_type, dev_id)
+    group2ctx = {k: _device(t, i) for k, t, i in
+                 zip(g2c_keys, g2c_dev_types, g2c_dev_ids)} or None
+    grad_req: object = "write"
+    if grad_req_keys:
+        grad_req = {k: v for k, v in zip(grad_req_keys, grad_req_vals)}
+    type_dict = {k: _DTYPE_FROM_CODE[int(v)]
+                 for k, v in zip(dtype_keys, dtype_vals)} or None
+    kwargs = {k: tuple(int(d) for d in s)
+              for k, s in zip(shape_keys, shapes)}
+    ex = Executor.simple_bind(sym, ctx=ctx, grad_req=grad_req,
+                              type_dict=type_dict, group2ctx=group2ctx,
+                              shared_exec=shared_exec, **kwargs)
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    in_args = [ex.arg_dict[n] for n in arg_names]
+    arg_grads = [ex.grad_dict.get(n) for n in arg_names]
+    aux_states = [ex.aux_dict[n] for n in aux_names]
+    return ex, in_args, arg_grads, aux_states
+
+
+def exec_set_monitor_callback(ex: Executor, trampoline,
+                              monitor_all: int) -> None:
+    """ref: MXExecutorSetMonitorCallback."""
+    ex.set_monitor_callback(lambda name, arr: trampoline(str(name), arr),
+                            monitor_all=bool(monitor_all))
+
+
+# ---------------------------------------------------------------------------
+# NDArray tail
+# ---------------------------------------------------------------------------
+_STYPE_CODE = {"default": 0, "row_sparse": 1, "csr": 2}
+
+
+def nd_storage_type(arr) -> int:
+    return _STYPE_CODE.get(getattr(arr, "stype", "default"), 0)
+
+
+def nd_detach(arr: NDArray) -> NDArray:
+    return arr.detach()
+
+
+def nd_grad(arr: NDArray) -> Optional[NDArray]:
+    return arr.grad
+
+
+def nd_set_grad_state(arr: NDArray, state: int) -> None:
+    arr._grad_req = "write" if state else "null"
+
+
+def nd_get_grad_state(arr: NDArray) -> int:
+    return int(arr._grad_req != "null")
+
+
+def nd_save_raw(arr: NDArray) -> bytes:
+    """ref: MXNDArraySaveRawBytes — the dmlc single-array blob."""
+    import io as _pyio
+
+    from .ndarray.utils import _write_dmlc
+
+    buf = _pyio.BytesIO()
+    _write_dmlc(buf, [arr], [])
+    return buf.getvalue()
+
+
+def nd_load_raw(data: bytes) -> NDArray:
+    import io as _pyio
+
+    from .context import current_context
+    from .ndarray.utils import _read_dmlc
+
+    arrs = _read_dmlc(_pyio.BytesIO(data), current_context())
+    if isinstance(arrs, dict):
+        arrs = list(arrs.values())
+    if not arrs:
+        raise MXNetError("empty raw NDArray blob")
+    return arrs[0]
+
+
+def nd_create_sparse(stype: int, shape: Sequence[int], dev_type: int,
+                     dev_id: int, dtype: int,
+                     aux_types: Sequence[int]):
+    from .ndarray import sparse as _sp
+
+    name = {1: "row_sparse", 2: "csr"}[int(stype)]
+    return _sp.zeros(name, tuple(int(d) for d in shape),
+                     ctx=_device(dev_type, dev_id),
+                     dtype=_DTYPE_FROM_CODE[int(dtype)])
+
+
+def nd_aux_type(arr, i: int) -> int:
+    # row_sparse: indices; csr: indptr, indices — all int64 here
+    return 6
+
+
+def nd_num_aux(arr) -> int:
+    st = getattr(arr, "stype", "default")
+    return {"default": 0, "row_sparse": 1, "csr": 2}[st]
+
+
+def nd_get_aux(arr, i: int) -> NDArray:
+    st = getattr(arr, "stype", "default")
+    if st == "row_sparse":
+        return [arr.indices][int(i)]
+    if st == "csr":
+        return [arr.indptr, arr.indices][int(i)]
+    raise MXNetError("dense NDArray has no aux arrays")
+
+
+def nd_get_data_nd(arr) -> NDArray:
+    if getattr(arr, "stype", "default") == "default":
+        raise MXNetError("use the array itself for dense data")
+    return arr.data
+
+
+def nd_sync_copy_from_nd(dst: NDArray, src: NDArray, loc: int) -> None:
+    """ref: MXNDArraySyncCopyFromNDArray."""
+    if loc >= 0:
+        dst[int(loc)] = src
+    else:
+        src.copyto(dst)
+
+
+def nd_check_format(arr, full_check: int) -> None:
+    """ref: MXNDArraySyncCheckFormat — sparse invariant check."""
+    st = getattr(arr, "stype", "default")
+    if st == "csr":
+        import numpy as _np2
+
+        indptr = arr.indptr.asnumpy()
+        if indptr[0] != 0 or (_np2.diff(indptr) < 0).any():
+            raise MXNetError("malformed CSR indptr")
+
+
+# ---------------------------------------------------------------------------
+# KVStore tail (dist surface)
+# ---------------------------------------------------------------------------
+def kv_pull_row_sparse(kv, keys: Sequence, outs: Sequence,
+                       row_ids: Sequence, priority: int) -> None:
+    kv.row_sparse_pull(list(keys), out=list(outs), priority=priority,
+                       row_ids=list(row_ids))
+
+
+def kv_run_server(kv, controller_trampoline) -> None:
+    """ref: MXKVStoreRunServer — blocks in the server loop; the
+    controller receives (head, body) commands sent by workers via
+    MXKVStoreSendCommmandToServers."""
+    from . import kvstore_server
+
+    controller = None
+    if controller_trampoline is not None and \
+            callable(controller_trampoline):
+        controller = lambda head, body: controller_trampoline(int(head),
+                                                              str(body))
+    kvstore_server.init(controller=controller)
+
+
+def kv_send_command(kv, head: int, body: str) -> None:
+    fn = getattr(kv, "send_command_to_servers", None)
+    if fn is None:
+        raise MXNetError("kvstore %r has no command channel" % kv.type)
+    fn(int(head), body)
+
+
+def kv_set_compression(kv, keys: Sequence[str],
+                       vals: Sequence[str]) -> None:
+    kv.set_gradient_compression(dict(zip(keys, vals)))
+
+
+def kv_barrier_before_exit(kv, flag: int) -> None:
+    setattr(kv, "_barrier_before_exit", bool(flag))
+
+
+def kv_is_scheduler() -> int:
+    import os
+
+    return int(os.environ.get("DMLC_ROLE") == "scheduler")
+
+
+def kv_is_server() -> int:
+    import os
+
+    return int(os.environ.get("DMLC_ROLE") == "server")
+
+
+def kv_num_dead_node(kv, node_id: int, timeout: int) -> int:
+    fn = getattr(kv, "get_dead_nodes", None)
+    if fn is None:
+        return 0
+    return len(fn(timeout))
+
+
+# ---------------------------------------------------------------------------
+# Profiler / engine / misc (ref: c_api_profile.cc, MXEngineSetBulkSize)
+# ---------------------------------------------------------------------------
+def profiler_set_config(keys: Sequence[str], vals: Sequence[str]) -> None:
+    from . import profiler
+
+    params = dict(zip(keys, vals))
+    fname = params.get("filename", params.get("file_name",
+                                              "profile.json"))
+    profiler.set_config(filename=fname)
+
+
+def profiler_set_state(state: int) -> None:
+    from . import profiler
+
+    profiler.set_state("run" if state else "stop")
+
+
+def profiler_dump(finished: int) -> None:
+    from . import profiler
+
+    profiler.dump(finished=bool(finished))
+
+
+def engine_set_bulk_size(size: int) -> int:
+    from . import engine
+
+    return engine.set_bulk_size(int(size))
+
+
+def get_version() -> int:
+    # encode like the reference: major*10000 + minor*100 + patch (1.0.0)
+    return 10000
+
+
+def set_omp_threads(n: int) -> None:
+    import os
+
+    os.environ["OMP_NUM_THREADS"] = str(int(n))
+
+
+def init_ps_env(keys: Sequence[str], vals: Sequence[str]) -> None:
+    import os
+
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+
+
+# ---------------------------------------------------------------------------
+# Symbol tail
+# ---------------------------------------------------------------------------
+def sym_list_attr(h: "CSymbol", shallow: int) -> List[str]:
+    """Flattened [key, value, key, value...] like the reference's
+    MXSymbolListAttr."""
+    out: List[str] = []
+    sym = h.built()
+    if shallow:
+        node = sym._entries[0][0]
+        for k, v in node.attrs.items():
+            kk = k[2:-2] if k.startswith("__") and k.endswith("__") else k
+            out.extend([kk, str(v)])
+        return out
+    for name, attrs in sym.attr_dict().items():
+        for k, v in attrs.items():
+            out.extend(["%s$%s" % (name, k), str(v)])
+    return out
+
+
+def sym_get_children(h: "CSymbol") -> "CSymbol":
+    sym = h.built()
+    node = sym._entries[0][0]
+    from .symbol.symbol import Symbol as _S
+
+    if not node.inputs:
+        raise MXNetError("symbol has no children")
+    return CSymbol(sym=_S(list(node.inputs)))
+
+
+# ---------------------------------------------------------------------------
+# Custom op registration from C (ref: src/c_api/c_api_function.cc)
+# ---------------------------------------------------------------------------
+def custom_op_register(op_type: str, creator_trampoline) -> None:
+    """The C creator is invoked per instantiation; it returns forward/
+    backward/infer callbacks.  The full reference protocol (struct of
+    function pointers) is marshalled by the C side into python callables
+    before reaching here."""
+    from . import operator as _operator
+
+    _operator.register_c_creator(op_type, creator_trampoline)

@@ -147,6 +147,13 @@ def build_graph_eval(symbol, collect_internals: bool = False,
                 args = [jax.random.fold_in(rng_key, node_index[id(node)])] + args
             out = op.fn(*args, **params)
             outs = list(out) if isinstance(out, tuple) else [out]
+            if op.nondiff:
+                # the reference registers NO gradient for these ops
+                # (MultiBoxTarget, samplers, ...): jax must not
+                # differentiate through their internals — argmax/where/
+                # division inside target-assignment produces NaN
+                # cotangents that poison every upstream gradient
+                outs = [jax.lax.stop_gradient(o) for o in outs]
             n_vis = len(outs) - len(op.mutate_aux)
             env[id(node)] = outs[:n_vis]
             if collect_internals:
@@ -212,7 +219,28 @@ class Executor:
         self._grad_names = grad_names
         self._train_step = self._build_train_step(collect_internals=False)
 
+        # outputs are STABLE buffers allocated at bind time and updated
+        # in place by forward/backward — reference code captures
+        # ``exec.outputs`` once and reads it after every forward (e.g.
+        # example/model-parallel/lstm/lstm.py:248-263 seq_outputs), so
+        # identity must survive across calls (ref: GraphExecutor output
+        # NDArrays live for the executor's lifetime).
         self.outputs: List[NDArray] = []
+        try:
+            from .symbol.infer import infer_shape
+
+            shapes = {k: tuple(v.shape) for k, v in arg_dict.items()}
+            _, out_shapes, _ = infer_shape(symbol, **shapes)
+            self.outputs = [_nd_mod.zeros(s, ctx=self._ctx)
+                            for s in out_shapes if s is not None]
+            if len(self.outputs) != len(self._output_names):
+                self.outputs = []
+        except Exception:
+            self.outputs = []  # first forward materializes them
+        # bind-time buffers hold zeros until a forward runs; consumers
+        # that lazily materialize outputs key off this flag, not
+        # list-emptiness (the buffers must pre-exist for identity)
+        self._forward_done = False
         self._cached_grads: Optional[Dict[str, Any]] = None
         self._monitor_callback = None
         self._monitor_all = False
@@ -342,7 +370,7 @@ class Executor:
             if is_train:
                 self._write_aux(aux_upd)
         self._cached_grads = None
-        self.outputs = [NDArray.from_raw(o, self._ctx) for o in outs]
+        self._set_outputs(outs)
         return self.outputs
 
     # -- monitor tap (ref: MXExecutorSetMonitorCallback →
@@ -392,7 +420,7 @@ class Executor:
                                    placement=self._placement)
         grad_names = self._grad_names
 
-        def train_step(arg_vals, aux_vals, key, out_cots):
+        def train_step(arg_vals, aux_vals, key, out_cots, n_given):
             diff = {k: arg_vals[k] for k in grad_names}
             rest = {k: v for k, v in arg_vals.items() if k not in diff}
 
@@ -401,22 +429,36 @@ class Executor:
 
             res, vjp_fn = jax.vjp(pure, diff)
             outs = res[0]
-            cots = [
-                c if c is not None else jax.numpy.ones_like(o)
-                for c, o in zip(out_cots, outs)
-            ]
-            zero_rest = jax.tree.map(jax.numpy.zeros_like, res[1:])
+            jnp = jax.numpy
+            # reference head-grad semantics (GraphExecutor::Backward):
+            # None → implicit ones (loss outputs); a list shorter than
+            # the output count (n_given, static) leaves the tail
+            # gradient-free (BlockGrad'd state outputs, e.g.
+            # model-parallel lstm.py head_grad); a (1,)-shaped head grad
+            # broadcasts over the output
+            cots = []
+            for i, o in enumerate(outs):
+                c = out_cots[i] if i < len(out_cots) else None
+                if i >= n_given:
+                    cots.append(jnp.zeros_like(o))
+                elif c is None:
+                    cots.append(jnp.ones_like(o))
+                else:
+                    cots.append(jnp.broadcast_to(c, o.shape).astype(o.dtype))
+            zero_rest = jax.tree.map(jnp.zeros_like, res[1:])
             (grads,) = vjp_fn((cots,) + tuple(zero_rest))
             return (outs, grads) + tuple(res[1:])
 
-        return train_step if self._placement is not None else jax.jit(train_step)
+        return train_step if self._placement is not None else \
+            jax.jit(train_step, static_argnums=4)
 
-    def _train_step_monitored(self, cots):
+    def _train_step_monitored(self, cots, n_given):
         if self._monitor_train_fn is None:
             self._monitor_train_fn = self._build_train_step(
                 collect_internals=True)
         outs, grads, aux_upd, internals = self._monitor_train_fn(
-            self._arg_vals(), self._aux_vals(), self._next_key(), cots)
+            self._arg_vals(), self._aux_vals(), self._next_key(), cots,
+            n_given)
         self._fire_monitor(internals)
         return outs, grads, aux_upd
 
@@ -430,10 +472,13 @@ class Executor:
         n_out = len(self._output_names)
         if out_grads is None:
             cots = [None] * n_out
+            n_given = n_out
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             cots = [g._data if g is not None else None for g in out_grads]
+            n_given = len(cots)
+            cots += [None] * (n_out - n_given)
         from . import profiler as _profiler
 
         with _profiler.span("Backward<%s>" % (self._output_names[0]
@@ -442,16 +487,17 @@ class Executor:
             # fire the monitor tap only on the fused-step path (fit's
             # forward_backward); a manual forward() already fired it
             if self._monitor_callback is not None and update_outputs:
-                outs, grads, aux_upd = self._train_step_monitored(cots)
+                outs, grads, aux_upd = self._train_step_monitored(cots,
+                                                                  n_given)
             else:
                 outs, grads, aux_upd = self._train_step(
                     self._arg_vals(), self._aux_vals(), self._next_key(),
-                    cots)
+                    cots, n_given)
             if _profiler.is_running() and _profiler._sync:
                 _jax().block_until_ready(outs)
         self._write_aux(aux_upd)
-        if update_outputs or not self.outputs:
-            self.outputs = [NDArray.from_raw(o, self._ctx) for o in outs]
+        if update_outputs or not self._forward_done:
+            self._set_outputs(outs)
         for name in self._grad_names:
             buf = self.grad_dict.get(name)
             if buf is None:
@@ -463,6 +509,22 @@ class Executor:
             else:
                 buf._data = g.astype(buf.dtype)
         return self.outputs
+
+    def _set_outputs(self, outs) -> None:
+        """Write forward results into the stable output cells (identity
+        preserved); (re)materialize cells only on first use or when a
+        shape changed."""
+        self._forward_done = True
+        if len(self.outputs) != len(outs):
+            self.outputs = [NDArray.from_raw(o, self._ctx) for o in outs]
+            return
+        for i, o in enumerate(outs):
+            cell = self.outputs[i]
+            if tuple(cell.shape) == tuple(o.shape):
+                cell._data = o
+                cell._vt = object()
+            else:
+                self.outputs[i] = NDArray.from_raw(o, self._ctx)
 
     def _write_aux(self, aux_upd) -> None:
         for name, val in aux_upd.items():

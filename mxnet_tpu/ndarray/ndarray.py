@@ -56,6 +56,12 @@ class NDArray:
     # make NDArray win against numpy in mixed dunders
     __array_priority__ = 1000.0
 
+    @staticmethod
+    def _is_traced(x) -> bool:
+        import jax.core as _jc
+
+        return isinstance(x, _jc.Tracer)
+
     def __init__(self, data, ctx: Optional[Context] = None):
         jax = _jax()
         if ctx is None:
@@ -537,6 +543,16 @@ def invoke(
     tupled = outs if isinstance(outs, tuple) else (outs,)
     n_visible = len(tupled) - len(op.mutate_aux)
     wrapped = [NDArray.from_raw(o, out_ctx) for o in tupled[:n_visible]]
+    if ctx is not None and tupled and \
+            not NDArray._is_traced(tupled[0]):
+        # an EXPLICIT creation context commits the buffer to that device
+        # (model parallelism allocates per-group arrays with
+        # mx.nd.zeros(shape, ctx); reference arrays live on their
+        # context's device, ndarray.h Chunk)
+        dev = ctx.jax_device()
+        for w in wrapped:
+            if dev not in w._data.devices():
+                w._data = _jax().device_put(w._data, dev)
 
     # write back mutated aux states (BatchNorm moving stats et al.;
     # ref: aux-state updates in src/operator/batch_norm.cc)

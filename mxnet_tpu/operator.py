@@ -244,3 +244,66 @@ def _register_custom_op():
 
 
 _register_custom_op()
+
+
+def register_c_creator(op_type: str, trampoline) -> None:
+    """Register a C-ABI custom op (ref: MXCustomOpRegister,
+    src/c_api/c_api_function.cc).  ``trampoline`` is the PyCFunction
+    built by native/c_api_ext.cc over the registered CustomOpPropCreator
+    callback chain; queries mirror the reference's CustomOpPropCallbacks
+    enum (list_arguments/list_outputs/infer_shape/create_operator) and
+    the operator's forward/backward ride CustomOpFBFunc with reference
+    tag ints."""
+
+    class _CBackedProp(CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=False)
+            self.kwargs = {k: str(v) for k, v in kwargs.items()}
+
+        def list_arguments(self):
+            return list(trampoline("list_arguments")) or ["data"]
+
+        def list_outputs(self):
+            return list(trampoline("list_outputs")) or ["output"]
+
+        def list_auxiliary_states(self):
+            return list(trampoline("list_aux"))
+
+        def infer_shape(self, in_shape):
+            n_in = len(self.list_arguments())
+            n_out = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            payload = [tuple(int(d) for d in s) for s in in_shape]
+            payload += [None] * (n_in + n_out + n_aux - len(payload))
+            res = trampoline("infer_shape", payload)
+            if res is None:
+                return CustomOpProp.infer_shape(self, in_shape)
+            res = [tuple(s) for s in res]
+            return (res[:n_in], res[n_in:n_in + n_out],
+                    res[n_in + n_out:])
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            cap = trampoline(
+                "create_operator",
+                [tuple(int(d) for d in s) for s in in_shapes])
+
+            class _CBackedOp(CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    arrs = list(in_data) + list(out_data)
+                    tags = [0] * len(in_data) + [1] * len(out_data)
+                    trampoline("forward",
+                               (cap, arrs, tags, int(is_train)))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    # reference tag order for backward: out_grad(3),
+                    # in_data(0), out_data(1), in_grad(2)
+                    arrs = (list(out_grad) + list(in_data) +
+                            list(out_data) + list(in_grad))
+                    tags = ([3] * len(out_grad) + [0] * len(in_data) +
+                            [1] * len(out_data) + [2] * len(in_grad))
+                    trampoline("backward", (cap, arrs, tags, 1))
+
+            return _CBackedOp()
+
+    _REGISTRY[op_type] = _CBackedProp

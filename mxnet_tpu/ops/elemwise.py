@@ -88,7 +88,45 @@ register("size_array", nondiff=True)(
 register("stop_gradient", aliases=("BlockGrad",))(
     lambda data, **_: jax.lax.stop_gradient(data)
 )
-register("make_loss")(lambda data, **_: data)
+def _make_loss_fn(grad_scale, normalization, valid_thresh):
+    """ref: src/operator/make_loss-inl.h — forward is identity; backward
+    IGNORES the incoming cotangent (loss head) and emits
+    grad_scale / N, where N is the batch size ('batch') or the count of
+    elements above valid_thresh ('valid')."""
+    import functools as _ft
+
+    @jax.custom_vjp
+    def f(data):
+        return data
+
+    def f_fwd(data):
+        return data, data
+
+    def f_bwd(data, _g):
+        scale = grad_scale
+        if normalization == "valid":
+            nvalid = jnp.maximum(
+                jnp.sum((data > valid_thresh).astype(data.dtype)), 1.0)
+            scale = scale / nvalid
+        elif normalization == "batch":
+            scale = scale / data.shape[0]
+        return (jnp.full_like(data, scale),)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+_make_loss_cache = {}
+
+
+@register("make_loss")
+def _make_loss(data, grad_scale=1.0, normalization="null",
+               valid_thresh=0.0, **_):
+    key = (float(grad_scale), str(normalization), float(valid_thresh))
+    f = _make_loss_cache.get(key)
+    if f is None:
+        f = _make_loss_cache[key] = _make_loss_fn(*key)
+    return f(data)
 
 
 @register("Cast", aliases=("cast",))

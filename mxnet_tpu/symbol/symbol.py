@@ -168,7 +168,11 @@ class Symbol:
 
     def attr(self, key: str) -> Optional[str]:
         node = self._entries[0][0]
-        v = node.attrs.get("__" + key + "__", node.attrs.get(key))
+        # callers pass either form (reference model-parallel code asks
+        # for "__ctx_group__" directly, lstm.py:215) — look up both
+        base = key[2:-2] if len(key) > 4 and key.startswith("__") \
+            and key.endswith("__") else key
+        v = node.attrs.get("__" + base + "__", node.attrs.get(base))
         return str(v) if v is not None else None
 
     def attr_dict(self) -> Dict[str, Dict[str, str]]:
@@ -288,11 +292,24 @@ def _visible_outputs(node: _Node) -> int:
     return max(1, node.num_outputs - len(op.mutate_aux))
 
 
+_DUNDER_HINT = {"broadcast_add": "_plus", "broadcast_sub": "_minus",
+                "broadcast_mul": "_mul", "broadcast_div": "_div",
+                "broadcast_power": "_power"}
+
+
 def _binary_sym(op_name, scalar_op, lhs, other, reverse=False):
     if isinstance(other, Symbol):
-        return create(op_name, lhs=lhs, rhs=other) if not reverse else create(
-            op_name, lhs=other, rhs=lhs
-        )
+        # auto-name like the reference's elemwise dunder ops ("_plus12"
+        # etc., the _Plus/_Minus registered names): generated model code
+        # addresses residual-add internals by these names (e.g.
+        # example/ssd/symbol_factory.py from_layers ['_plus12', ...])
+        from .. import name as _name_mod
+
+        auto = _name_mod.current().get(None, _DUNDER_HINT.get(op_name,
+                                                              op_name))
+        return create(op_name, lhs=lhs, rhs=other, name=auto) \
+            if not reverse else create(op_name, lhs=other, rhs=lhs,
+                                       name=auto)
     return create(scalar_op, data=lhs, scalar=float(other))
 
 

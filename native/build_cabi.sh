@@ -5,7 +5,7 @@
 # (ref: the reference ships these entry points inside libmxnet.so).
 set -e
 cd "$(dirname "$0")"
-g++ -O2 -shared -fPIC -std=c++17 c_predict_api.cc c_api.cc \
+g++ -O2 -shared -fPIC -std=c++17 c_predict_api.cc c_api.cc c_api_ext.cc recordio.cc \
     $(python3-config --includes) \
     $(python3-config --ldflags --embed) \
     -o libmxnet_tpu.so

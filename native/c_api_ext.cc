@@ -1,0 +1,1462 @@
+/* C ABI tail: the reference surface beyond c_api.cc's core families.
+ *
+ * ref: include/mxnet/c_api.h —
+ *   MXAutograd*            (src/c_api/c_api_ndarray.cc)
+ *   MXExecutorSimpleBind   (src/c_api/c_api_executor.cc — what every
+ *                           reference language binding actually calls)
+ *   MXDataIter*            (src/c_api/c_api.cc iterator surface)
+ *   MX{Create,Invoke,Free}CachedOp
+ *   MXNDArray tail         (storage type, grads, raw bytes, sparse aux)
+ *   MXKVStore dist tail    (row_sparse pull, server loop, compression)
+ *   MXRecordIO*            (over native/recordio.cc)
+ *   Profiler / engine / version / env
+ *   MXCustomOpRegister     (src/c_api/c_api_function.cc protocol: the
+ *                           C callback chain is wrapped into python
+ *                           callables; enums/typedefs match c_api.h)
+ *   MXRtc* / MXFunc legacy (error stubs where the reference itself
+ *                           errors without CUDA; imperative aliases)
+ *
+ * Marshalling only — semantics live in mxnet_tpu/cabi_runtime.py.
+ */
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "embed_common.h"
+#include "recordio.h"
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+typedef void *DataIterHandle;
+typedef void *CachedOpHandle;
+typedef void *RecordIOHandle_;
+typedef void *AtomicSymbolCreator;
+typedef void *FunctionHandle;
+
+#define MXNET_DLL __attribute__((visibility("default")))
+#define MXAPI extern "C" MXNET_DLL
+
+using mxtpu::CallRt;
+using mxtpu::Fail;
+using mxtpu::Gil;
+using mxtpu::HandleList;
+using mxtpu::LastError;
+using mxtpu::StrList;
+
+namespace {
+
+int ReturnHandleX(PyObject *obj, void **out, const char *where) {
+  if (!obj) return Fail(where);
+  *out = obj;
+  return 0;
+}
+
+struct HandleStoreX {
+  std::vector<void *> handles;
+  int Fill(PyObject *seq_any, mx_uint *out_size, NDArrayHandle **out,
+           const char *where) {
+    PyObject *seq = PySequence_Fast(seq_any, where);
+    if (!seq) return Fail(where);
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PySequence_Fast_GET_ITEM(seq, i);
+      if (it == Py_None) {
+        handles.push_back(nullptr);
+        continue;
+      }
+      Py_INCREF(it);
+      handles.push_back(it);
+    }
+    Py_DECREF(seq);
+    *out_size = static_cast<mx_uint>(handles.size());
+    *out = handles.data();
+    return 0;
+  }
+};
+
+thread_local HandleStoreX g_args_store, g_grads_store, g_aux_store,
+    g_iter_store;
+thread_local mxtpu::StrStore g_ext_str_store;
+thread_local std::string g_ext_str;
+
+PyObject *IntList(mx_uint n, const int *a) {
+  PyObject *lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SetItem(lst, i, PyLong_FromLong(a ? a[i] : 0));
+  return lst;
+}
+
+}  // namespace
+
+/* ====================================================================
+ * Autograd (ref: c_api_ndarray.cc MXAutograd*)
+ * ==================================================================== */
+MXAPI int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  Gil gil;
+  PyObject *r = CallRt("ag_set_recording", "i", is_recording);
+  if (!r) return Fail("MXAutogradSetIsRecording");
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXAutogradSetIsTraining(int is_training, int *prev) {
+  Gil gil;
+  PyObject *r = CallRt("ag_set_training", "i", is_training);
+  if (!r) return Fail("MXAutogradSetIsTraining");
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXAutogradIsRecording(bool *curr) {
+  Gil gil;
+  PyObject *r = CallRt("ag_is_recording", "");
+  if (!r) return Fail("MXAutogradIsRecording");
+  *curr = PyLong_AsLong(r) != 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXAutogradIsTraining(bool *curr) {
+  Gil gil;
+  PyObject *r = CallRt("ag_is_training", "");
+  if (!r) return Fail("MXAutogradIsTraining");
+  *curr = PyLong_AsLong(r) != 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                                  mx_uint *reqs_array,
+                                  NDArrayHandle *grad_handles) {
+  Gil gil;
+  PyObject *vars = HandleList(num_var, var_handles);
+  PyObject *grads = HandleList(num_var, grad_handles);
+  PyObject *reqs = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i)
+    PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+  PyObject *r = CallRt("ag_mark_variables", "OOO", vars, reqs, grads);
+  Py_DECREF(vars);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  if (!r) return Fail("MXAutogradMarkVariables");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXAutogradBackwardEx(mx_uint num_output,
+                               NDArrayHandle *output_handles,
+                               NDArrayHandle *ograd_handles,
+                               mx_uint num_variables,
+                               NDArrayHandle *var_handles, int retain_graph,
+                               int create_graph, int is_train,
+                               NDArrayHandle **grad_handles,
+                               int **grad_stypes) {
+  (void)num_variables;
+  (void)var_handles;
+  (void)create_graph;
+  (void)grad_handles;
+  (void)grad_stypes;
+  Gil gil;
+  PyObject *outs = HandleList(num_output, output_handles);
+  PyObject *ogs = HandleList(num_output, ograd_handles);
+  PyObject *r = CallRt("ag_backward", "OOii", outs, ogs, retain_graph,
+                       is_train);
+  Py_DECREF(outs);
+  Py_DECREF(ogs);
+  if (!r) return Fail("MXAutogradBackwardEx");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXAutogradBackward(mx_uint num_output,
+                             NDArrayHandle *output_handles,
+                             NDArrayHandle *ograd_handles,
+                             int retain_graph) {
+  return MXAutogradBackwardEx(num_output, output_handles, ograd_handles, 0,
+                              nullptr, retain_graph, 0, 1, nullptr, nullptr);
+}
+
+MXAPI int MXAutogradComputeGradient(mx_uint num_output,
+                                    NDArrayHandle *output_handles) {
+  return MXAutogradBackward(num_output, output_handles, nullptr, 0);
+}
+
+MXAPI int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  PyObject *r = CallRt("nd_grad", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXNDArrayGetGrad");
+  if (r == Py_None) {
+    Py_DECREF(r);
+    *out = nullptr;
+    return 0;
+  }
+  *out = r;
+  return 0;
+}
+
+MXAPI int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  return ReturnHandleX(CallRt("nd_detach", "O",
+                              static_cast<PyObject *>(handle)),
+                       out, "MXNDArrayDetach");
+}
+
+MXAPI int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  Gil gil;
+  PyObject *r = CallRt("nd_set_grad_state", "Oi",
+                       static_cast<PyObject *>(handle), state);
+  if (!r) return Fail("MXNDArraySetGradState");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArrayGetGradState(NDArrayHandle handle, int *out) {
+  Gil gil;
+  PyObject *r = CallRt("nd_get_grad_state", "O",
+                       static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXNDArrayGetGradState");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ====================================================================
+ * NDArray tail
+ * ==================================================================== */
+MXAPI int MXNDArrayGetStorageType(NDArrayHandle handle, int *out) {
+  Gil gil;
+  PyObject *r = CallRt("nd_storage_type", "O",
+                       static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXNDArrayGetStorageType");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                                const char **out_buf) {
+  Gil gil;
+  PyObject *r = CallRt("nd_save_raw", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXNDArraySaveRawBytes");
+  char *buf;
+  Py_ssize_t len;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return Fail("MXNDArraySaveRawBytes");
+  }
+  g_ext_str.assign(buf, len);
+  Py_DECREF(r);
+  *out_size = g_ext_str.size();
+  *out_buf = g_ext_str.data();
+  return 0;
+}
+
+MXAPI int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                                    NDArrayHandle *out) {
+  Gil gil;
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      static_cast<const char *>(buf), static_cast<Py_ssize_t>(size));
+  PyObject *r = CallRt("nd_load_raw", "O", bytes);
+  Py_DECREF(bytes);
+  return ReturnHandleX(r, out, "MXNDArrayLoadFromRawBytes");
+}
+
+MXAPI int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                                  mx_uint ndim, int dev_type, int dev_id,
+                                  int delay_alloc, int dtype,
+                                  mx_uint num_aux, int *aux_type,
+                                  mx_uint *aux_ndims,
+                                  const mx_uint *aux_shape,
+                                  NDArrayHandle *out) {
+  (void)delay_alloc;
+  (void)aux_ndims;
+  (void)aux_shape;
+  Gil gil;
+  PyObject *shp = PyList_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyList_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject *auxt = IntList(num_aux, aux_type);
+  PyObject *r = CallRt("nd_create_sparse", "iOiiiO", storage_type, shp,
+                       dev_type, dev_id, dtype, auxt);
+  Py_DECREF(shp);
+  Py_DECREF(auxt);
+  return ReturnHandleX(r, out, "MXNDArrayCreateSparseEx");
+}
+
+MXAPI int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out) {
+  Gil gil;
+  PyObject *r = CallRt("nd_aux_type", "Oi", static_cast<PyObject *>(handle),
+                       static_cast<int>(i));
+  if (!r) return Fail("MXNDArrayGetAuxType");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                                 NDArrayHandle *out) {
+  Gil gil;
+  return ReturnHandleX(CallRt("nd_get_aux", "Oi",
+                              static_cast<PyObject *>(handle),
+                              static_cast<int>(i)),
+                       out, "MXNDArrayGetAuxNDArray");
+}
+
+MXAPI int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  return ReturnHandleX(CallRt("nd_get_data_nd", "O",
+                              static_cast<PyObject *>(handle)),
+                       out, "MXNDArrayGetDataNDArray");
+}
+
+MXAPI int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                       const NDArrayHandle handle_src,
+                                       const int i) {
+  Gil gil;
+  PyObject *r = CallRt("nd_sync_copy_from_nd", "OOi",
+                       static_cast<PyObject *>(handle_dst),
+                       static_cast<PyObject *>(const_cast<void *>(handle_src)),
+                       i);
+  if (!r) return Fail("MXNDArraySyncCopyFromNDArray");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArraySyncCheckFormat(NDArrayHandle handle,
+                                   const bool full_check) {
+  Gil gil;
+  PyObject *r = CallRt("nd_check_format", "Oi",
+                       static_cast<PyObject *>(handle),
+                       static_cast<int>(full_check));
+  if (!r) return Fail("MXNDArraySyncCheckFormat");
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ====================================================================
+ * SimpleBind (ref: c_api_executor.cc — full reference signature)
+ * ==================================================================== */
+MXAPI int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char **g2c_keys,
+    const int *g2c_dev_types, const int *g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char **provided_arg_stype_names, const int *provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list, mx_uint *num_in_args,
+    NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out) {
+  /* stype/shared-buffer params accepted for signature parity; dense XLA
+   * buffers make the shared memory pool the compiler's job */
+  (void)num_provided_arg_stypes;
+  (void)provided_arg_stype_names;
+  (void)provided_arg_stypes;
+  (void)num_shared_arg_names;
+  (void)shared_arg_name_list;
+  (void)shared_buffer_name_list;
+  (void)shared_buffer_handle_list;
+  if (shared_buffer_len && *shared_buffer_len > 0) {
+    if (updated_shared_buffer_name_list) *updated_shared_buffer_name_list = nullptr;
+    if (updated_shared_buffer_handle_list) *updated_shared_buffer_handle_list = nullptr;
+  }
+  Gil gil;
+  PyObject *py_g2c_keys = StrList(num_g2c_keys, g2c_keys);
+  PyObject *py_g2c_types = IntList(num_g2c_keys, g2c_dev_types);
+  PyObject *py_g2c_ids = IntList(num_g2c_keys, g2c_dev_ids);
+  PyObject *shape_keys = StrList(num_provided_arg_shapes,
+                                 provided_arg_shape_names);
+  PyObject *shapes = PyList_New(num_provided_arg_shapes);
+  for (mx_uint i = 0; i < num_provided_arg_shapes; ++i) {
+    mx_uint b = provided_arg_shape_idx[i], e = provided_arg_shape_idx[i + 1];
+    PyObject *t = PyList_New(e - b);
+    for (mx_uint d = b; d < e; ++d)
+      PyList_SetItem(t, d - b,
+                     PyLong_FromUnsignedLong(provided_arg_shape_data[d]));
+    PyList_SetItem(shapes, i, t);
+  }
+  PyObject *dtype_keys = StrList(num_provided_arg_dtypes,
+                                 provided_arg_dtype_names);
+  PyObject *dtype_vals = IntList(num_provided_arg_dtypes,
+                                 provided_arg_dtypes);
+  PyObject *req_keys = StrList(provided_grad_req_list_len,
+                               provided_grad_req_names);
+  PyObject *req_vals = StrList(provided_grad_req_list_len,
+                               provided_grad_req_types);
+  PyObject *shared = shared_exec_handle
+                         ? static_cast<PyObject *>(shared_exec_handle)
+                         : Py_None;
+  PyObject *r = CallRt("exec_simple_bind", "OiiOOOOOOOOOO",
+                       static_cast<PyObject *>(symbol_handle), dev_type,
+                       dev_id, py_g2c_keys, py_g2c_types, py_g2c_ids,
+                       shape_keys, shapes, dtype_keys, dtype_vals, req_keys,
+                       req_vals, shared);
+  Py_DECREF(py_g2c_keys);
+  Py_DECREF(py_g2c_types);
+  Py_DECREF(py_g2c_ids);
+  Py_DECREF(shape_keys);
+  Py_DECREF(shapes);
+  Py_DECREF(dtype_keys);
+  Py_DECREF(dtype_vals);
+  Py_DECREF(req_keys);
+  Py_DECREF(req_vals);
+  if (!r) return Fail("MXExecutorSimpleBind");
+  PyObject *ex = PyTuple_GetItem(r, 0);
+  int rc = g_args_store.Fill(PyTuple_GetItem(r, 1), num_in_args, in_args,
+                             "SimpleBind in_args");
+  mx_uint ngrads = 0;
+  if (rc == 0)
+    rc = g_grads_store.Fill(PyTuple_GetItem(r, 2), &ngrads, arg_grads,
+                            "SimpleBind arg_grads");
+  if (rc == 0)
+    rc = g_aux_store.Fill(PyTuple_GetItem(r, 3), num_aux_states, aux_states,
+                          "SimpleBind aux_states");
+  if (rc == 0) {
+    Py_INCREF(ex);
+    *out = ex;
+  }
+  Py_DECREF(r);
+  return rc;
+}
+
+typedef void (*ExecutorMonitorCallback)(const char *, NDArrayHandle, void *);
+
+namespace {
+/* trampoline object: python calls back into the C monitor callback */
+struct MonitorCtx {
+  ExecutorMonitorCallback cb;
+  void *handle;
+};
+
+PyObject *MonitorTrampoline(PyObject *self, PyObject *args) {
+  const char *name;
+  PyObject *arr;
+  if (!PyArg_ParseTuple(args, "sO", &name, &arr)) return nullptr;
+  auto *ctx = static_cast<MonitorCtx *>(PyCapsule_GetPointer(self, nullptr));
+  Py_INCREF(arr); /* the callback side owns a handle (MX*Free contract) */
+  ctx->cb(name, arr, ctx->handle);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_monitor_def = {"_monitor_trampoline", MonitorTrampoline,
+                             METH_VARARGS, nullptr};
+}  // namespace
+
+MXAPI int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                       ExecutorMonitorCallback callback,
+                                       void *callback_handle) {
+  Gil gil;
+  auto *ctx = new MonitorCtx{callback, callback_handle};
+  PyObject *cap = PyCapsule_New(ctx, nullptr, nullptr);
+  PyObject *fn = PyCFunction_New(&g_monitor_def, cap);
+  Py_DECREF(cap);
+  PyObject *r = CallRt("exec_set_monitor_callback", "OOi",
+                       static_cast<PyObject *>(handle), fn, 0);
+  Py_DECREF(fn);
+  if (!r) return Fail("MXExecutorSetMonitorCallback");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                               NDArrayHandle *head_grads, int is_train) {
+  (void)is_train;
+  Gil gil;
+  PyObject *grads = HandleList(len, head_grads);
+  PyObject *r = CallRt("exec_backward", "OO",
+                       static_cast<PyObject *>(handle), grads);
+  Py_DECREF(grads);
+  if (!r) return Fail("MXExecutorBackwardEx");
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ====================================================================
+ * CachedOp (ref: c_api_ndarray.cc MXCreateCachedOp/MXInvokeCachedOp)
+ * ==================================================================== */
+MXAPI int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out) {
+  Gil gil;
+  return ReturnHandleX(CallRt("cachedop_create", "O",
+                              static_cast<PyObject *>(handle)),
+                       out, "MXCreateCachedOp");
+}
+
+MXAPI int MXCreateCachedOpEx(SymbolHandle handle, int num_flags,
+                             const char **keys, const char **vals,
+                             CachedOpHandle *out) {
+  (void)num_flags;
+  (void)keys;
+  (void)vals;
+  return MXCreateCachedOp(handle, out);
+}
+
+MXAPI int MXFreeCachedOp(CachedOpHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+MXAPI int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                           NDArrayHandle *inputs, int *num_outputs,
+                           NDArrayHandle **outputs) {
+  Gil gil;
+  PyObject *ins = HandleList(num_inputs, inputs);
+  PyObject *r = CallRt("cachedop_invoke", "OO",
+                       static_cast<PyObject *>(handle), ins);
+  Py_DECREF(ins);
+  if (!r) return Fail("MXInvokeCachedOp");
+  mx_uint n = 0;
+  int rc = g_iter_store.Fill(r, &n, outputs, "MXInvokeCachedOp");
+  *num_outputs = static_cast<int>(n);
+  Py_DECREF(r);
+  return rc;
+}
+
+MXAPI int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                             NDArrayHandle *inputs, int *num_outputs,
+                             NDArrayHandle **outputs,
+                             const int **out_stypes) {
+  static thread_local std::vector<int> stypes;
+  int rc = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
+                            outputs);
+  if (rc == 0 && out_stypes) {
+    stypes.assign(static_cast<size_t>(*num_outputs), 0);
+    *out_stypes = stypes.data();
+  }
+  return rc;
+}
+
+/* ====================================================================
+ * DataIter surface (ref: c_api.cc MXDataIter*)
+ * ==================================================================== */
+MXAPI int MXListDataIters(mx_uint *out_size, DataIterHandle **out_array) {
+  Gil gil;
+  PyObject *r = CallRt("di_list", "");
+  if (!r) return Fail("MXListDataIters");
+  /* creators are interned name strings (same scheme as op creators) */
+  static std::vector<std::string> names;
+  static std::vector<void *> ptrs;
+  names.clear();
+  ptrs.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(r, i)));
+  Py_DECREF(r);
+  for (auto &s : names) ptrs.push_back(const_cast<char *>(s.c_str()));
+  *out_size = static_cast<mx_uint>(ptrs.size());
+  *out_array = ptrs.data();
+  return 0;
+}
+
+MXAPI int MXDataIterGetIterInfo(DataIterHandle creator, const char **name,
+                                const char **description,
+                                mx_uint *num_args,
+                                const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions) {
+  Gil gil;
+  PyObject *r = CallRt("di_info", "s", static_cast<const char *>(creator));
+  if (!r) return Fail("MXDataIterGetIterInfo");
+  static thread_local std::string nm, desc;
+  nm = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  desc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  *name = nm.c_str();
+  *description = desc.c_str();
+  if (num_args) *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  return 0;
+}
+
+MXAPI int MXDataIterCreateIter(DataIterHandle creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               DataIterHandle *out) {
+  Gil gil;
+  PyObject *k = StrList(num_param, keys);
+  PyObject *v = StrList(num_param, vals);
+  PyObject *r = CallRt("di_create", "sOO",
+                       static_cast<const char *>(creator), k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  return ReturnHandleX(r, out, "MXDataIterCreateIter");
+}
+
+MXAPI int MXDataIterFree(DataIterHandle handle) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+MXAPI int MXDataIterNext(DataIterHandle handle, int *out) {
+  Gil gil;
+  PyObject *r = CallRt("di_next", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXDataIterNext");
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXDataIterBeforeFirst(DataIterHandle handle) {
+  Gil gil;
+  PyObject *r = CallRt("di_before_first", "O",
+                       static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXDataIterBeforeFirst");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  return ReturnHandleX(CallRt("di_get_data", "O",
+                              static_cast<PyObject *>(handle)),
+                       out, "MXDataIterGetData");
+}
+
+MXAPI int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  Gil gil;
+  return ReturnHandleX(CallRt("di_get_label", "O",
+                              static_cast<PyObject *>(handle)),
+                       out, "MXDataIterGetLabel");
+}
+
+MXAPI int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  Gil gil;
+  PyObject *r = CallRt("di_get_pad", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXDataIterGetPadNum");
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                             uint64_t *out_size) {
+  Gil gil;
+  PyObject *r = CallRt("di_get_index", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXDataIterGetIndex");
+  static thread_local std::vector<uint64_t> idx;
+  idx.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    idx.push_back(PyLong_AsUnsignedLongLong(PyList_GetItem(r, i)));
+  Py_DECREF(r);
+  *out_index = idx.data();
+  *out_size = idx.size();
+  return 0;
+}
+
+/* ====================================================================
+ * KVStore dist tail
+ * ==================================================================== */
+MXAPI int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                                 const int *keys, NDArrayHandle *vals,
+                                 const NDArrayHandle *row_ids,
+                                 int priority) {
+  Gil gil;
+  PyObject *k = IntList(num, keys);
+  PyObject *v = HandleList(num, vals);
+  PyObject *rids = HandleList(num, const_cast<NDArrayHandle *>(row_ids));
+  PyObject *r = CallRt("kv_pull_row_sparse", "OOOOi",
+                       static_cast<PyObject *>(handle), k, v, rids,
+                       priority);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  Py_DECREF(rids);
+  if (!r) return Fail("MXKVStorePullRowSparse");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                                   const char **keys, NDArrayHandle *vals,
+                                   const NDArrayHandle *row_ids,
+                                   int priority) {
+  Gil gil;
+  PyObject *k = StrList(num, keys);
+  PyObject *v = HandleList(num, vals);
+  PyObject *rids = HandleList(num, const_cast<NDArrayHandle *>(row_ids));
+  PyObject *r = CallRt("kv_pull_row_sparse", "OOOOi",
+                       static_cast<PyObject *>(handle), k, v, rids,
+                       priority);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  Py_DECREF(rids);
+  if (!r) return Fail("MXKVStorePullRowSparseEx");
+  Py_DECREF(r);
+  return 0;
+}
+
+typedef void (*MXKVStoreServerController)(int head, const char *body,
+                                          void *controller_handle);
+
+MXAPI int MXKVStoreRunServer(KVStoreHandle handle,
+                             MXKVStoreServerController controller,
+                             void *controller_handle) {
+  (void)controller;
+  (void)controller_handle;
+  Gil gil;
+  PyObject *r = CallRt("kv_run_server", "OO",
+                       static_cast<PyObject *>(handle), Py_None);
+  if (!r) return Fail("MXKVStoreRunServer");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                         const char *cmd_body) {
+  Gil gil;
+  PyObject *r = CallRt("kv_send_command", "Ois",
+                       static_cast<PyObject *>(handle), cmd_id, cmd_body);
+  if (!r) return Fail("MXKVStoreSendCommmandToServers");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreSetGradientCompression(KVStoreHandle handle,
+                                          mx_uint num_params,
+                                          const char **keys,
+                                          const char **vals) {
+  Gil gil;
+  PyObject *k = StrList(num_params, keys);
+  PyObject *v = StrList(num_params, vals);
+  PyObject *r = CallRt("kv_set_compression", "OOO",
+                       static_cast<PyObject *>(handle), k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!r) return Fail("MXKVStoreSetGradientCompression");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                        const int barrier_before_exit) {
+  Gil gil;
+  PyObject *r = CallRt("kv_barrier_before_exit", "Oi",
+                       static_cast<PyObject *>(handle), barrier_before_exit);
+  if (!r) return Fail("MXKVStoreSetBarrierBeforeExit");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreIsSchedulerNode(int *ret) {
+  Gil gil;
+  PyObject *r = CallRt("kv_is_scheduler", "");
+  if (!r) return Fail("MXKVStoreIsSchedulerNode");
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreIsServerNode(int *ret) {
+  Gil gil;
+  PyObject *r = CallRt("kv_is_server", "");
+  if (!r) return Fail("MXKVStoreIsServerNode");
+  *ret = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                                  int *number, const int timeout_sec) {
+  Gil gil;
+  PyObject *r = CallRt("kv_num_dead_node", "Oii",
+                       static_cast<PyObject *>(handle), node_id,
+                       timeout_sec);
+  if (!r) return Fail("MXKVStoreGetNumDeadNode");
+  *number = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXInitPSEnv(mx_uint num_vars, const char **keys,
+                      const char **vals) {
+  Gil gil;
+  PyObject *k = StrList(num_vars, keys);
+  PyObject *v = StrList(num_vars, vals);
+  PyObject *r = CallRt("init_ps_env", "OO", k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!r) return Fail("MXInitPSEnv");
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ====================================================================
+ * RecordIO (reference names over native/recordio.cc)
+ * ==================================================================== */
+MXAPI int MXRecordIOWriterCreate(const char *uri, RecordIOHandle_ *out) {
+  RecordIOHandle h;
+  if (MXTPURecordIOWriterCreate(uri, &h) != 0) {
+    LastError() = MXTPURecordIOGetLastError();
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
+MXAPI int MXRecordIOWriterFree(RecordIOHandle_ handle) {
+  return MXTPURecordIOWriterFree(static_cast<RecordIOHandle>(handle));
+}
+
+MXAPI int MXRecordIOWriterWriteRecord(RecordIOHandle_ handle,
+                                      const char *buf, size_t size) {
+  if (MXTPURecordIOWriterWrite(static_cast<RecordIOHandle>(handle), buf,
+                               size) != 0) {
+    LastError() = MXTPURecordIOGetLastError();
+    return -1;
+  }
+  return 0;
+}
+
+MXAPI int MXRecordIOWriterTell(RecordIOHandle_ handle, size_t *pos) {
+  return MXTPURecordIOWriterTell(static_cast<RecordIOHandle>(handle), pos);
+}
+
+MXAPI int MXRecordIOReaderCreate(const char *uri, RecordIOHandle_ *out) {
+  RecordIOHandle h;
+  if (MXTPURecordIOReaderCreate(uri, &h) != 0) {
+    LastError() = MXTPURecordIOGetLastError();
+    return -1;
+  }
+  *out = h;
+  return 0;
+}
+
+MXAPI int MXRecordIOReaderFree(RecordIOHandle_ handle) {
+  return MXTPURecordIOReaderFree(static_cast<RecordIOHandle>(handle));
+}
+
+MXAPI int MXRecordIOReaderReadRecord(RecordIOHandle_ handle,
+                                     char const **buf, size_t *size) {
+  int rc = MXTPURecordIOReaderRead(static_cast<RecordIOHandle>(handle), buf,
+                                   size);
+  if (rc < 0) {
+    LastError() = MXTPURecordIOGetLastError();
+    return -1;
+  }
+  if (rc == 0) { /* EOF → empty record (reference contract) */
+    *buf = nullptr;
+    *size = 0;
+  }
+  return 0;
+}
+
+MXAPI int MXRecordIOReaderSeek(RecordIOHandle_ handle, size_t pos) {
+  return MXTPURecordIOReaderSeek(static_cast<RecordIOHandle>(handle), pos);
+}
+
+MXAPI int MXRecordIOReaderTell(RecordIOHandle_ handle, size_t *pos) {
+  return MXTPURecordIOReaderTell(static_cast<RecordIOHandle>(handle), pos);
+}
+
+/* ====================================================================
+ * Profiler / engine / version / misc
+ * ==================================================================== */
+MXAPI int MXSetProfilerConfig(int num_params, const char *const *keys,
+                              const char *const *vals) {
+  Gil gil;
+  PyObject *k = StrList(num_params, const_cast<const char **>(keys));
+  PyObject *v = StrList(num_params, const_cast<const char **>(vals));
+  PyObject *r = CallRt("profiler_set_config", "OO", k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!r) return Fail("MXSetProfilerConfig");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXSetProfilerState(int state) {
+  Gil gil;
+  PyObject *r = CallRt("profiler_set_state", "i", state);
+  if (!r) return Fail("MXSetProfilerState");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXDumpProfile(int finished) {
+  Gil gil;
+  PyObject *r = CallRt("profiler_dump", "i", finished);
+  if (!r) return Fail("MXDumpProfile");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXEngineSetBulkSize(int bulk_size, int *prev_bulk_size) {
+  Gil gil;
+  PyObject *r = CallRt("engine_set_bulk_size", "i", bulk_size);
+  if (!r) return Fail("MXEngineSetBulkSize");
+  if (prev_bulk_size) *prev_bulk_size = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXSetNumOMPThreads(int thread_num) {
+  Gil gil;
+  PyObject *r = CallRt("set_omp_threads", "i", thread_num);
+  if (!r) return Fail("MXSetNumOMPThreads");
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ====================================================================
+ * Symbol tail
+ * ==================================================================== */
+MXAPI int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                           const char ***out) {
+  Gil gil;
+  PyObject *r = CallRt("sym_list_attr", "Oi",
+                       static_cast<PyObject *>(symbol), 0);
+  if (!r) return Fail("MXSymbolListAttr");
+  int rc = g_ext_str_store.Fill(r, out_size, out);
+  Py_DECREF(r);
+  if (rc == 0) *out_size /= 2; /* reference counts PAIRS */
+  return rc;
+}
+
+MXAPI int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                                  const char ***out) {
+  Gil gil;
+  PyObject *r = CallRt("sym_list_attr", "Oi",
+                       static_cast<PyObject *>(symbol), 1);
+  if (!r) return Fail("MXSymbolListAttrShallow");
+  int rc = g_ext_str_store.Fill(r, out_size, out);
+  Py_DECREF(r);
+  if (rc == 0) *out_size /= 2;
+  return rc;
+}
+
+MXAPI int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out) {
+  Gil gil;
+  return ReturnHandleX(CallRt("sym_get_children", "O",
+                              static_cast<PyObject *>(symbol)),
+                       out, "MXSymbolGetChildren");
+}
+
+MXAPI int MXSymbolGrad(SymbolHandle, mx_uint, const char **, SymbolHandle *) {
+  LastError() =
+      "MXSymbolGrad was deprecated before the reference v1.0 and is "
+      "unimplemented there too (src/c_api/c_api_symbolic.cc)";
+  return -1;
+}
+
+/* ====================================================================
+ * Legacy MXFunc surface: every imperative op doubles as a "function"
+ * (ref: c_api.cc MXListFunctions routes to the same op registry)
+ * ==================================================================== */
+MXAPI int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array) {
+  Gil gil;
+  PyObject *r = CallRt("op_names", "");
+  if (!r) return Fail("MXListFunctions");
+  static std::vector<std::string> names;
+  static std::vector<void *> ptrs;
+  names.clear();
+  ptrs.clear();
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(r, i);
+    names.emplace_back(PyUnicode_AsUTF8(it));
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  for (auto &s : names) ptrs.push_back(const_cast<char *>(s.c_str()));
+  *out_size = static_cast<mx_uint>(ptrs.size());
+  *out_array = ptrs.data();
+  return 0;
+}
+
+MXAPI int MXGetFunction(const char *name, FunctionHandle *out) {
+  Gil gil;
+  PyObject *r = CallRt("op_info", "s", name);
+  if (!r) return Fail("MXGetFunction");
+  Py_DECREF(r);
+  static std::vector<std::string> interned;
+  interned.emplace_back(name);
+  *out = const_cast<char *>(interned.back().c_str());
+  return 0;
+}
+
+MXAPI int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                        const char **description, mx_uint *num_args,
+                        const char ***arg_names,
+                        const char ***arg_type_infos,
+                        const char ***arg_descriptions,
+                        const char **return_type) {
+  Gil gil;
+  PyObject *r = CallRt("op_info", "s", static_cast<const char *>(fun));
+  if (!r) return Fail("MXFuncGetInfo");
+  static thread_local std::string nm, doc;
+  nm = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  doc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  mx_uint nargs = 0;
+  const char **anames = nullptr;
+  int rc = g_ext_str_store.Fill(PyTuple_GetItem(r, 2), &nargs, &anames);
+  Py_DECREF(r);
+  if (rc != 0) return rc;
+  *name = nm.c_str();
+  *description = doc.c_str();
+  if (num_args) *num_args = nargs;
+  if (arg_names) *arg_names = anames;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  if (return_type) *return_type = "";
+  return 0;
+}
+
+MXAPI int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                         mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                         int *type_mask) {
+  Gil gil;
+  PyObject *r = CallRt("op_info", "s", static_cast<const char *>(fun));
+  if (!r) return Fail("MXFuncDescribe");
+  Py_ssize_t nin = PySequence_Size(PyTuple_GetItem(r, 2));
+  Py_DECREF(r);
+  *num_use_vars = static_cast<mx_uint>(nin);
+  *num_scalars = 0;
+  *num_mutate_vars = 1;
+  *type_mask = 0;
+  return 0;
+}
+
+MXAPI int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                       mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                       mx_uint num_use_vars, mx_uint num_scalars,
+                       mx_uint num_mutate_vars) {
+  (void)scalar_args;
+  (void)num_scalars;
+  Gil gil;
+  PyObject *ins = HandleList(num_use_vars, use_vars);
+  PyObject *outs = HandleList(num_mutate_vars, mutate_vars);
+  PyObject *empty = PyList_New(0);
+  PyObject *r = CallRt("imperative_invoke", "sOOOO",
+                       static_cast<const char *>(fun), ins, empty, empty,
+                       outs);
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+  Py_DECREF(empty);
+  if (!r) return Fail("MXFuncInvoke");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                         mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                         mx_uint num_use_vars, mx_uint num_scalars,
+                         mx_uint num_mutate_vars, int num_params,
+                         char **param_keys, char **param_vals) {
+  (void)scalar_args;
+  (void)num_scalars;
+  Gil gil;
+  PyObject *ins = HandleList(num_use_vars, use_vars);
+  PyObject *outs = HandleList(num_mutate_vars, mutate_vars);
+  PyObject *keys = StrList(num_params, const_cast<const char **>(param_keys));
+  PyObject *vals = StrList(num_params, const_cast<const char **>(param_vals));
+  PyObject *r = CallRt("imperative_invoke", "sOOOO",
+                       static_cast<const char *>(fun), ins, keys, vals,
+                       outs);
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  if (!r) return Fail("MXFuncInvokeEx");
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ====================================================================
+ * RTC: CUDA-only in the reference — without USE_CUDA the reference
+ * errors at exactly these entry points, so honest error stubs ARE the
+ * parity behavior (ref: src/common/rtc.cc guarded by MXNET_USE_CUDA;
+ * the TPU path is rtc.py PallasModule).
+ * ==================================================================== */
+#define RTC_STUB(name, sig)                                              \
+  MXAPI int name sig {                                                   \
+    LastError() = #name                                                  \
+        ": CUDA RTC is not available on the TPU build (the reference "   \
+        "errors identically without USE_CUDA); use mxnet_tpu.rtc."       \
+        "PallasModule for runtime TPU kernels";                          \
+    return -1;                                                           \
+  }
+
+RTC_STUB(MXRtcCreate, (char *, mx_uint, mx_uint, char **, char **,
+                       NDArrayHandle *, NDArrayHandle *, char *, void **))
+RTC_STUB(MXRtcPush, (void *, mx_uint, mx_uint, NDArrayHandle *,
+                     NDArrayHandle *, mx_uint, mx_uint, mx_uint, mx_uint,
+                     mx_uint, mx_uint))
+RTC_STUB(MXRtcFree, (void *))
+RTC_STUB(MXRtcCudaModuleCreate, (const char *, int, const char **, void **))
+RTC_STUB(MXRtcCudaModuleFree, (void *))
+RTC_STUB(MXRtcCudaKernelCreate, (void *, const char *, int, int *, int *,
+                                 int *, void **))
+RTC_STUB(MXRtcCudaKernelFree, (void *))
+RTC_STUB(MXRtcCudaKernelCall, (void *, int, void **, mx_uint, mx_uint,
+                               mx_uint, mx_uint, mx_uint, mx_uint))
+
+/* shared-memory NDArray surface: POSIX shm is the gluon mp dataloader's
+ * transport (cpu_shared_storage_manager.h); the TPU build ships batches
+ * through python multiprocessing.shared_memory instead, so the C hooks
+ * error with that pointer (reference behavior without shm support). */
+MXAPI int MXNDArrayCreateFromSharedMem(int, int, const mx_uint *, mx_uint,
+                                       int, NDArrayHandle *) {
+  LastError() = "MXNDArrayCreateFromSharedMem: shared-memory NDArrays ride "
+                "multiprocessing.shared_memory in this build "
+                "(gluon/data/dataloader.py)";
+  return -1;
+}
+
+MXAPI int MXNDArrayGetSharedMemHandle(NDArrayHandle, int *, int *) {
+  LastError() = "MXNDArrayGetSharedMemHandle: see MXNDArrayCreateFromSharedMem";
+  return -1;
+}
+
+/* ====================================================================
+ * Custom op registration (ref: src/c_api/c_api_function.cc;
+ * enums/typedefs from include/mxnet/c_api.h:130-171)
+ * ==================================================================== */
+extern "C" {
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void **contexts;
+};
+}
+
+enum CustomOpCallbacks { kCustomOpDelete, kCustomOpForward, kCustomOpBackward };
+enum CustomOpPropCallbacks {
+  kCustomOpPropDelete,
+  kCustomOpPropListArguments,
+  kCustomOpPropListOutputs,
+  kCustomOpPropListAuxiliaryStates,
+  kCustomOpPropInferShape,
+  kCustomOpPropDeclareBackwardDependency,
+  kCustomOpPropCreateOperator,
+  kCustomOpPropInferType
+};
+
+typedef int (*CustomOpFBFunc)(int, void **, int *, int *, int, void *);
+typedef int (*CustomOpDelFunc)(void *);
+typedef int (*CustomOpListFunc)(char ***, void *);
+typedef int (*CustomOpInferShapeFunc)(int, int *, unsigned **, void *);
+typedef int (*CustomOpInferTypeFunc)(int, int *, void *);
+typedef int (*CustomOpCreateFunc)(const char *, int, unsigned **, const int *,
+                                  const int *, struct MXCallbackList *,
+                                  void *);
+typedef int (*CustomOpPropCreator)(const char *, const int, const char **,
+                                   const char **, struct MXCallbackList *);
+
+namespace {
+
+struct CustomProp {
+  MXCallbackList cbs{};
+  template <typename F>
+  F get(int idx) const {
+    if (idx >= cbs.num_callbacks) return nullptr;
+    return reinterpret_cast<F>(cbs.callbacks[idx]);
+  }
+  void *ctx(int idx) const {
+    return idx < cbs.num_callbacks ? cbs.contexts[idx] : nullptr;
+  }
+};
+
+/* python-callable facade over one registered prop instance */
+PyObject *PropTrampoline(PyObject *self, PyObject *args) {
+  auto *prop = static_cast<CustomProp *>(PyCapsule_GetPointer(self, nullptr));
+  const char *what;
+  PyObject *payload = nullptr;
+  if (!PyArg_ParseTuple(args, "s|O", &what, &payload)) return nullptr;
+
+  if (std::strcmp(what, "list_arguments") == 0 ||
+      std::strcmp(what, "list_outputs") == 0 ||
+      std::strcmp(what, "list_aux") == 0) {
+    int idx = std::strcmp(what, "list_arguments") == 0
+                  ? kCustomOpPropListArguments
+                  : std::strcmp(what, "list_outputs") == 0
+                        ? kCustomOpPropListOutputs
+                        : kCustomOpPropListAuxiliaryStates;
+    auto fn = prop->get<CustomOpListFunc>(idx);
+    PyObject *lst = PyList_New(0);
+    if (fn) {
+      char **names = nullptr;
+      if (fn(&names, prop->ctx(idx)) == 0 || names) {
+        for (char **p = names; p && *p; ++p) {
+          PyObject *s = PyUnicode_FromString(*p);
+          PyList_Append(lst, s);
+          Py_DECREF(s);
+        }
+      }
+    }
+    return lst;
+  }
+
+  if (std::strcmp(what, "infer_shape") == 0) {
+    /* payload: list of input shape tuples; the C callback mutates the
+     * full ndims/shapes array covering inputs+outputs+aux */
+    auto fn = prop->get<CustomOpInferShapeFunc>(kCustomOpPropInferShape);
+    if (!fn) Py_RETURN_NONE;
+    Py_ssize_t total = PyList_Size(payload);
+    std::vector<int> ndims(total, 0);
+    std::vector<std::vector<unsigned>> store(total);
+    std::vector<unsigned *> ptrs(total, nullptr);
+    for (Py_ssize_t i = 0; i < total; ++i) {
+      PyObject *t = PyList_GetItem(payload, i);
+      if (t == Py_None) continue;
+      Py_ssize_t nd = PyTuple_Size(t);
+      ndims[i] = static_cast<int>(nd);
+      for (Py_ssize_t d = 0; d < nd; ++d)
+        store[i].push_back(static_cast<unsigned>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(t, d))));
+      ptrs[i] = store[i].data();
+    }
+    if (fn(static_cast<int>(total), ndims.data(), ptrs.data(),
+           prop->ctx(kCustomOpPropInferShape)) != 0) {
+      PyErr_SetString(PyExc_RuntimeError, "custom op infer_shape failed");
+      return nullptr;
+    }
+    PyObject *res = PyList_New(total);
+    for (Py_ssize_t i = 0; i < total; ++i) {
+      PyObject *t = PyTuple_New(ndims[i]);
+      for (int d = 0; d < ndims[i]; ++d)
+        PyTuple_SetItem(t, d, PyLong_FromUnsignedLong(ptrs[i][d]));
+      PyList_SetItem(res, i, t);
+    }
+    return res;
+  }
+
+  if (std::strcmp(what, "create_operator") == 0) {
+    /* payload: list of input shape tuples → returns a capsule holding
+     * the operator's MXCallbackList */
+    auto fn = prop->get<CustomOpCreateFunc>(kCustomOpPropCreateOperator);
+    if (!fn) {
+      PyErr_SetString(PyExc_RuntimeError, "no create_operator callback");
+      return nullptr;
+    }
+    Py_ssize_t n = PyList_Size(payload);
+    std::vector<int> ndims(n, 0);
+    std::vector<std::vector<unsigned>> store(n);
+    std::vector<unsigned *> ptrs(n, nullptr);
+    std::vector<int> dtypes(n, 0);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *t = PyList_GetItem(payload, i);
+      Py_ssize_t nd = PyTuple_Size(t);
+      ndims[i] = static_cast<int>(nd);
+      for (Py_ssize_t d = 0; d < nd; ++d)
+        store[i].push_back(static_cast<unsigned>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(t, d))));
+      ptrs[i] = store[i].data();
+    }
+    auto *op = new CustomProp();
+    if (fn("cpu", static_cast<int>(n), ptrs.data(), ndims.data(),
+           dtypes.data(), &op->cbs,
+           prop->ctx(kCustomOpPropCreateOperator)) != 0) {
+      delete op;
+      PyErr_SetString(PyExc_RuntimeError, "create_operator failed");
+      return nullptr;
+    }
+    return PyCapsule_New(op, "mxtpu.custom_op", nullptr);
+  }
+
+  if (std::strcmp(what, "forward") == 0 ||
+      std::strcmp(what, "backward") == 0) {
+    /* payload: (op_capsule, [NDArray handles], [tags], is_train);
+     * tags: reference kData tag ints, caller-assigned */
+    PyObject *cap;
+    PyObject *arrs;
+    PyObject *tags;
+    int is_train;
+    if (!PyArg_ParseTuple(payload, "OOOi", &cap, &arrs, &tags, &is_train))
+      return nullptr;
+    auto *op = static_cast<CustomProp *>(
+        PyCapsule_GetPointer(cap, "mxtpu.custom_op"));
+    int which = std::strcmp(what, "forward") == 0 ? kCustomOpForward
+                                                  : kCustomOpBackward;
+    auto fn = op->get<CustomOpFBFunc>(which);
+    if (!fn) {
+      PyErr_SetString(PyExc_RuntimeError, "callback not registered");
+      return nullptr;
+    }
+    Py_ssize_t n = PyList_Size(arrs);
+    std::vector<void *> ptrs(n);
+    std::vector<int> tagv(n), reqs(n, 1 /* kWriteTo */);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *a = PyList_GetItem(arrs, i);
+      Py_INCREF(a); /* callback side may hold it; we re-own below */
+      ptrs[i] = a;
+      tagv[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(tags, i)));
+    }
+    int rc = fn(static_cast<int>(n), ptrs.data(), tagv.data(), reqs.data(),
+                is_train, op->ctx(which));
+    for (Py_ssize_t i = 0; i < n; ++i)
+      Py_DECREF(static_cast<PyObject *>(ptrs[i]));
+    if (rc != 0) {
+      PyErr_SetString(PyExc_RuntimeError, "custom op callback failed");
+      return nullptr;
+    }
+    Py_RETURN_NONE;
+  }
+
+  PyErr_Format(PyExc_ValueError, "unknown custom-op query %s", what);
+  return nullptr;
+}
+
+PyMethodDef g_prop_def = {"_custom_prop", PropTrampoline, METH_VARARGS,
+                          nullptr};
+
+}  // namespace
+
+MXAPI int MXCustomOpRegister(const char *op_type,
+                             CustomOpPropCreator creator) {
+  Gil gil;
+  auto *prop = new CustomProp();
+  if (creator(op_type, 0, nullptr, nullptr, &prop->cbs) != 0) {
+    delete prop;
+    LastError() = "MXCustomOpRegister: creator callback failed";
+    return -1;
+  }
+  PyObject *cap = PyCapsule_New(prop, nullptr, nullptr);
+  PyObject *fn = PyCFunction_New(&g_prop_def, cap);
+  Py_DECREF(cap);
+  PyObject *r = CallRt("custom_op_register", "sO", op_type, fn);
+  Py_DECREF(fn);
+  if (!r) return Fail("MXCustomOpRegister");
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ====================================================================
+ * Last reference-name stragglers
+ * ==================================================================== */
+MXAPI int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                               NDArrayHandle *inputs, int *num_outputs,
+                               NDArrayHandle **outputs, int num_params,
+                               const char **param_keys,
+                               const char **param_vals,
+                               const int **out_stypes) {
+  extern int MXImperativeInvoke(AtomicSymbolCreator, int, NDArrayHandle *,
+                                int *, NDArrayHandle **, int, const char **,
+                                const char **);
+  int rc = MXImperativeInvoke(creator, num_inputs, inputs, num_outputs,
+                              outputs, num_params, param_keys, param_vals);
+  if (rc == 0 && out_stypes) {
+    static thread_local std::vector<int> stypes;
+    stypes.assign(static_cast<size_t>(*num_outputs), 0 /* dense */);
+    *out_stypes = stypes.data();
+  }
+  return rc;
+}
+
+typedef void (*MXKVStoreUpdater_)(int, NDArrayHandle, NDArrayHandle, void *);
+typedef void (*MXKVStoreStrUpdater_)(const char *, NDArrayHandle,
+                                     NDArrayHandle, void *);
+
+namespace {
+struct StrUpdaterCtx {
+  MXKVStoreStrUpdater_ cb;
+  void *handle;
+};
+
+PyObject *StrUpdaterTrampoline(PyObject *self, PyObject *args) {
+  PyObject *key;
+  PyObject *recv;
+  PyObject *local;
+  if (!PyArg_ParseTuple(args, "OOO", &key, &recv, &local)) return nullptr;
+  auto *ctx =
+      static_cast<StrUpdaterCtx *>(PyCapsule_GetPointer(self, nullptr));
+  PyObject *key_str = PyObject_Str(key);
+  Py_INCREF(recv);
+  Py_INCREF(local);
+  ctx->cb(PyUnicode_AsUTF8(key_str), recv, local, ctx->handle);
+  Py_DECREF(key_str);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_str_updater_def = {"_str_updater", StrUpdaterTrampoline,
+                                 METH_VARARGS, nullptr};
+}  // namespace
+
+MXAPI int MXKVStoreSetUpdaterEx(KVStoreHandle handle,
+                                MXKVStoreUpdater_ updater,
+                                MXKVStoreStrUpdater_ str_updater,
+                                void *updater_handle) {
+  (void)updater;  /* the string form subsumes int keys via str(key) */
+  Gil gil;
+  auto *ctx = new StrUpdaterCtx{str_updater, updater_handle};
+  PyObject *cap = PyCapsule_New(ctx, nullptr, nullptr);
+  PyObject *fn = PyCFunction_New(&g_str_updater_def, cap);
+  Py_DECREF(cap);
+  PyObject *r = CallRt("kv_set_updater", "OO",
+                       static_cast<PyObject *>(handle), fn);
+  Py_DECREF(fn);
+  if (!r) return Fail("MXKVStoreSetUpdaterEx");
+  Py_DECREF(r);
+  return 0;
+}
+
+MXAPI int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
+  /* reference contract: a pointer into the array's CPU memory.  XLA
+   * owns device buffers, so this returns a thread-local host mirror —
+   * valid until the next MXNDArrayGetData on this thread; mutations do
+   * NOT write back (use MXNDArraySyncCopyFromCPU to write). */
+  Gil gil;
+  PyObject *r = CallRt("nd_tobytes", "O", static_cast<PyObject *>(handle));
+  if (!r) return Fail("MXNDArrayGetData");
+  char *buf;
+  Py_ssize_t len;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return Fail("MXNDArrayGetData");
+  }
+  static thread_local std::string mirror;
+  mirror.assign(buf, len);
+  Py_DECREF(r);
+  *out_pdata = mirror.data();
+  return 0;
+}
+
+MXAPI int MXAutogradGetSymbol(NDArrayHandle, SymbolHandle *) {
+  LastError() =
+      "MXAutogradGetSymbol: the TPU build's autograd tape records jax "
+      "vjp closures, not nnvm nodes; export graphs via gluon "
+      "HybridBlock.export / Symbol JSON instead";
+  return -1;
+}
+
+typedef int (*CustomFunctionBwdFunc_)(int, int, void **, const int *,
+                                      const int, void *);
+typedef int (*CustomFunctionDelFunc_)(void *);
+
+MXAPI int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                                 int num_outputs, NDArrayHandle *outputs,
+                                 struct MXCallbackList *callbacks) {
+  (void)num_inputs;
+  (void)inputs;
+  (void)num_outputs;
+  (void)outputs;
+  (void)callbacks;
+  LastError() =
+      "MXCustomFunctionRecord: C-side autograd Functions are not wired "
+      "in this build; use mxnet_tpu.autograd.Function (python) or a "
+      "registered custom op (MXCustomOpRegister)";
+  return -1;
+}

@@ -1,0 +1,230 @@
+/* Pure-C exercise of the GENERAL ABI (no Python in this translation
+ * unit): NDArray create/copy, op registry, imperative invoke, and a
+ * C-implemented custom operator registered through the reference
+ * CustomOpPropCreator callback protocol (include/mxnet/c_api.h:130-171,
+ * src/c_api/c_api_function.cc) then run via Custom(op_type=...).
+ *
+ * The predict ABI already has such a test (test_predict_api.c); this is
+ * its general-ABI sibling. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef unsigned int mx_uint;
+typedef void *NDArrayHandle;
+typedef void *AtomicSymbolCreator;
+
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void **contexts;
+};
+
+enum CustomOpCallbacks { kCustomOpDelete, kCustomOpForward, kCustomOpBackward };
+enum CustomOpPropCallbacks {
+  kCustomOpPropDelete,
+  kCustomOpPropListArguments,
+  kCustomOpPropListOutputs,
+  kCustomOpPropListAuxiliaryStates,
+  kCustomOpPropInferShape,
+  kCustomOpPropDeclareBackwardDependency,
+  kCustomOpPropCreateOperator,
+  kCustomOpPropInferType
+};
+
+extern int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                             int dev_id, int delay_alloc, int dtype,
+                             NDArrayHandle *out);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void *data,
+                                    size_t size);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data, size_t size);
+extern int MXNDArrayGetShape(NDArrayHandle h, mx_uint *out_dim,
+                             const mx_uint **out_pdata);
+extern int MXNDArrayFree(NDArrayHandle h);
+extern int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                            AtomicSymbolCreator **out_array);
+extern int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                       const char **name);
+extern int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                              NDArrayHandle *inputs, int *num_outputs,
+                              NDArrayHandle **outputs, int num_params,
+                              const char **param_keys,
+                              const char **param_vals);
+extern int MXCustomOpRegister(const char *op_type, void *creator);
+extern int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+extern const char *MXGetLastError(void);
+
+#define CHK(call)                                                     \
+  do {                                                                \
+    if ((call) != 0) {                                                \
+      fprintf(stderr, "FAIL %s: %s\n", #call, MXGetLastError());      \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+/* ---- the custom op: y = 3 * x --------------------------------------- */
+static int list_args(char ***out, void *state) {
+  static char *names[] = {(char *)"data", NULL};
+  (void)state;
+  *out = names;
+  return 0;
+}
+
+static int list_outputs(char ***out, void *state) {
+  static char *names[] = {(char *)"output", NULL};
+  (void)state;
+  *out = names;
+  return 0;
+}
+
+static int infer_shape(int num_input, int *ndims, unsigned **shapes,
+                       void *state) {
+  (void)state;
+  /* output (index 1) matches input (index 0) */
+  if (num_input >= 2) {
+    ndims[1] = ndims[0];
+    shapes[1] = shapes[0];
+  }
+  return 0;
+}
+
+static int op_forward(int size, void **ptrs, int *tags, int *reqs,
+                      int is_train, void *state) {
+  (void)reqs;
+  (void)is_train;
+  (void)state;
+  NDArrayHandle in = NULL, out = NULL;
+  int i;
+  for (i = 0; i < size; ++i) {
+    if (tags[i] == 0) in = ptrs[i];
+    if (tags[i] == 1) out = ptrs[i];
+  }
+  if (!in || !out) return -1;
+  mx_uint nd;
+  const mx_uint *shp;
+  if (MXNDArrayGetShape(in, &nd, &shp) != 0) return -1;
+  size_t n = 1;
+  for (mx_uint d = 0; d < nd; ++d) n *= shp[d];
+  float *buf = (float *)malloc(n * sizeof(float));
+  if (MXNDArraySyncCopyToCPU(in, buf, n) != 0) return -1;
+  for (size_t k = 0; k < n; ++k) buf[k] *= 3.0f;
+  if (MXNDArraySyncCopyFromCPU(out, buf, n) != 0) return -1;
+  free(buf);
+  return 0;
+}
+
+static int op_delete(void *state) {
+  (void)state;
+  return 0;
+}
+
+static int create_operator(const char *ctx, int num_inputs, unsigned **shapes,
+                           const int *ndims, const int *dtypes,
+                           struct MXCallbackList *ret, void *state) {
+  (void)ctx;
+  (void)num_inputs;
+  (void)shapes;
+  (void)ndims;
+  (void)dtypes;
+  (void)state;
+  static int (*cbs[3])(void);
+  static void *ctxs[3];
+  cbs[kCustomOpDelete] = (int (*)(void))op_delete;
+  cbs[kCustomOpForward] = (int (*)(void))op_forward;
+  cbs[kCustomOpBackward] = NULL;
+  ret->num_callbacks = 2; /* delete + forward */
+  ret->callbacks = cbs;
+  ret->contexts = ctxs;
+  return 0;
+}
+
+static int prop_creator(const char *op_type, const int num_kwargs,
+                        const char **keys, const char **values,
+                        struct MXCallbackList *ret) {
+  (void)op_type;
+  (void)num_kwargs;
+  (void)keys;
+  (void)values;
+  static int (*cbs[8])(void);
+  static void *ctxs[8];
+  memset(cbs, 0, sizeof(cbs));
+  cbs[kCustomOpPropListArguments] = (int (*)(void))list_args;
+  cbs[kCustomOpPropListOutputs] = (int (*)(void))list_outputs;
+  cbs[kCustomOpPropInferShape] = (int (*)(void))infer_shape;
+  cbs[kCustomOpPropCreateOperator] = (int (*)(void))create_operator;
+  ret->num_callbacks = 8;
+  ret->callbacks = cbs;
+  ret->contexts = ctxs;
+  return 0;
+}
+
+static AtomicSymbolCreator find_creator(const char *want) {
+  mx_uint n;
+  AtomicSymbolCreator *arr;
+  if (MXSymbolListAtomicSymbolCreators(&n, &arr) != 0) return NULL;
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *name;
+    if (MXSymbolGetAtomicSymbolName(arr[i], &name) != 0) continue;
+    if (strcmp(name, want) == 0) return arr[i];
+  }
+  return NULL;
+}
+
+int main(void) {
+  /* registry sanity through the pure-C surface */
+  mx_uint n_ops;
+  const char **op_names;
+  CHK(MXListAllOpNames(&n_ops, &op_names));
+  if (n_ops < 200) {
+    fprintf(stderr, "FAIL: only %u ops\n", n_ops);
+    return 1;
+  }
+
+  /* plain imperative op: y = x + 1 */
+  mx_uint shape[1] = {4};
+  NDArrayHandle x;
+  CHK(MXNDArrayCreateEx(shape, 1, 1, 0, 0, 0, &x));
+  float vals[4] = {1, 2, 3, 4};
+  CHK(MXNDArraySyncCopyFromCPU(x, vals, 4));
+  AtomicSymbolCreator plus = find_creator("_plus_scalar");
+  if (!plus) {
+    fprintf(stderr, "FAIL: _plus_scalar not found\n");
+    return 1;
+  }
+  int n_out = 0;
+  NDArrayHandle *outs = NULL;
+  const char *pk[1] = {"scalar"};
+  const char *pv[1] = {"1.0"};
+  CHK(MXImperativeInvoke(plus, 1, &x, &n_out, &outs, 1, pk, pv));
+  float got[4];
+  CHK(MXNDArraySyncCopyToCPU(outs[0], got, 4));
+  for (int i = 0; i < 4; ++i) {
+    if (got[i] != vals[i] + 1.0f) {
+      fprintf(stderr, "FAIL plus_scalar: got %f\n", got[i]);
+      return 1;
+    }
+  }
+
+  /* C custom op through the reference protocol */
+  CHK(MXCustomOpRegister("cscale3", (void *)prop_creator));
+  AtomicSymbolCreator custom = find_creator("Custom");
+  if (!custom) {
+    fprintf(stderr, "FAIL: Custom op not found\n");
+    return 1;
+  }
+  int n_out2 = 0;
+  NDArrayHandle *outs2 = NULL;
+  const char *ck[1] = {"op_type"};
+  const char *cv[1] = {"cscale3"};
+  CHK(MXImperativeInvoke(custom, 1, &x, &n_out2, &outs2, 1, ck, cv));
+  CHK(MXNDArraySyncCopyToCPU(outs2[0], got, 4));
+  for (int i = 0; i < 4; ++i) {
+    if (got[i] != vals[i] * 3.0f) {
+      fprintf(stderr, "FAIL custom op: got %f want %f\n", got[i],
+              vals[i] * 3.0f);
+      return 1;
+    }
+  }
+  printf("PASS\n");
+  return 0;
+}

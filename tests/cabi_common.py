@@ -15,7 +15,8 @@ def ensure_lib() -> str:
     """(Re)build libmxnet_tpu.so when any source is newer."""
     lib = os.path.join(NATIVE, "libmxnet_tpu.so")
     srcs = [os.path.join(NATIVE, f) for f in
-            ("c_predict_api.cc", "c_api.cc", "embed_common.h")]
+            ("c_predict_api.cc", "c_api.cc", "c_api_ext.cc",
+             "recordio.cc", "embed_common.h")]
     if not os.path.exists(lib) or any(
             os.path.getmtime(lib) < os.path.getmtime(s) for s in srcs):
         subprocess.run(["sh", os.path.join(NATIVE, "build_cabi.sh")],

@@ -286,3 +286,247 @@ def test_cpp_frontend_trains_mnist(tmp_path):
                           timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Round-3 tail: autograd, SimpleBind, DataIter, CachedOp, recordio,
+# profiler/engine/misc, sparse-tail, custom-op registration
+# ---------------------------------------------------------------------------
+def test_autograd_family(lib):
+    """MXAutograd*: record an op, backward, read the grad."""
+    prev = C.c_int()
+    chk(lib, lib.MXAutogradSetIsRecording(1, C.byref(prev)))
+    x = _nd(lib, (4,), np.array([1.0, 2.0, 3.0, 4.0]))
+    g = _nd(lib, (4,), np.zeros(4))
+    reqs = (mx_uint * 1)(1)  # write
+    chk(lib, lib.MXAutogradMarkVariables(
+        1, (C.c_void_p * 1)(x), reqs, (C.c_void_p * 1)(g)))
+    # y = x * x via imperative invoke while recording
+    creator = _creator(lib, b"square")
+    n_out = C.c_int(0)
+    outs = C.POINTER(C.c_void_p)()
+    chk(lib, lib.MXImperativeInvoke(creator, 1, (C.c_void_p * 1)(x),
+                                    C.byref(n_out), C.byref(outs), 0,
+                                    None, None))
+    y = C.c_void_p(outs[0])
+    chk(lib, lib.MXAutogradBackwardEx(
+        1, (C.c_void_p * 1)(y), (C.c_void_p * 1)(None), 0, None, 0, 0, 1,
+        None, None))
+    chk(lib, lib.MXAutogradSetIsRecording(0, C.byref(prev)))
+    gh = C.c_void_p()
+    chk(lib, lib.MXNDArrayGetGrad(x, C.byref(gh)))
+    assert gh.value, "no grad attached"
+    np.testing.assert_allclose(_to_np(lib, gh, (4,)),
+                               2 * np.array([1.0, 2.0, 3.0, 4.0]))
+    rec = C.c_bool()
+    chk(lib, lib.MXAutogradIsRecording(C.byref(rec)))
+    assert not rec.value
+
+
+def test_simple_bind_and_backward(lib):
+    """MXExecutorSimpleBind: the reference bindings' entry — bind an MLP
+    by shapes only, forward, backward, read a gradient."""
+    sym = _mlp_symbol(lib)
+    shape_names = (C.c_char_p * 1)(b"data")
+    shape_data = (mx_uint * 2)(8, 4)
+    shape_idx = (mx_uint * 2)(0, 2)
+    n_in = mx_uint()
+    in_args = C.POINTER(C.c_void_p)()
+    arg_grads = C.POINTER(C.c_void_p)()
+    n_aux = mx_uint()
+    aux = C.POINTER(C.c_void_p)()
+    ex = C.c_void_p()
+    shared_len = C.c_int(0)
+    chk(lib, lib.MXExecutorSimpleBind(
+        sym, 1, 0,                      # cpu(0)
+        0, None, None, None,            # no group2ctx
+        0, None, None,                  # default grad_req
+        1, shape_names, shape_data, shape_idx,
+        0, None, None,                  # no dtypes
+        0, None, None,                  # no stypes
+        0, None, C.byref(shared_len), None, None, None, None,
+        C.byref(n_in), C.byref(in_args), C.byref(arg_grads),
+        C.byref(n_aux), C.byref(aux), None, C.byref(ex)))
+    assert ex.value and n_in.value >= 3
+    # fill data + params then forward/backward
+    rng = np.random.RandomState(0)
+    for i in range(n_in.value):
+        dims = mx_uint()
+        pshape = C.POINTER(mx_uint)()
+        chk(lib, lib.MXNDArrayGetShape(C.c_void_p(in_args[i]),
+                                       C.byref(dims), C.byref(pshape)))
+        shp = tuple(pshape[d] for d in range(dims.value))
+        buf = rng.randn(*shp).astype(np.float32).ravel()
+        chk(lib, lib.MXNDArraySyncCopyFromCPU(
+            C.c_void_p(in_args[i]), buf.ctypes.data_as(C.c_void_p),
+            C.c_size_t(buf.size)))
+    chk(lib, lib.MXExecutorForward(ex, 1))
+    chk(lib, lib.MXExecutorBackwardEx(ex, 0, None, 1))
+    assert arg_grads[1], "weight grad missing"
+    gdims = mx_uint()
+    gshape = C.POINTER(mx_uint)()
+    chk(lib, lib.MXNDArrayGetShape(C.c_void_p(arg_grads[1]),
+                                   C.byref(gdims), C.byref(gshape)))
+    gr = _to_np(lib, C.c_void_p(arg_grads[1]),
+                tuple(gshape[d] for d in range(gdims.value)))
+    assert np.abs(gr).sum() > 0
+    chk(lib, lib.MXExecutorFree(ex))
+
+
+def _mlp_symbol(lib):
+    var = C.c_void_p()
+    chk(lib, lib.MXSymbolCreateVariable(b"data", C.byref(var)))
+    fc_creator = _creator(lib, b"FullyConnected")
+    fc = C.c_void_p()
+    chk(lib, lib.MXSymbolCreateAtomicSymbol(
+        fc_creator, 1, (C.c_char_p * 1)(b"num_hidden"),
+        (C.c_char_p * 1)(b"4"), C.byref(fc)))
+    chk(lib, lib.MXSymbolCompose(fc, b"fc", 1, (C.c_char_p * 1)(b"data"),
+                                 (C.c_void_p * 1)(var)))
+    sm_creator = _creator(lib, b"SoftmaxOutput")
+    sm = C.c_void_p()
+    chk(lib, lib.MXSymbolCreateAtomicSymbol(sm_creator, 0, None, None,
+                                            C.byref(sm)))
+    chk(lib, lib.MXSymbolCompose(sm, b"softmax", 1,
+                                 (C.c_char_p * 1)(b"data"),
+                                 (C.c_void_p * 1)(fc)))
+    return sm
+
+
+def test_dataiter_family(lib, tmp_path):
+    """MXDataIter*: list, create an NDArray-free iterator (MNISTIter
+    synthesizes data when files are absent), iterate, read batches."""
+    n = mx_uint()
+    iters = C.POINTER(C.c_void_p)()
+    chk(lib, lib.MXListDataIters(C.byref(n), C.byref(iters)))
+    names = []
+    for i in range(n.value):
+        nm = C.c_char_p()
+        desc = C.c_char_p()
+        na = mx_uint()
+        chk(lib, lib.MXDataIterGetIterInfo(
+            C.c_void_p(iters[i]), C.byref(nm), C.byref(desc),
+            C.byref(na), None, None, None))
+        names.append(nm.value.decode())
+    assert "MNISTIter" in names and "ImageRecordIter" in names
+    idx = names.index("MNISTIter")
+    keys = (C.c_char_p * 3)(b"batch_size", b"image", b"label")
+    vals = (C.c_char_p * 3)(
+        b"8", str(tmp_path / "absent-images").encode(),
+        str(tmp_path / "absent-labels").encode())
+    it = C.c_void_p()
+    chk(lib, lib.MXDataIterCreateIter(C.c_void_p(iters[idx]), 3, keys,
+                                      vals, C.byref(it)))
+    seen = 0
+    has = C.c_int()
+    chk(lib, lib.MXDataIterNext(it, C.byref(has)))
+    while has.value:
+        d = C.c_void_p()
+        chk(lib, lib.MXDataIterGetData(it, C.byref(d)))
+        dims = mx_uint()
+        shp = C.POINTER(mx_uint)()
+        chk(lib, lib.MXNDArrayGetShape(d, C.byref(dims), C.byref(shp)))
+        assert shp[0] == 8
+        lab = C.c_void_p()
+        chk(lib, lib.MXDataIterGetLabel(it, C.byref(lab)))
+        pad = C.c_int()
+        chk(lib, lib.MXDataIterGetPadNum(it, C.byref(pad)))
+        seen += 1
+        if seen > 3:
+            break
+        chk(lib, lib.MXDataIterNext(it, C.byref(has)))
+    assert seen >= 2
+    chk(lib, lib.MXDataIterBeforeFirst(it))
+    chk(lib, lib.MXDataIterNext(it, C.byref(has)))
+    assert has.value == 1
+    chk(lib, lib.MXDataIterFree(it))
+
+
+def test_cachedop_family(lib):
+    sym = _mlp_symbol(lib)
+    co = C.c_void_p()
+    chk(lib, lib.MXCreateCachedOp(sym, C.byref(co)))
+    rng = np.random.RandomState(1)
+    args = [_nd(lib, (8, 4), rng.randn(8, 4)),
+            _nd(lib, (4, 4), rng.randn(4, 4)),
+            _nd(lib, (4,), rng.randn(4)),
+            _nd(lib, (8,), np.zeros(8))]
+    n_out = C.c_int(0)
+    outs = C.POINTER(C.c_void_p)()
+    chk(lib, lib.MXInvokeCachedOp(co, 4, (C.c_void_p * 4)(*args),
+                                  C.byref(n_out), C.byref(outs)))
+    assert n_out.value == 1
+    probs = _to_np(lib, C.c_void_p(outs[0]), (8, 4))
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(8), rtol=1e-5)
+    chk(lib, lib.MXFreeCachedOp(co))
+
+
+def test_recordio_reference_names(lib, tmp_path):
+    path = str(tmp_path / "t.rec").encode()
+    w = C.c_void_p()
+    chk(lib, lib.MXRecordIOWriterCreate(path, C.byref(w)))
+    chk(lib, lib.MXRecordIOWriterWriteRecord(w, b"hello", 5))
+    chk(lib, lib.MXRecordIOWriterWriteRecord(w, b"world!", 6))
+    chk(lib, lib.MXRecordIOWriterFree(w))
+    r = C.c_void_p()
+    chk(lib, lib.MXRecordIOReaderCreate(path, C.byref(r)))
+    buf = C.c_char_p()
+    size = C.c_size_t()
+    chk(lib, lib.MXRecordIOReaderReadRecord(r, C.byref(buf), C.byref(size)))
+    assert C.string_at(buf, size.value) == b"hello"
+    chk(lib, lib.MXRecordIOReaderReadRecord(r, C.byref(buf), C.byref(size)))
+    assert C.string_at(buf, size.value) == b"world!"
+    chk(lib, lib.MXRecordIOReaderReadRecord(r, C.byref(buf), C.byref(size)))
+    assert size.value == 0  # EOF
+    chk(lib, lib.MXRecordIOReaderFree(r))
+
+
+def test_misc_and_stub_families(lib):
+    v = C.c_int()
+    chk(lib, lib.MXGetVersion(C.byref(v)))
+    assert v.value == 10000
+    prev = C.c_int()
+    chk(lib, lib.MXEngineSetBulkSize(7, C.byref(prev)))
+    chk(lib, lib.MXEngineSetBulkSize(prev.value, C.byref(prev)))
+    assert prev.value == 7
+    n = mx_uint()
+    arr = C.POINTER(C.c_char_p)()
+    chk(lib, lib.MXListAllOpNames(C.byref(n), C.byref(arr)))
+    assert n.value > 200
+    # storage type of a dense array
+    x = _nd(lib, (2, 2), np.ones((2, 2)))
+    st = C.c_int()
+    chk(lib, lib.MXNDArrayGetStorageType(x, C.byref(st)))
+    assert st.value == 0
+    # raw-bytes round trip
+    size = C.c_size_t()
+    raw = C.c_char_p()
+    chk(lib, lib.MXNDArraySaveRawBytes(x, C.byref(size), C.byref(raw)))
+    blob = C.string_at(raw, size.value)
+    y = C.c_void_p()
+    chk(lib, lib.MXNDArrayLoadFromRawBytes(blob, len(blob), C.byref(y)))
+    np.testing.assert_allclose(_to_np(lib, y, (2, 2)), np.ones((2, 2)))
+    # RTC errors with the documented pointer (reference-without-CUDA
+    # behavior)
+    rc = lib.MXRtcCudaModuleCreate(b"kernel", 0, None, C.byref(C.c_void_p()))
+    assert rc == -1
+    assert b"PallasModule" in lib.MXGetLastError()
+
+
+def test_custom_op_register_from_c(lib, tmp_path):
+    """MXCustomOpRegister: a C-implemented op (scale-by-3) registered
+    through the reference CustomOpPropCreator protocol, then invoked
+    imperatively through the ABI."""
+    src = os.path.join(ROOT, "native", "test_custom_op.c")
+    exe = str(tmp_path / "custom_op_test")
+    subprocess.run(
+        ["gcc", "-O2", src, "-I", os.path.join(ROOT, "include"),
+         "-L", os.path.join(ROOT, "native"), "-lmxnet_tpu",
+         "-Wl,-rpath," + os.path.join(ROOT, "native"), "-o", exe],
+        check=True, capture_output=True)
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run([exe], env=env, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout

@@ -1,0 +1,53 @@
+"""Scaling-efficiency harness (north-star metric #2, BASELINE.md).
+
+Reference bar: resnet-152 dist_device_sync reaches 90.1% scaling
+efficiency at 256 GPUs (example/image-classification/README.md:309-319).
+Real multi-chip is unreachable here; these tests pin the proxies:
+HLO collective accounting, cross-device-count numeric consistency, and
+the ring-allreduce projection model.
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu.parallel import scaling
+
+
+def test_collective_stats_parses_hlo_forms():
+    hlo = """
+  %ar = f32[1000]{0} all-reduce(f32[1000]{0} %p0), replica_groups={}
+  %t = (f32[64,3,7,7]{3,2,1,0}, f32[64]{0}) all-reduce(%a, %b), to_apply=%add
+  %ag-start = f32[8,128]{1,0} all-gather-start(f32[1,128]{1,0} %x), dimensions={0}
+  %ag-done = f32[8,128]{1,0} all-gather-done(%ag-start)
+  %unrelated = f32[4]{0} add(f32[4]{0} %u, f32[4]{0} %v)
+"""
+    out = scaling.collective_stats(hlo)
+    assert out["all-reduce"]["count"] == 2
+    assert out["all-reduce"]["bytes"] == 4 * (1000 + 64 * 3 * 7 * 7 + 64)
+    assert out["all-gather"]["count"] == 1  # -start counted, -done not
+    assert out["all-gather"]["bytes"] == 4 * 8 * 128
+    assert "add" not in out
+
+
+def test_projection_model_shape():
+    proj = scaling.project_efficiency(
+        grad_bytes=102_000_000, step_time_s=0.0138)
+    eff = proj["projected_efficiency"]
+    assert set(eff) == {"8", "16", "32", "64", "128", "256"}
+    # efficiency decreases with chip count, stays in (0, 1]
+    vals = [eff[k] for k in ("8", "16", "32", "64", "128", "256")]
+    assert all(0 < v <= 1 for v in vals)
+    assert vals == sorted(vals, reverse=True)
+    assert proj["reference_resnet152_256gpu"] == 0.901
+
+
+@pytest.mark.slow
+def test_sweep_consistency_and_collectives():
+    out = scaling.sweep(device_counts=(1, 2, 4), steps=3, batch=8)
+    rows = {r["n"]: r for r in out["sweep"] if "losses" in r}
+    assert set(rows) == {1, 2, 4}, out
+    for n in (2, 4):
+        assert rows[n]["numerically_consistent"], rows[n]
+        ar = rows[n]["collectives"]["all-reduce"]
+        # the gradient exchange must be real: >= resnet18's ~44 MB of
+        # parameters go over the wire every step
+        assert ar["bytes"] > 40e6, ar

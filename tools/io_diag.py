@@ -182,10 +182,10 @@ def main():
     compute_ips, _ = bench_compute(batch)
     out["compute_f32_ips"] = round(compute_ips, 1)
 
-    # stage 6: bench.py's own e2e path
+    # stage 6: bench.py's own decomposed e2e row
     import bench as bench_mod
-    out["e2e_ips"] = round(bench_mod.bench_recordio_input(), 1)
-    out["io_vs_compute"] = round(out["e2e_ips"] / compute_ips, 3)
+    out["bench_io_row"] = bench_mod.bench_recordio_input(
+        compute_ips=compute_ips, compute_dtype="float32", batch=batch)
     print(json.dumps(out, indent=1))
 
 
