@@ -1,0 +1,92 @@
+"""Sparse linear classification — row_sparse weights over CSR data.
+
+TPU rebuild of example/sparse/linear_classification/ (train.py +
+linear_model.py): LibSVM data through LibSVMIter as CSR batches, a
+row_sparse weight updated with sparse gradients, and the
+kvstore row_sparse_pull flow the reference uses for distributed
+training (train.py:108-124).  Storage types lower to dense XLA
+programs (SURVEY.md hard-part #4); the SURFACE and semantics are the
+reference's.
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def synthetic_libsvm(path, n=512, num_features=100, seed=0):
+    """Sparse rows whose label = sign of a planted sparse weight."""
+    rng = np.random.RandomState(seed)
+    w_true = np.zeros(num_features)
+    w_true[rng.choice(num_features, 10, replace=False)] = \
+        rng.randn(10) * 3
+    with open(path, "w") as f:
+        for _ in range(n):
+            nnz = rng.randint(3, 10)
+            idx = np.sort(rng.choice(num_features, nnz, replace=False))
+            val = rng.randn(nnz)
+            y = int(np.dot(val, w_true[idx]) > 0)
+            f.write("%d %s\n" % (y, " ".join(
+                "%d:%.4f" % (i, v) for i, v in zip(idx, val))))
+    return path
+
+
+def linear_model(num_features, positive_cls_weight=1.0):
+    """ref: linear_model.py — CSR data x row_sparse weight."""
+    x = mx.symbol.Variable("data", stype="csr")
+    norm_init = mx.initializer.Normal(sigma=0.01)
+    weight = mx.symbol.Variable("weight", shape=(num_features, 2),
+                                init=norm_init, stype="row_sparse")
+    bias = mx.symbol.Variable("bias", shape=(2,))
+    dot = mx.symbol.sparse.dot(x, weight)
+    pred = mx.symbol.broadcast_add(dot, bias)
+    y = mx.symbol.Variable("softmax_label")
+    return mx.symbol.SoftmaxOutput(data=pred, label=y, name="softmax")
+
+
+def main(num_features=100, batch_size=32, epochs=6, lr=0.5):
+    tmp = tempfile.mkdtemp(prefix="sparse_lc_")
+    train_path = synthetic_libsvm(os.path.join(tmp, "train.libsvm"))
+    train_iter = mx.io.LibSVMIter(data_libsvm=train_path,
+                                  data_shape=(num_features,),
+                                  batch_size=batch_size)
+    sym = linear_model(num_features)
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    mod.init_params(mx.init.Normal(sigma=0.01))
+    kv = mx.kv.create("local")
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": lr})
+    metric = mx.metric.create("accuracy")
+    accs = []
+    for epoch in range(epochs):
+        train_iter.reset()
+        metric.reset()
+        for batch in train_iter:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        accs.append(metric.get()[1])
+        print("epoch %d accuracy %.3f" % (epoch, accs[-1]))
+
+    # the distributed row_sparse flow (train.py:108-124): pull only the
+    # rows this batch touches from the kvstore
+    weight_param = mx.nd.zeros((num_features, 2), stype="row_sparse")
+    all_rows = mx.nd.arange(0, num_features, dtype="int64")
+    kv.row_sparse_pull(0, out=weight_param, row_ids=all_rows)
+    assert weight_param.shape == (num_features, 2)
+    return accs
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    args = ap.parse_args()
+    accs = main(epochs=args.epochs)
+    assert accs[-1] > 0.85, accs
+    print("PASS final accuracy %.3f" % accs[-1])

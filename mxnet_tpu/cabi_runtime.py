@@ -476,24 +476,26 @@ class CCachedOp:
     def __init__(self, h: "CSymbol"):
         self.sym = h.built()
         self._arg_names = self.sym.list_arguments()
-        self._exec = None
-        self._shapes = None
+        # per-shape executors like the reference CachedOp's per-shape
+        # cached graphs: alternating shapes (bucketing, partial last
+        # batch) must hit the jit cache, not rebind every call
+        self._execs: Dict[tuple, Executor] = {}
 
     def invoke(self, inputs: Sequence[NDArray]) -> List[NDArray]:
         if len(inputs) != len(self._arg_names):
             raise MXNetError("CachedOp: %d inputs given, %d expected"
                              % (len(inputs), len(self._arg_names)))
         shapes = tuple(tuple(a.shape) for a in inputs)
-        if self._exec is None or shapes != self._shapes:
+        ex = self._execs.get(shapes)
+        if ex is None:
             kwargs = {n: tuple(a.shape) for n, a in
                       zip(self._arg_names, inputs)}
-            self._exec = Executor.simple_bind(self.sym, grad_req="null",
-                                              **kwargs)
-            self._shapes = shapes
+            ex = Executor.simple_bind(self.sym, grad_req="null",
+                                      **kwargs)
+            self._execs[shapes] = ex
         for n, a in zip(self._arg_names, inputs):
-            self._exec.arg_dict[n]._data = a._data.astype(
-                self._exec.arg_dict[n].dtype)
-        return list(self._exec.forward(is_train=False))
+            ex.arg_dict[n]._data = a._data.astype(ex.arg_dict[n].dtype)
+        return list(ex.forward(is_train=False))
 
 
 def cachedop_create(h: "CSymbol") -> CCachedOp:

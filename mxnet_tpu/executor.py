@@ -180,7 +180,7 @@ class Executor:
     def __init__(self, symbol, ctx: Context, arg_dict: Dict[str, NDArray],
                  grad_dict: Dict[str, Optional[NDArray]],
                  aux_dict: Dict[str, NDArray], grad_req, group2ctx=None,
-                 placement=None):
+                 placement=None, out_shapes=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
         self.arg_dict = arg_dict
@@ -227,10 +227,11 @@ class Executor:
         # NDArrays live for the executor's lifetime).
         self.outputs: List[NDArray] = []
         try:
-            from .symbol.infer import infer_shape
+            if out_shapes is None:  # bind() path: infer once here
+                from .symbol.infer import infer_shape
 
-            shapes = {k: tuple(v.shape) for k, v in arg_dict.items()}
-            _, out_shapes, _ = infer_shape(symbol, **shapes)
+                shapes = {k: tuple(v.shape) for k, v in arg_dict.items()}
+                _, out_shapes, _ = infer_shape(symbol, **shapes)
             self.outputs = [_nd_mod.zeros(s, ctx=self._ctx)
                             for s in out_shapes if s is not None]
             if len(self.outputs) != len(self._output_names):
@@ -290,8 +291,11 @@ class Executor:
         aux_dict = {}
         for name, shape in zip(aux_names, aux_shapes):
             aux_dict[name] = alloc(shape, var_ctx.get(name, ctx))
+        # out_shapes rides along: the constructor must not re-run the
+        # whole-graph inference this bind just performed
         return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
-                        group2ctx=group2ctx, placement=placement)
+                        group2ctx=group2ctx, placement=placement,
+                        out_shapes=out_shapes)
 
     @staticmethod
     def bind(symbol, ctx=None, args=None, args_grad=None, grad_req="write",

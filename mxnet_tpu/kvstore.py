@@ -417,6 +417,15 @@ class KVStoreDist(KVStore):
                 self._req(c, {"op": "set_optimizer", "payload": payload})
         self.barrier()
 
+    def send_command_to_servers(self, head: int, body: str) -> None:
+        """Generic command broadcast to every server — received by the
+        server's controller callback (ref: KVStore::SendCommandToServers
+        include/mxnet/kvstore.h + MXKVStoreRunServer server_controller;
+        server side: kvstore_server.py op == 'command')."""
+        for c in self._server_clients:
+            self._req(c, {"op": "command", "head": int(head),
+                          "body": str(body)})
+
     def set_gradient_compression(self, compression_params) -> None:
         from .gradient_compression import GradientCompression
 

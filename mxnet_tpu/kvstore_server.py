@@ -40,7 +40,8 @@ class _KeyState:
 class KVStoreServer:
     """One PS shard (ref: KVStoreDistServer, kvstore_dist_server.h:113)."""
 
-    def __init__(self):
+    def __init__(self, controller=None):
+        self.controller = controller
         host, port, num_servers, num_workers = _ps.env_cluster()
         self.num_workers = num_workers
         self.sync_mode = True
@@ -144,6 +145,14 @@ class KVStoreServer:
                 # None uninstalls: back to raw-aggregate semantics
                 self.updater = (None if optimizer is None
                                 else _opt.get_updater(optimizer))
+            _ps.send_msg(conn, {"ok": True})
+        elif op == "command":
+            # generic command channel (ref: SendCommandToServers ->
+            # server_controller, kvstore_dist_server.h:154 +
+            # MXKVStoreRunServer contract)
+            if self.controller is not None:
+                self.controller(int(msg.get("head", 0)),
+                                str(msg.get("body", "")))
             _ps.send_msg(conn, {"ok": True})
         elif op == "set_sync":
             # ref: sync-mode command, kvstore_dist_server.h:154
@@ -281,18 +290,20 @@ def run_scheduler():
     _ps.Scheduler(port, ns, nw).run()
 
 
-def run_server():
-    KVStoreServer().run()
+def run_server(controller=None):
+    KVStoreServer(controller=controller).run()
 
 
-def init():
+def init(controller=None):
     """Role-based bootstrap: blocks forever in scheduler/server roles,
     returns immediately for workers (ref: kvstore_server.py:28-73 —
-    importing mxnet in a server process runs the server loop)."""
+    importing mxnet in a server process runs the server loop).
+    ``controller(head, body)`` receives generic worker commands — the
+    reference's MXKVStoreRunServer server_controller contract."""
     role = _ps.env_role()
     if role == "scheduler":
         run_scheduler()
         raise SystemExit(0)
     if role == "server":
-        run_server()
+        run_server(controller=controller)
         raise SystemExit(0)

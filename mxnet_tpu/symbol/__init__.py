@@ -7,3 +7,6 @@ _register.populate(globals())
 
 # mx.sym.linalg.gemm2(...) etc. (ref: python/mxnet/symbol/linalg.py)
 from . import linalg  # noqa: F401
+
+# mx.sym.sparse.dot(...) etc. (ref: python/mxnet/symbol/sparse.py)
+from . import sparse  # noqa: F401

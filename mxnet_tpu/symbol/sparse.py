@@ -1,0 +1,28 @@
+"""``mx.sym.sparse`` — sparse-op symbol namespace.
+
+ref: python/mxnet/symbol/sparse.py (generated namespace over the
+FComputeEx sparse registrations).  Storage types are per-NDArray hints
+on this backend (the executor lowers everything to dense XLA programs,
+SURVEY.md hard-part #4), so these forward to the same registered ops —
+the parity point is the *surface* reference scripts touch
+(e.g. example/sparse/linear_classification/linear_model.py:29
+``mx.symbol.sparse.dot``)."""
+from . import register as _register
+from .symbol import create as _create
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
+    return _create("dot", lhs, rhs, transpose_a=transpose_a,
+                   transpose_b=transpose_b, **kwargs)
+
+
+def zeros_like(data, **kwargs):
+    return _create("zeros_like", data, **kwargs)
+
+
+def retain(data, indices, **kwargs):
+    return _create("_sparse_retain", data, indices, **kwargs)
+
+
+def elemwise_add(lhs, rhs, **kwargs):
+    return _create("elemwise_add", lhs, rhs, **kwargs)

@@ -302,14 +302,13 @@ def _binary_sym(op_name, scalar_op, lhs, other, reverse=False):
         # auto-name like the reference's elemwise dunder ops ("_plus12"
         # etc., the _Plus/_Minus registered names): generated model code
         # addresses residual-add internals by these names (e.g.
-        # example/ssd/symbol_factory.py from_layers ['_plus12', ...])
-        from .. import name as _name_mod
-
-        auto = _name_mod.current().get(None, _DUNDER_HINT.get(op_name,
-                                                              op_name))
-        return create(op_name, lhs=lhs, rhs=other, name=auto) \
+        # example/ssd/symbol_factory.py from_layers ['_plus12', ...]).
+        # The hint rides through create() so the NameManager resolves it
+        # exactly ONCE (a pre-resolved name would get a Prefix twice).
+        hint = _DUNDER_HINT.get(op_name, op_name)
+        return create(op_name, lhs=lhs, rhs=other, __hint__=hint) \
             if not reverse else create(op_name, lhs=other, rhs=lhs,
-                                       name=auto)
+                                       __hint__=hint)
     return create(scalar_op, data=lhs, scalar=float(other))
 
 
@@ -370,7 +369,7 @@ def create(op_name: str, *args, name: Optional[str] = None, **kwargs) -> Symbol:
     # NameManager: a fresh `with NameManager():` scope restarts the
     # counters, and Prefix prefixes explicit names too (ref: name.py:22
     # NameManager.get / :74 Prefix.get semantics)
-    hint = op.name.lower().lstrip("_")
+    hint = kwargs.pop("__hint__", None) or op.name.lower().lstrip("_")
     base = _name_mod.current().get(name, hint)
 
     # positional symbol inputs

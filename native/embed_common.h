@@ -8,6 +8,8 @@
 #pragma once
 #include <Python.h>
 
+#include <dlfcn.h>
+
 #include <mutex>
 #include <string>
 #include <vector>
@@ -23,6 +25,18 @@ inline void EnsurePython() {
   static std::once_flag once;
   std::call_once(once, []() {
     if (!Py_IsInitialized()) {
+      /* when THIS library was dlopen'ed without RTLD_GLOBAL (perl XS,
+       * lua, any plugin host), libpython's symbols are not visible to
+       * the extension modules numpy/jax load — re-promote libpython
+       * globally before interpreter start */
+      char soname[64];
+      snprintf(soname, sizeof(soname), "libpython%d.%d.so.1.0",
+               PY_MAJOR_VERSION, PY_MINOR_VERSION);
+      if (!dlopen(soname, RTLD_NOW | RTLD_GLOBAL)) {
+        snprintf(soname, sizeof(soname), "libpython%d.%d.so",
+                 PY_MAJOR_VERSION, PY_MINOR_VERSION);
+        dlopen(soname, RTLD_NOW | RTLD_GLOBAL);
+      }
       Py_InitializeEx(0);
       /* release the GIL acquired by Py_Initialize so PyGILState works
        * from any caller thread; the interpreter lives until process

@@ -1,0 +1,22 @@
+package AI::MXNetTPU;
+# Perl frontend slice over the TPU build's C ABI (see MXNetTPU.xs).
+use strict;
+use warnings;
+require XSLoader;
+our $VERSION = '0.01';
+XSLoader::load('AI::MXNetTPU', $VERSION);
+1;
+__END__
+=head1 NAME
+
+AI::MXNetTPU - minimal perl binding over the mxnet_tpu C ABI
+
+=head1 SYNOPSIS
+
+  use AI::MXNetTPU;
+  my $pred = AI::MXNetTPU::pred_create($json, $params, "data", [1, 8]);
+  AI::MXNetTPU::pred_set_input($pred, "data", \@pixels);
+  AI::MXNetTPU::pred_forward($pred);
+  my $probs = AI::MXNetTPU::pred_get_output($pred, 0);
+
+=cut

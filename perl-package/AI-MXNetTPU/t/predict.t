@@ -1,0 +1,50 @@
+# End-to-end: load a python-trained checkpoint, predict from perl, and
+# match the logits python wrote alongside (1e-4).
+use strict;
+use warnings;
+use Test::More;
+use AI::MXNetTPU;
+
+my $dir = $ENV{MXTPU_FIXTURE_DIR} or plan skip_all => 'no fixture dir';
+
+ok(AI::MXNetTPU::get_version() >= 10000, 'version');
+ok(AI::MXNetTPU::num_ops() > 200, 'op registry visible');
+
+open(my $jf, '<', "$dir/model-symbol.json") or die $!;
+my $json = do { local $/; <$jf> };
+open(my $pf, '<:raw', "$dir/model-0001.params") or die $!;
+my $params = do { local $/; <$pf> };
+
+# input fixture: one row of floats + expected probs, python-written
+open(my $xf, '<', "$dir/input.txt") or die $!;
+my @x = split ' ', <$xf>;
+my @want = split ' ', <$xf>;
+
+my $pred = AI::MXNetTPU::pred_create($json, $params, "data",
+                                     [1, scalar(@x)]);
+AI::MXNetTPU::pred_set_input($pred, "data", \@x);
+AI::MXNetTPU::pred_forward($pred);
+my $got = AI::MXNetTPU::pred_get_output($pred, 0);
+is(scalar(@$got), scalar(@want), 'output width');
+my $max_err = 0;
+for my $i (0 .. $#want) {
+    my $e = abs($got->[$i] - $want[$i]);
+    $max_err = $e if $e > $max_err;
+}
+ok($max_err < 1e-4, "logits match python (max err $max_err)");
+AI::MXNetTPU::pred_free($pred);
+
+# the general-ABI slice: symbol + ndarray round trip
+my $sym = AI::MXNetTPU::sym_load("$dir/model-symbol.json");
+my $args = AI::MXNetTPU::sym_arguments($sym);
+ok(scalar(@$args) >= 3, 'symbol arguments listed');
+AI::MXNetTPU::sym_free($sym);
+
+my $nd = AI::MXNetTPU::nd_create([2, 3]);
+AI::MXNetTPU::nd_set($nd, [1, 2, 3, 4, 5, 6]);
+my $back = AI::MXNetTPU::nd_get($nd);
+is_deeply([map { 0 + $_ } @$back], [1, 2, 3, 4, 5, 6],
+          'ndarray round trip');
+AI::MXNetTPU::nd_free($nd);
+
+done_testing();

@@ -530,3 +530,48 @@ def test_custom_op_register_from_c(lib, tmp_path):
                           timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
+
+
+@pytest.mark.slow
+def test_perl_binding_end_to_end(tmp_path):
+    """The ABI hosts a NON-PYTHON binding: AI::MXNetTPU (perl XS over 15
+    C entry points, perl-package/) loads a python-trained checkpoint and
+    reproduces its logits (VERDICT r2 item 9 — converts coverage row
+    #41 from 'cut' to 'demonstrated')."""
+    import shutil
+
+    if shutil.which("perl") is None or shutil.which("xsubpp") is None:
+        pytest.skip("perl toolchain absent")
+    from cabi_common import ensure_lib, train_and_save
+
+    ensure_lib()
+    # python-side fixture: train + checkpoint + golden logits
+    prefix, x, y, mod = train_and_save(tmp_path)
+    import mxnet_tpu as mx
+
+    row = x[:1]
+    out = mod.predict(mx.io.NDArrayIter(row, None, batch_size=1)).asnumpy()
+    fix = tmp_path / "fixture"
+    fix.mkdir()
+    for suffix in ("-symbol.json", "-0001.params"):
+        shutil.copy(prefix + suffix, str(fix / ("model" + suffix)))
+    with open(fix / "input.txt", "w") as f:
+        f.write(" ".join("%r" % float(v) for v in row.ravel()) + "\n")
+        f.write(" ".join("%r" % float(v) for v in out.ravel()) + "\n")
+
+    pkg = os.path.join(ROOT, "perl-package", "AI-MXNetTPU")
+    build = tmp_path / "perl-build"
+    shutil.copytree(pkg, str(build))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               PYTHONPATH=ROOT, MXTPU_FIXTURE_DIR=str(fix),
+               MXTPU_ROOT=ROOT)
+    r = subprocess.run(["perl", "Makefile.PL"], cwd=str(build), env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(["make"], cwd=str(build), env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(["make", "test"], cwd=str(build), env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Result: PASS" in r.stdout, r.stdout[-2000:]

@@ -77,3 +77,28 @@ def test_single_process_dist_fallback():
     out = nd.zeros((2,))
     kv.pull("k", out=out)
     np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+
+def test_server_command_channel_controller():
+    """SendCommandToServers -> server controller (the MXKVStoreRunServer
+    contract): a generic (head, body) command reaches the registered
+    controller callback and is acked."""
+    import socket as _socket
+
+    from mxnet_tpu import _ps
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    got = []
+    srv = KVStoreServer.__new__(KVStoreServer)
+    srv.controller = lambda head, body: got.append((head, body))
+    a, b = _socket.socketpair()
+    try:
+        _ps.send_msg(a, {"op": "command", "head": 7, "body": "sync=0"})
+        msg = _ps.recv_msg(b)
+        assert srv._dispatch(b, msg) in (None, False)
+        reply = _ps.recv_msg(a)
+        assert reply == {"ok": True}
+        assert got == [(7, "sync=0")]
+    finally:
+        a.close()
+        b.close()

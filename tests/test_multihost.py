@@ -53,3 +53,30 @@ def test_dist_module_env_contract(monkeypatch):
     assert dist.env_spec() == ("10.0.0.1:9123", 16, 3)
     with pytest.raises(ValueError):
         dist.initialize(coordinator_address="x:1")
+
+
+@pytest.mark.slow
+def test_four_process_pod_two_devices_each(tmp_path):
+    """Beyond-minimum pod: 4 processes x 2 virtual devices = 8-device
+    mesh; dist_sync identity from jax.distributed (no DMLC env);
+    row_sparse gradient exchange across the pod (VERDICT r2 item 8)."""
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    for k in ("DMLC_PS_ROOT_URI", "DMLC_ROLE", "DMLC_NUM_SERVER",
+              "DMLC_NUM_WORKER"):
+        env[k] = ""  # force the jax.distributed identity path
+    codes = launch.launch_jax(
+        4, [sys.executable,
+            os.path.join(os.path.dirname(__file__),
+                         "multihost_worker4.py"), str(tmp_path)], env=env)
+    assert codes == [0, 0, 0, 0], codes
+    ws = []
+    for r in range(4):
+        with open(tmp_path / ("rank%d.json" % r)) as f:
+            ws.append(json.load(f)["w"])
+    for r in range(1, 4):
+        np.testing.assert_array_equal(ws[0], ws[r])
