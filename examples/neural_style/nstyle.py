@@ -61,7 +61,7 @@ def style_transfer(content, style, steps=60, lr=0.05,
         # pixel units regardless of loss scale
         g = img.grad
         scale = float(nd.abs(g).mean().asnumpy()) + 1e-12
-        img._data = (img - (lr / scale) * g)._data
+        img[:] = img - (lr / scale) * g
         img.grad[:] = 0
         losses.append(float(loss.asnumpy()))
         s_losses.append(float(s_loss.asnumpy()))

@@ -136,6 +136,7 @@ pred_create(const char *symbol_json, SV *param_bytes, const char *input_key, AV 
     const char *pbuf = SvPV(param_bytes, plen);
     mx_uint dims[8];
     mx_uint nd = (mx_uint)(av_len(shape) + 1);
+    if (nd > 8) croak("shape rank > 8");
     for (mx_uint i = 0; i < nd; ++i)
       dims[i] = (mx_uint)SvUV(*av_fetch(shape, i, 0));
     mx_uint indptr[2] = {0, nd};
