@@ -45,21 +45,28 @@ import numpy as np
 # (model, batch, K80 baseline img/s, dtype, bulk K).  Steps run K-at-a-
 # time inside one XLA program (FusedTrainStep.run_steps) — the bulk
 # path; K picked so a window is ~1-3s of device time.
+# ordered by information value: the headline rows first, so a slow
+# (congested-tunnel) run that hits the time budget still reports them
 CONFIGS = [
-    ("resnet18_v1", 32, 185.0, "float32", 64),
-    ("resnet18_v1", 32, 185.0, "bfloat16", 64),
     ("resnet50_v1", 32, 109.0, "float32", 48),
     ("resnet50_v1", 32, 109.0, "bfloat16", 48),
     ("resnet50_v1", 64, 109.0, "bfloat16", 32),
-    ("resnet50_v1", 128, 109.0, "bfloat16", 16),
-    ("resnet50_v1", 256, 109.0, "bfloat16", 8),
+    ("resnet18_v1", 32, 185.0, "float32", 64),
+    ("resnet18_v1", 32, 185.0, "bfloat16", 64),
     ("resnet152_v1", 32, 57.0, "float32", 24),
     ("resnet152_v1", 32, 57.0, "bfloat16", 24),
     ("inception_bn", 32, 152.0, "float32", 48),
     ("inception_bn", 32, 152.0, "bfloat16", 48),
     ("alexnet", 512, 457.07, "float32", 12),
     ("alexnet", 512, 457.07, "bfloat16", 12),
+    ("resnet50_v1", 128, 109.0, "bfloat16", 16),
+    ("resnet50_v1", 256, 109.0, "bfloat16", 8),
 ]
+
+# wall-clock budget: the tunnel's speed varies 3x day to day, and the
+# driver must ALWAYS get the final JSON line — table rows stop when the
+# model budget is spent, reserving time for the io + fit rows
+BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "4200"))
 
 # per-model ceiling notes: what "at the XLA ceiling" means per row.
 # resnet50-bf16 ~2.3k img/s/chip is the published JAX/XLA rate for this
@@ -354,21 +361,23 @@ def _sym_resnet50(num_classes=1000):
     return mx.sym.SoftmaxOutput(x, name="softmax")
 
 
-def bench_fit_loop(batch=32, bulk_k=16, n_batches=16):
+def bench_fit_loop(batch=32, bulk_k=8, n_batches=8):
     """Module.fit throughput on synthetic data — the number a user's
     training script sees, not the raw fused step.  engine.set_bulk_size
     makes fit run K steps per dispatch (module/bulk.py), the reference's
     bulk-exec segments translated to step granularity
-    (threaded_engine.h:386-458)."""
+    (threaded_engine.h:386-458).  BENCH_FIT_IMG overrides the image side
+    (CI plumbing drives use 64; the real row is 224)."""
     import mxnet_tpu as mx
     from mxnet_tpu import engine, io as mio
 
+    img = int(os.environ.get("BENCH_FIT_IMG", "224"))
     sym = _sym_resnet50(1000)
-    X = np.random.rand(batch * n_batches, 3, 224, 224).astype(np.float32)
+    X = np.random.rand(batch * n_batches, 3, img, img).astype(np.float32)
     y = np.random.randint(0, 1000, batch * n_batches).astype(np.float32)
     it = mio.NDArrayIter(X, y, batch_size=batch, label_name="softmax_label")
     mod = mx.mod.Module(sym)
-    engine.set_bulk_size(bulk_k)
+    engine.set_bulk_size(bulk_k)  # noqa: consumed by the bulk fit path
 
     class _Clock:
         """Per-epoch wall clock via epoch callbacks."""
@@ -381,7 +390,7 @@ def bench_fit_loop(batch=32, bulk_k=16, n_batches=16):
 
     clock = _Clock()
     t0 = time.time()
-    mod.fit(it, num_epoch=4, optimizer="sgd",
+    mod.fit(it, num_epoch=3, optimizer="sgd",
             optimizer_params=(("learning_rate", 0.05), ("momentum", 0.9)),
             epoch_end_callback=clock, initializer=mx.init.Xavier())
     # epoch 1 pays compilation; steady state = fastest later epoch
@@ -396,10 +405,16 @@ def main():
     mx.random.seed(0)
 
     peak, kind = _peak()
+    t_start = time.time()
     table = []
     headline = None
     io_compute_ref = None  # resnet50-bf16@64: the io row's comparator
     for name, batch, baseline, dtype, bulk_k in CONFIGS:
+        if time.time() - t_start > BENCH_BUDGET_S * 0.6:
+            table.append({"skipped": "%s/%s bs%d — model time budget "
+                          "spent (BENCH_BUDGET_S=%d, congested tunnel)"
+                          % (name, dtype, batch, BENCH_BUDGET_S)})
+            continue
         try:
             ips, flops, sps = bench_model(name, batch, dtype, bulk_k)
         except Exception as exc:
@@ -435,14 +450,40 @@ def main():
         print(json.dumps({"progress": row}), file=sys.stderr)
 
     try:
+        if time.time() - t_start > BENCH_BUDGET_S * 0.85:
+            raise RuntimeError("time budget spent before io row")
         io_row = bench_recordio_input(compute_ips=io_compute_ref,
                                       compute_dtype="bfloat16", batch=64)
     except Exception as exc:  # never lose the headline to an IO failure
         io_row = {"pipeline": "ImageRecordIter->train", "error": repr(exc)}
 
     try:
-        fit_ips = bench_fit_loop()
-        fit_row = {"pipeline": "Module.fit (bulk_size=16)",
+        if time.time() - t_start > BENCH_BUDGET_S:
+            raise RuntimeError("time budget spent before fit row")
+        # subprocess + hard timeout: a tunnel stall inside the big fit
+        # compile must never hang the whole bench past the driver's
+        # window (observed: uploads of the K-step symbolic program can
+        # block indefinitely on a congested tunnel)
+        import subprocess
+
+        # never outlive the budget window: a congested-tunnel compile
+        # is bounded by the REMAINING budget, not a fixed floor
+        fit_timeout = min(1500, max(30, BENCH_BUDGET_S + t_start
+                                    - time.time()))
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import bench; print('FIT_IPS', bench.bench_fit_loop())"],
+            capture_output=True, text=True, timeout=fit_timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        fit_ips = None
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("FIT_IPS "):
+                fit_ips = float(ln.split()[1])
+        if fit_ips is None:
+            raise RuntimeError("fit subprocess rc=%d: %s"
+                               % (proc.returncode,
+                                  (proc.stdout + proc.stderr)[-400:]))
+        fit_row = {"pipeline": "Module.fit (bulk_size=8)",
                    "model": "resnet50_v1(sym)", "batch": 32,
                    "dtype": "float32",
                    "images_per_sec": round(fit_ips, 2),

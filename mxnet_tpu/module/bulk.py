@@ -260,15 +260,28 @@ class BulkTrainLoop:
                     arrs.append(src._data if isinstance(src, NDArray)
                                 else jnp.asarray(src))
                 stacked.append(jnp.stack(arrs))
-            params = {n: c._data for n, c in ex.arg_dict.items()
+            # COMMIT every carried buffer to the device before the first
+            # dispatch: jit keys include placement, so uncommitted
+            # first-call inputs vs committed (donated-output) later ones
+            # would trace the huge program twice
+            import jax as _jax
+
+            dev = ex._ctx.jax_device()
+
+            def _commit(cell):
+                if getattr(cell._data, "committed", True) is not True:
+                    cell._data = _jax.device_put(cell._data, dev)
+                return cell._data
+
+            params = {n: _commit(c) for n, c in ex.arg_dict.items()
                       if n not in io_names}
-            aux_vals = {n: c._data for n, c in ex.aux_dict.items()}
+            aux_vals = {n: _commit(c) for n, c in ex.aux_dict.items()}
             updater = mod._active_updater()
             leaves: List[Any] = []
             for i, _ in self._trainable:
                 flat: List[Any] = []
                 _flatten_state(updater.states[i], flat)
-                leaves.extend(c._data for c in flat)
+                leaves.extend(_commit(c) for c in flat)
             from .. import random as _random
 
             key_root = _random._next_key()

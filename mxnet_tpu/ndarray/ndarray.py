@@ -418,13 +418,42 @@ class NDArray:
         return NDArray.from_raw(self._data[_convert_index(key)], self._ctx)
 
     def __setitem__(self, key, value):
+        # whole-array assignment (`arr[:] = v`, the initializer/copyto
+        # hot path) replaces the buffer instead of lowering to a jax
+        # scatter: a scatter compiles one program PER ARRAY SHAPE, which
+        # on a remote-compile backend (tunnel TPU) turns a 161-param
+        # init into minutes of compilation
+        if (key is None or key == slice(None) or key is Ellipsis):
+            # preserve commitment semantics: a COMMITTED destination
+            # keeps its device (o[:] = src across devices must not
+            # migrate o); an uncommitted one stays uncommitted so mesh
+            # users (DataParallelRunner.place) remain free to shard it
+            dev = next(iter(self._data.devices())) \
+                if getattr(self._data, "committed", False) else None
+            if isinstance(value, NDArray):
+                raw = value._data.astype(self._data.dtype) \
+                    if value._data.dtype != self._data.dtype else value._data
+                raw = _jnp().broadcast_to(raw, self._data.shape) \
+                    if raw.shape != tuple(self._data.shape) else raw
+                if dev is not None:
+                    raw = _jax().device_put(raw, dev)
+            else:
+                arr = _np.asarray(value, dtype=self.dtype)
+                arr = _np.broadcast_to(arr, tuple(self._data.shape))
+                raw = _jax().device_put(arr, dev) if dev is not None \
+                    else _jnp().asarray(arr)
+            self._data = raw
+            self._bump_version()
+            return
         key2 = _convert_index(key)
         if isinstance(value, NDArray):
             raw = value._data
         else:
             raw = _np.asarray(value, dtype=self.dtype)
         self._data = self._data.at[key2].set(raw)
-        self._vt = object()  # new value version; detaches from the tape
+        # full in-place-write bump (token + stale producer node), same
+        # contract as copyto
+        self._bump_version()
 
     # iteration over first axis
     def __iter__(self):
