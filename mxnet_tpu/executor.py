@@ -431,7 +431,12 @@ class Executor:
             def pure(diff_args):
                 return eval_fn({**rest, **diff_args}, aux_vals, key, True)
 
-            res, vjp_fn = jax.vjp(pure, diff)
+            # MXNET_BACKWARD_DO_MIRROR: recompute cheap activations in
+            # backward instead of storing them (remat.py; ref mirror
+            # pass graph_executor.cc:249)
+            from .remat import maybe_checkpoint
+
+            res, vjp_fn = jax.vjp(maybe_checkpoint(pure), diff)
             outs = res[0]
             jnp = jax.numpy
             # reference head-grad semantics (GraphExecutor::Backward):

@@ -351,6 +351,9 @@ class CachedOp:
                                if p.grad_req == "null"]
         self._op = Op("CachedOp_" + block.name, self._raw_fn, rng=True,
                       input_names=())
+        # the block trace is the mirror/remat boundary
+        # (MXNET_BACKWARD_DO_MIRROR, remat.py)
+        self._op.remat = True
 
     def _raw_fn(self, key, *arrays, _training=True, _n_inputs=1):
         """Pure function over raw jax arrays: rebuild NDArray shells, run the

@@ -175,7 +175,10 @@ class BulkTrainLoop:
             def pure(d):
                 return eval_fn({**rest, **d}, aux_vals, key, True)
 
-            res, vjp_fn = jax.vjp(pure, diff)
+            # MXNET_BACKWARD_DO_MIRROR honored inside the scan body too
+            from ..remat import maybe_checkpoint
+
+            res, vjp_fn = jax.vjp(maybe_checkpoint(pure), diff)
             outs = res[0]
             cots = [jnp.ones_like(o) for o in outs]
             zero_rest = jax.tree.map(jnp.zeros_like, res[1:])

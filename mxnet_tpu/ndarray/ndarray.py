@@ -557,6 +557,12 @@ def invoke(
         )
     )
     if recording:
+        if op.remat:
+            # whole-block ops (CachedOp) honor MXNET_BACKWARD_DO_MIRROR:
+            # cheap activations recompute in backward (remat.py)
+            from ..remat import maybe_checkpoint
+
+            fn = maybe_checkpoint(fn)
         outs, vjp_fn = _jax().vjp(fn, *raw)
     else:
         outs = fn(*raw)

@@ -60,6 +60,7 @@ class Op:
         "doc",
         "aliases",
         "input_names",
+        "remat",
     )
 
     def __init__(
@@ -86,6 +87,9 @@ class Op:
         self.nondiff = nondiff
         self.train_aware = train_aware
         self.doc = doc or (fn.__doc__ or "")
+        # whole-program ops (CachedOp) opt in to the mirror/remat wrap;
+        # primitive ops never do — remat granularity is the block trace
+        self.remat = False
         self.aliases: List[str] = []
         if input_names is None:
             # derive from the body's leading positional params (skip the rng

@@ -210,8 +210,12 @@ class FusedTrainStep:
                     loss = loss_block(out_nd, lab_nd)
                 return loss._data.mean(), (new_aux, visible[0])
 
+            # MXNET_BACKWARD_DO_MIRROR: keep only conv/matmul residuals,
+            # rematerialize activations in backward (remat.py)
+            from ..remat import maybe_checkpoint
+
             (loss_val, (new_aux, logits)), grads = jax.value_and_grad(
-                pure_loss, has_aux=True)(diff)
+                maybe_checkpoint(pure_loss), has_aux=True)(diff)
 
             new_params = []
             new_moms = []

@@ -1,0 +1,69 @@
+"""Memory-for-compute trading: the mirror pass, TPU-style.
+
+The reference halves activation memory by *mirroring* cheap nodes —
+recomputing activations/BN/pooling during backward instead of keeping
+them alive (``MXNET_BACKWARD_DO_MIRROR``, src/executor/graph_executor.cc:249
+InitFullGraph mirror augmentation; the documented trade is Inception-v3
+batch 64 -> 128 in the same 10 GB at ~10% slowdown,
+example/image-classification/README.md:370-373).
+
+On TPU the idiomatic equivalent is ``jax.checkpoint`` with a
+*save-policy*: wrap the traced training program so XLA keeps only the
+expensive MXU results (conv / matmul outputs) as residuals and
+rematerializes the cheap elementwise chains — BN normalization,
+activations, pooling, adds — inside the backward computation.  That is
+exactly the node set the reference's mirror pass marks (its
+``MXNET_BACKWARD_MIRROR_FN`` defaults to mirroring Activation/BatchNorm/
+pooling class nodes).
+
+Honored by every backward path:
+  * ``Executor`` symbolic training (``executor.py`` fused fwd+vjp),
+  * the bulk fit scan (``module/bulk.py``),
+  * ``FusedTrainStep`` whole-step compilation (``parallel/dp.py``),
+  * gluon/autograd via the CachedOp tape node (``ndarray.invoke``).
+
+The knob keeps the reference's env name and truthiness; it is read at
+program *build* time (bind / first step), matching the reference, which
+consults it during graph init.
+"""
+import os
+
+__all__ = ["mirror_enabled", "mirror_policy", "maybe_checkpoint"]
+
+# ops whose OUTPUTS are kept as backward residuals under the mirror
+# policy: the MXU heavyweights.  Everything else (BN math, relu, adds,
+# pooling, reshapes) is rematerialized in backward — recomputing them
+# costs a few percent of the conv FLOPs but releases every intermediate
+# activation between conv boundaries.
+_SAVEABLE_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+
+
+def mirror_enabled() -> bool:
+    """Reference env contract: any value but 0/empty/false enables."""
+    v = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0")
+    return v not in ("", "0", "false", "False", "FALSE")
+
+
+def mirror_policy():
+    """A jax.checkpoint save-policy: keep conv/matmul outputs,
+    rematerialize the rest."""
+
+    def policy(prim, *_, **__):
+        return getattr(prim, "name", str(prim)) in _SAVEABLE_PRIMS
+
+    return policy
+
+
+def maybe_checkpoint(fn):
+    """Wrap a pure traced callable in ``jax.checkpoint`` with the mirror
+    policy when ``MXNET_BACKWARD_DO_MIRROR`` is on; identity otherwise.
+
+    Apply to the *whole-program* pure function right before ``jax.vjp`` /
+    ``jax.value_and_grad`` — the policy, not the wrap granularity, decides
+    what is kept.
+    """
+    if not mirror_enabled():
+        return fn
+    import jax
+
+    return jax.checkpoint(fn, policy=mirror_policy())
