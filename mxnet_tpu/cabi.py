@@ -95,6 +95,20 @@ class Predictor:
         """ref: MXPredForward."""
         self.outputs = [o.asnumpy() for o in self.exe.forward()]
 
+    def partial_forward(self, step: int) -> int:
+        """ref: MXPredPartialForward (c_predict_api.cc RunStep loop).
+
+        The reference executes the op sequence incrementally so slow
+        predictions can display progress.  Under XLA the forward is ONE
+        compiled program: step 0 executes it entirely; later steps are
+        progress bookkeeping against the graph's node count, preserving
+        the documented call contract (loop until step_left == 0).
+        """
+        n = max(1, len(self.symbol.get_internals().list_outputs()))
+        if step == 0:
+            self.forward()
+        return max(0, n - 1 - int(step))
+
     def get_output_shape(self, index: int) -> tuple:
         """ref: MXPredGetOutputShape (works pre-forward via inference)."""
         if self.outputs:
@@ -115,6 +129,22 @@ class Predictor:
     @property
     def num_outputs(self) -> int:
         return len(self.symbol.list_outputs())
+
+
+def load_ndlist(data: bytes):
+    """ref: MXNDListCreate — parse a .nd file blob (the dmlc ndarray
+    container, e.g. a mean-image file) into [(key, float32 C-contiguous
+    array), ...].  Unnamed containers get empty keys like the reference
+    (MXAPINDList keys default to "")."""
+    loaded = load_frombuffer(bytes(data))
+    if isinstance(loaded, dict):
+        items = list(loaded.items())
+    elif isinstance(loaded, (list, tuple)):
+        items = [("", a) for a in loaded]
+    else:
+        items = [("", loaded)]
+    return [(k, np.ascontiguousarray(a.asnumpy(), dtype=np.float32))
+            for k, a in items]
 
 
 def create_predictor(symbol_json, param_bytes, dev_type, dev_id,

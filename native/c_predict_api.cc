@@ -207,9 +207,113 @@ extern "C" MXNET_DLL int MXPredGetOutput(PredictorHandle handle,
   return 0;
 }
 
+extern "C" MXNET_DLL int MXPredPartialForward(PredictorHandle handle,
+                                              int step, int *step_left) {
+  /* ref: c_predict_api.h:170 — loop from step=0 until step_left==0.
+   * The Python side runs the whole fused XLA program on step 0 and
+   * reports progress against the graph node count (see
+   * cabi.Predictor.partial_forward for the XLA-vs-op-sequence note). */
+  Gil gil;
+  PyObject *pred = static_cast<PyObject *>(handle);
+  PyObject *r = PyObject_CallMethod(pred, "partial_forward", "i", step);
+  if (!r) return Fail("MXPredPartialForward");
+  long left = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (left < 0 && PyErr_Occurred()) return Fail("MXPredPartialForward");
+  if (step_left) *step_left = static_cast<int>(left);
+  return 0;
+}
+
 extern "C" MXNET_DLL int MXPredFree(PredictorHandle handle) {
   Gil gil;
   Py_XDECREF(static_cast<PyObject *>(handle));
+  return 0;
+}
+
+/* -- NDList: .nd container loading (mean image files etc.) ----------
+ * ref: c_predict_api.h:198-223, backed by MXAPINDList in the
+ * reference.  All data is copied out of Python at create time so the
+ * returned pointers stay valid until MXNDListFree with no Python
+ * object retained (and no GIL needed in Get). */
+typedef void *NDListHandle;
+
+namespace {
+
+struct NDListObj {
+  std::vector<std::string> keys;
+  std::vector<std::vector<mx_float>> data;
+  std::vector<std::vector<mx_uint>> shapes;
+};
+
+}  // namespace
+
+extern "C" MXNET_DLL int MXNDListCreate(const char *nd_file_bytes,
+                                        int nd_file_size,
+                                        NDListHandle *out,
+                                        mx_uint *out_length) {
+  Gil gil;
+  PyObject *mod = CabiModule();
+  if (!mod) return Fail("import mxnet_tpu.cabi");
+  PyObject *fn = PyObject_GetAttrString(mod, "load_ndlist");
+  Py_DECREF(mod);
+  if (!fn) return Fail("load_ndlist missing");
+  PyObject *blob =
+      PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject *items = PyObject_CallFunctionObjArgs(fn, blob, nullptr);
+  Py_DECREF(fn);
+  Py_XDECREF(blob);
+  if (!items) return Fail("MXNDListCreate");
+  PyObject *seq = PySequence_Fast(items, "load_ndlist result");
+  Py_DECREF(items);
+  if (!seq) return Fail("MXNDListCreate sequence");
+  auto *list = new NDListObj();
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *pair = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject *key = PyTuple_GetItem(pair, 0);
+    PyObject *arr = PyTuple_GetItem(pair, 1);
+    const char *k = key ? PyUnicode_AsUTF8(key) : nullptr;
+    Py_buffer view;
+    if (!k || !arr ||
+        PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO | PyBUF_FORMAT) != 0) {
+      delete list;
+      Py_DECREF(seq);
+      return Fail("MXNDListCreate item");
+    }
+    list->keys.emplace_back(k);
+    const mx_float *f = static_cast<const mx_float *>(view.buf);
+    list->data.emplace_back(f, f + view.len / sizeof(mx_float));
+    std::vector<mx_uint> shp;
+    for (int d = 0; d < view.ndim; ++d)
+      shp.push_back(static_cast<mx_uint>(view.shape[d]));
+    list->shapes.emplace_back(std::move(shp));
+    PyBuffer_Release(&view);
+  }
+  Py_DECREF(seq);
+  *out = list;
+  *out_length = static_cast<mx_uint>(list->keys.size());
+  return 0;
+}
+
+extern "C" MXNET_DLL int MXNDListGet(NDListHandle handle, mx_uint index,
+                                     const char **out_key,
+                                     const mx_float **out_data,
+                                     const mx_uint **out_shape,
+                                     mx_uint *out_ndim) {
+  auto *list = static_cast<NDListObj *>(handle);
+  if (!list || index >= list->keys.size()) {
+    LastError() = "MXNDListGet: index out of range";
+    return -1;
+  }
+  *out_key = list->keys[index].c_str();
+  *out_data = list->data[index].data();
+  *out_shape = list->shapes[index].data();
+  *out_ndim = static_cast<mx_uint>(list->shapes[index].size());
+  return 0;
+}
+
+extern "C" MXNET_DLL int MXNDListFree(NDListHandle handle) {
+  delete static_cast<NDListObj *>(handle);
   return 0;
 }
 

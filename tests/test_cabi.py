@@ -99,3 +99,93 @@ def test_c_program_end_to_end(tmp_path):
         x[:4], np.zeros(4, np.float32), batch_size=4)).asnumpy().ravel()
     np.testing.assert_allclose(got, mod_out[:len(got)], rtol=1e-3,
                                atol=1e-5)
+
+
+def test_ndlist_and_partial_forward_from_c(tmp_path):
+    """The last 4 c_predict_api.h names (VERDICT r3 item 10) work, not
+    just link: MXNDListCreate/Get/Free round-trip a mean-image .nd blob
+    (keys, data, shapes) and MXPredPartialForward follows the header's
+    documented loop contract (step from 0 until step_left == 0)."""
+    import ctypes
+
+    lib = ctypes.CDLL(_ensure_lib())
+
+    # --- NDList: save a dict of arrays with mx.nd.save, load via C ---
+    mean = np.arange(12, dtype=np.float32).reshape(3, 2, 2)
+    std = np.full((3,), 58.8, np.float32)
+    path = str(tmp_path / "mean.nd")
+    mx.nd.save(path, {"mean_img": mx.nd.array(mean),
+                      "std": mx.nd.array(std)})
+    blob = open(path, "rb").read()
+
+    handle = ctypes.c_void_p()
+    length = ctypes.c_uint32()
+    rc = lib.MXNDListCreate(ctypes.c_char_p(blob), ctypes.c_int(len(blob)),
+                            ctypes.byref(handle), ctypes.byref(length))
+    assert rc == 0, ctypes.string_at(lib.MXGetLastError()).decode()
+    assert length.value == 2
+
+    got = {}
+    for i in range(length.value):
+        key = ctypes.c_char_p()
+        data = ctypes.POINTER(ctypes.c_float)()
+        shape = ctypes.POINTER(ctypes.c_uint32)()
+        ndim = ctypes.c_uint32()
+        rc = lib.MXNDListGet(handle, ctypes.c_uint32(i),
+                             ctypes.byref(key), ctypes.byref(data),
+                             ctypes.byref(shape), ctypes.byref(ndim))
+        assert rc == 0
+        shp = tuple(shape[d] for d in range(ndim.value))
+        n = int(np.prod(shp))
+        got[key.value.decode()] = np.array(
+            [data[j] for j in range(n)], np.float32).reshape(shp)
+    np.testing.assert_array_equal(got["mean_img"], mean)
+    np.testing.assert_array_equal(got["std"], std)
+    # out-of-range index is an error, not a crash
+    key = ctypes.c_char_p()
+    data = ctypes.POINTER(ctypes.c_float)()
+    shape = ctypes.POINTER(ctypes.c_uint32)()
+    ndim = ctypes.c_uint32()
+    assert lib.MXNDListGet(handle, ctypes.c_uint32(99), ctypes.byref(key),
+                           ctypes.byref(data), ctypes.byref(shape),
+                           ctypes.byref(ndim)) != 0
+    assert lib.MXNDListFree(handle) == 0
+
+    # --- PartialForward: header's documented loop, vs full forward ---
+    prefix, x, _, mod = _train_and_save(tmp_path)
+    sym_json = open(prefix + "-symbol.json").read().encode()
+    params = open(prefix + "-0001.params", "rb").read()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    shp = (ctypes.c_uint32 * 2)(4, 8)
+    pred = ctypes.c_void_p()
+    rc = lib.MXPredCreate(ctypes.c_char_p(sym_json),
+                          ctypes.c_char_p(params),
+                          ctypes.c_int(len(params)), 1, 0, 1, keys,
+                          indptr, shp, ctypes.byref(pred))
+    assert rc == 0, ctypes.string_at(lib.MXGetLastError()).decode()
+    xin = np.ascontiguousarray(x[:4], np.float32)
+    rc = lib.MXPredSetInput(pred, b"data",
+                            xin.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_float)),
+                            ctypes.c_uint32(xin.size))
+    assert rc == 0
+    step_left = ctypes.c_int(1)
+    steps = 0
+    while step_left.value != 0:
+        rc = lib.MXPredPartialForward(pred, ctypes.c_int(steps),
+                                      ctypes.byref(step_left))
+        assert rc == 0
+        steps += 1
+        assert steps < 10000
+    assert steps > 1  # a real multi-node graph reports real progress
+    out = np.zeros((4, 2), np.float32)
+    rc = lib.MXPredGetOutput(pred, 0,
+                             out.ctypes.data_as(
+                                 ctypes.POINTER(ctypes.c_float)),
+                             ctypes.c_uint32(out.size))
+    assert rc == 0
+    mod_out = mod.predict(mx.io.NDArrayIter(
+        x[:4], np.zeros(4, np.float32), batch_size=4)).asnumpy()
+    np.testing.assert_allclose(out, mod_out, rtol=1e-4, atol=1e-5)
+    assert lib.MXPredFree(pred) == 0
