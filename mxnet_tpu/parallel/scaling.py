@@ -81,7 +81,8 @@ def collective_stats(hlo_text: str) -> Dict[str, Dict[str, float]]:
     return out
 
 
-def _child_code(n: int, steps: int, batch: int) -> str:
+def _child_code(n: int, steps: int, batch: int, dtype: str = "",
+                lr: float = 0.05) -> str:
     return r"""
 import json, os, sys
 import numpy as np
@@ -97,25 +98,50 @@ from mxnet_tpu.parallel.scaling import collective_stats
 
 np.random.seed(0); mx.random.seed(0)
 n = %d
+dtype = %r or None
 net = vision.resnet18_v1(classes=16)
 net.initialize(mx.init.Xavier())
 mesh = make_mesh((n,), ("dp",), jax.devices()[:n])
 step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
-                      mesh=mesh, learning_rate=0.05, momentum=0.9)
+                      mesh=mesh, learning_rate=%r, momentum=0.9,
+                      dtype=dtype)
 X = nd.random.uniform(shape=(%d, 3, 32, 32))
 y = nd.array((np.arange(%d) %% 16).astype("float32"))
 losses = step.run_steps(X, y, steps=%d)
 tr = [float(v) for v in np.asarray(losses.asnumpy()).reshape(-1)]
 comp = step._multi_step_same[%d].lower(
     step._param_vals, step._moms,
-    jax.device_put(X._data, step._data_sh),
+    jax.device_put(X._data.astype(dtype) if dtype else X._data,
+                   step._data_sh),
     jax.device_put(y._data, step._data_sh),
     step._key_root, step._key_ctr).compile()
 stats = collective_stats(comp.as_text())
 print("SCALING_CHILD " + json.dumps({"n": n, "losses": tr,
                                      "collectives": stats}))
 """ % (os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), n, batch, batch, steps, steps)
+        os.path.abspath(__file__)))), n, dtype, lr, batch, batch, steps,
+        steps)
+
+
+def _run_child(n: int, code: str, timeout: int, x64: bool = False) -> Dict:
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    if x64:
+        env["JAX_ENABLE_X64"] = "1"
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (flags +
+                        " --xla_force_host_platform_device_count=%d"
+                        % n).strip()
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        return {"n": n, "error": (proc.stdout + proc.stderr)[-1500:]}
+    for line in proc.stdout.splitlines():
+        if line.startswith("SCALING_CHILD "):
+            return json.loads(line[len("SCALING_CHILD "):])
+    return {"n": n, "error": "no child output"}
 
 
 def sweep(device_counts: Sequence[int] = (1, 2, 4, 8),
@@ -127,28 +153,8 @@ def sweep(device_counts: Sequence[int] = (1, 2, 4, 8),
     trajectory must reproduce the single-device one."""
     results: List[Dict] = []
     for n in device_counts:
-        env = dict(os.environ)
-        env["PALLAS_AXON_POOL_IPS"] = ""
-        env["JAX_PLATFORMS"] = "cpu"
-        flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
-                         if "host_platform_device_count" not in f)
-        env["XLA_FLAGS"] = (flags +
-                            " --xla_force_host_platform_device_count=%d"
-                            % n).strip()
-        proc = subprocess.run([sys.executable, "-c",
-                               _child_code(n, steps, batch)],
-                              env=env, capture_output=True, text=True,
-                              timeout=timeout)
-        if proc.returncode != 0:
-            results.append({"n": n, "error":
-                            (proc.stdout + proc.stderr)[-1500:]})
-            continue
-        for line in proc.stdout.splitlines():
-            if line.startswith("SCALING_CHILD "):
-                results.append(json.loads(line[len("SCALING_CHILD "):]))
-                break
-        else:
-            results.append({"n": n, "error": "no child output"})
+        results.append(_run_child(n, _child_code(n, steps, batch),
+                                  timeout))
 
     ref = next((r for r in results if r.get("n") == 1
                 and "losses" in r), None)
@@ -158,7 +164,8 @@ def sweep(device_counts: Sequence[int] = (1, 2, 4, 8),
         # the first two losses see at most one parameter update: fp
         # reduction-order noise only, so the tolerance is tight.  Later
         # steps amplify that noise through the (chaotic) training
-        # dynamics — reported as drift, not failed.
+        # dynamics — reported as drift, quantified as chaos by
+        # control_sweep (fp64: the same trajectories collapse together).
         head = [abs(a - b) / max(abs(a), 1e-6)
                 for a, b in zip(r["losses"][:2], ref["losses"][:2])]
         drift = max(abs(a - b) / max(abs(a), 1e-6)
@@ -167,6 +174,105 @@ def sweep(device_counts: Sequence[int] = (1, 2, 4, 8),
         r["trajectory_rel_drift"] = round(drift, 6)
         r["numerically_consistent"] = bool(max(head) < 1e-4)
     return {"steps": steps, "global_batch": batch, "sweep": results}
+
+
+def control_sweep(device_counts: Sequence[int] = (1, 2, 8),
+                  steps: int = 4, batch: int = 16,
+                  timeout: int = 1200) -> Dict:
+    """The drift-is-chaos control (VERDICT r3 item 6).
+
+    The fp32 sweep's multi-step trajectories diverge ~0.5 rel by step 4;
+    the claim is that this is fp reduction-order noise amplified by
+    chaotic training dynamics, not a sharding bug.  Two controls make
+    that falsifiable:
+
+    * ``fp64``: identical sweep at float64 — reduction-order noise
+      shrinks from ~1e-7 to ~1e-16 per op, so if chaos (noise
+      amplification) is the cause, MULTI-STEP trajectories must now
+      agree across n to ~1e-9.  A sharding bug (wrong mean, missing
+      rows, rank-dependent masking) would NOT shrink with precision.
+    * ``lr0``: fp32, learning rate 0 — parameters never move, so step k
+      repeats step 0 and nothing amplifies; every step must match
+      across n to first-step tolerance.  Isolates the update feedback
+      loop as the amplifier.
+    """
+    out: Dict[str, Dict] = {}
+    for name, dtype, lr, x64, tol in (
+            ("fp64", "float64", 0.05, True, 1e-9),
+            ("lr0", "", 0.0, False, 1e-4)):
+        results = [
+            _run_child(n, _child_code(n, steps, batch, dtype=dtype, lr=lr),
+                       timeout, x64=x64)
+            for n in device_counts]
+        ref = next((r for r in results if r.get("n") == 1
+                    and "losses" in r), None)
+        ok = ref is not None
+        for r in results:
+            if "losses" not in r:
+                ok = False
+                continue
+            if r is ref or ref is None:
+                continue
+            drift = max(abs(a - b) / max(abs(a), 1e-12)
+                        for a, b in zip(r["losses"], ref["losses"]))
+            r["multi_step_rel_drift"] = float(drift)
+            r["multi_step_consistent"] = bool(drift < tol)
+            ok = ok and r["multi_step_consistent"]
+        out[name] = {"dtype": dtype or "float32", "lr": lr,
+                     "tolerance": tol, "steps": steps,
+                     "sweep": results, "all_consistent": ok}
+    return out
+
+
+def mp_placement_sweep(timeout: int = 1200) -> Dict:
+    """dp×mp second workload (VERDICT r3 item 6): the reference's OWN
+    model-parallel LSTM (example/model-parallel/lstm/lstm.py, run
+    byte-identical through tests/mp_lstm_runner.py) trained with
+    ctx_group placement over 1 vs 2 device groups.
+
+    Placement moves buffers, not the algorithm: the per-epoch NLL
+    trajectory must agree across group counts to fp tolerance.  (Not
+    bitwise: each placement compiles DIFFERENT per-device XLA programs,
+    whose fusion choices reorder fp32 reductions — measured ~2.5e-5
+    rel.  A placement bug — wrong copy, stale buffer, dropped grad —
+    shows up orders of magnitude above that.)"""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    runner = os.path.join(root, "tests", "mp_lstm_runner.py")
+    out: Dict[str, object] = {"workload": "model-parallel LSTM "
+                              "(reference lstm.py, ctx_group placement)"}
+    trajs = {}
+    for ngpu in (1, 2):
+        env = dict(os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MP_LSTM_NGPU"] = str(ngpu)
+        flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                         if "host_platform_device_count" not in f)
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8")
+        proc = subprocess.run([sys.executable, runner], env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode != 0 or "MP_LSTM_OK" not in proc.stdout:
+            out["ngpu%d" % ngpu] = {
+                "error": (proc.stdout + proc.stderr)[-1500:]}
+            continue
+        nlls = [float(m) for m in
+                re.findall(r"Train: Time: [\d.]+ sec, NLL=([\d.]+)",
+                           proc.stdout)]
+        trajs[ngpu] = nlls
+        out["ngpu%d" % ngpu] = {"train_nll": nlls}
+    if 1 in trajs and 2 in trajs and trajs[1] and trajs[2] and \
+            len(trajs[1]) == len(trajs[2]):
+        rel = max(abs(a - b) / max(abs(a), 1e-9)
+                  for a, b in zip(trajs[1], trajs[2]))
+        out["max_rel_diff"] = rel
+        out["tolerance"] = 1e-3
+        out["trajectories_match"] = bool(rel < 1e-3)
+    else:
+        out["trajectories_match"] = False
+    return out
 
 
 def resnet50_grad_bytes(dtype_bytes: int = 4) -> int:

@@ -66,9 +66,12 @@ def main():
     vocab = 50
     buckets = [12]
 
-    # the reference placement plan (lstm_ptb.py:96-100) on 2 virtual
-    # devices: embed on gpu(0), decode on the last, layers striped
-    ngpu = 2
+    # the reference placement plan (lstm_ptb.py:96-100) on N virtual
+    # devices: embed on gpu(0), decode on the last, layers striped.
+    # MP_LSTM_NGPU=1 collapses every group onto one device — used by the
+    # scaling harness's placement-invariance control
+    # (parallel/scaling.py mp_placement_sweep)
+    ngpu = int(os.environ.get("MP_LSTM_NGPU", "2"))
     group2ctx = {"embed": mx.gpu(0), "decode": mx.gpu(ngpu - 1)}
     for i in range(num_lstm_layer):
         group2ctx["layer%d" % i] = mx.gpu(i * ngpu // num_lstm_layer)
@@ -91,8 +94,12 @@ def main():
     embed_dev = devs["embed_weight"]
     decode_dev = devs["cls_weight"]  # 'decode' ctx_group (lstm.py:68-70)
     print("embed on", embed_dev, "| decode on", decode_dev)
-    assert embed_dev != decode_dev, \
-        "embed and decode must be placed on different devices"
+    if ngpu > 1:
+        assert embed_dev != decode_dev, \
+            "embed and decode must be placed on different devices"
+    else:
+        assert embed_dev == decode_dev, \
+            "single-group control must land on one device"
 
     train = TinyBucketIter(vocab, buckets, batch_size, n_batches=6, seed=0)
     val = TinyBucketIter(vocab, buckets, batch_size, n_batches=2, seed=1)

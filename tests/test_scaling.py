@@ -51,3 +51,31 @@ def test_sweep_consistency_and_collectives():
         # the gradient exchange must be real: >= resnet18's ~44 MB of
         # parameters go over the wire every step
         assert ar["bytes"] > 40e6, ar
+
+
+@pytest.mark.slow
+def test_control_sweep_fp64_and_lr0():
+    """VERDICT r3 item 6: the drift-is-chaos claim made falsifiable.
+    fp64 multi-step trajectories must agree across n to 1e-9 (a real
+    sharding bug would not shrink with precision); lr=0 trajectories
+    must be flat and equal at first-step tolerance."""
+    out = scaling.control_sweep(device_counts=(1, 2), steps=3, batch=8)
+    for name in ("fp64", "lr0"):
+        blk = out[name]
+        assert blk["all_consistent"], blk
+        rows = [r for r in blk["sweep"] if r.get("n") == 2]
+        assert rows and rows[0]["multi_step_consistent"], blk
+    # fp64's drift must be orders below fp32's first-step tolerance
+    fp64_row = [r for r in out["fp64"]["sweep"] if r.get("n") == 2][0]
+    assert fp64_row["multi_step_rel_drift"] < 1e-9
+
+
+@pytest.mark.slow
+def test_mp_placement_sweep_matches():
+    """The ctx_group model-parallel LSTM (reference lstm.py) trained on
+    1 vs 2 device groups: placement must not change the training math
+    beyond per-program fp reorder noise."""
+    out = scaling.mp_placement_sweep()
+    assert out["trajectories_match"], out
+    assert out["max_rel_diff"] < 1e-3
+    assert len(out["ngpu1"]["train_nll"]) >= 2
