@@ -311,19 +311,33 @@ class Executor:
             arg_dict = dict(zip(arg_names, args))
         else:
             arg_dict = dict(args or {})
+        # reference grad semantics (symbol.py:1638, "one can give up
+        # gradient by using a dict in args_grad and only specify
+        # gradient they interested in"): args_grad=None means NO
+        # gradients; a dict grants them only to the listed names —
+        # everything else is effectively grad_req='null' (the
+        # autoencoder example's Solver iterates grad_arrays expecting
+        # None for data inputs)
         if isinstance(args_grad, (list, tuple)):
             grad_dict = dict(zip(arg_names, args_grad))
         else:
             grad_dict = dict(args_grad or {})
+
+        def _declared_req(name):
+            if isinstance(grad_req, str):
+                return grad_req
+            if isinstance(grad_req, (list, tuple)):
+                return dict(zip(arg_names, grad_req)).get(name, "null")
+            return grad_req.get(name, "null")
+
+        eff_req = {}
         for name in arg_names:
-            if name in grad_dict:
-                continue
-            req = grad_req if isinstance(grad_req, str) else grad_req.get(name, "null")
-            if req != "null" and name in arg_dict:
-                src = arg_dict[name]
-                grad_dict[name] = _nd_mod.zeros(src.shape, ctx=ctx, dtype=src.dtype)
+            if name in grad_dict and grad_dict[name] is not None:
+                eff_req[name] = _declared_req(name)
             else:
                 grad_dict[name] = None
+                eff_req[name] = "null"
+        grad_req = eff_req
         if isinstance(aux_states, (list, tuple)):
             aux_dict = dict(zip(aux_names, aux_states))
         else:

@@ -82,6 +82,12 @@ class DataIter:
     def reset(self):
         pass
 
+    def hard_reset(self):
+        """Ignore any roll-over state and restart from the beginning
+        (ref: io.py NDArrayIter.hard_reset; the autoencoder example's
+        extract_feature depends on it)."""
+        self.reset()
+
     def next(self) -> DataBatch:
         if self.iter_next():
             return DataBatch(self.getdata(), self.getlabel(),
@@ -167,6 +173,10 @@ class NDArrayIter(DataIter):
             self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
         else:
             self.cursor = -self.batch_size
+
+    def hard_reset(self):
+        """Ignore roll over data and set to start (ref io.py:688)."""
+        self.cursor = -self.batch_size
 
     def iter_next(self) -> bool:
         self.cursor += self.batch_size

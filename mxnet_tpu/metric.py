@@ -122,11 +122,22 @@ class CompositeEvalMetric(EvalMetric):
         return names, values
 
 
-def _check_label_shapes(labels, preds):
-    if len(labels) != len(preds):
+def check_label_shapes(labels, preds, shape=0):
+    """Public surface (ref metric.py:33 — custom metrics in example
+    code call it, e.g. example/multi-task): compare counts, or shapes
+    with shape=1."""
+    if shape == 0:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
         raise ValueError(
-            "label/pred count mismatch: %d vs %d" % (len(labels), len(preds))
-        )
+            "Shape of labels %s does not match shape of predictions %s"
+            % (label_shape, pred_shape))
+
+
+def _check_label_shapes(labels, preds):
+    check_label_shapes(labels, preds)
 
 
 @register

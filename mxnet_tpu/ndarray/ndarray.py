@@ -149,7 +149,14 @@ class NDArray:
     wait_to_write = wait_to_read
 
     def asnumpy(self) -> _np.ndarray:
-        return _np.asarray(self._data)
+        """An OWNED, WRITABLE copy — the reference contract
+        (ndarray.py asnumpy copies device memory into a fresh array;
+        example code mutates the result in place, e.g.
+        example/numpy-ops/custom_softmax.py:39 backward)."""
+        out = _np.asarray(self._data)
+        if not out.flags.writeable:
+            out = _np.array(out)
+        return out
 
     def asscalar(self):
         if self.size != 1:

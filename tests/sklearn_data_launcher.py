@@ -1,0 +1,48 @@
+"""Launcher for reference scripts whose data loader is the sklearn-0.x
+``fetch_mldata`` (removed from sklearn years ago; this environment is
+offline anyway): installs a synthetic 'MNIST original' into
+sklearn.datasets, then runs the UNTOUCHED reference script via runpy.
+
+Pattern precedent: the SSD byte-identical test's launcher aliasing the
+py3.10-removed ``collections.Mapping`` name — no reference file is
+touched; the script's own code path (including its real sklearn PCA
+call) executes as written.
+
+The synthetic set mirrors the mldata Bunch shape the scripts consume:
+``.data`` (n, 784) float32, ``.target`` (n,) float — with a
+class-dependent block pattern so PCA features stay class-separable.
+``SYN_MNIST_N`` sizes it; scripts hard-slice [:60000]/[60000:], so the
+default leaves a 256-sample test tail.
+"""
+import os
+import sys
+import types
+
+import numpy as np
+
+
+def fetch_mldata(dataname, data_home=None):
+    assert "MNIST" in dataname, dataname
+    rng = np.random.RandomState(42)
+    n = int(os.environ.get("SYN_MNIST_N", "60256"))
+    y = (np.arange(n) % 10).astype(np.float64)
+    X = rng.randint(0, 25, (n, 784)).astype(np.float32)
+    for c in range(10):
+        X[y == c, c * 70:(c + 1) * 70] += 160.0
+    return types.SimpleNamespace(data=X, target=y)
+
+
+def main():
+    import sklearn.datasets as skd
+
+    skd.fetch_mldata = fetch_mldata
+    script = sys.argv[1]
+    sys.argv = [script] + sys.argv[2:]
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script)))
+    import runpy
+
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()
