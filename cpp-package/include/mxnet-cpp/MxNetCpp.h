@@ -13,6 +13,8 @@
 #include "mxnet-cpp/monitor.h"
 #include "mxnet-cpp/ndarray.h"
 #include "mxnet-cpp/op.h"
+#include "mxnet-cpp/op_suppl.h"
+#include "mxnet-cpp/operator.h"
 #include "mxnet-cpp/optimizer.h"
 #include "mxnet-cpp/shape.h"
 #include "mxnet-cpp/symbol.h"

@@ -11,6 +11,7 @@
 
 #include <cstdint>
 #include <iostream>
+#include <map>
 #include <memory>
 #include <sstream>
 #include <stdexcept>
@@ -45,6 +46,29 @@ class Context {
   DeviceType type_;
   int id_;
 };
+
+/* op-creator lookup, cached: one registry walk total, then O(1) —
+ * shared by Symbol::CreateAtomic, NDArray arithmetic and the
+ * optimizers (hot paths like mlp.cpp's 20k-iteration update loop call
+ * this per op) */
+inline void *FindOpCreator(const std::string &op) {
+  static std::map<std::string, void *> *cache = [] {
+    auto *m = new std::map<std::string, void *>();
+    mx_uint n = 0;
+    void **arr = nullptr;
+    MXCPP_CHECK(MXSymbolListAtomicSymbolCreators(&n, &arr));
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *name = nullptr;
+      MXCPP_CHECK(MXSymbolGetAtomicSymbolName(arr[i], &name));
+      (*m)[name] = arr[i];
+    }
+    return m;
+  }();
+  auto it = cache->find(op);
+  if (it == cache->end())
+    throw std::runtime_error("op not found: " + op);
+  return it->second;
+}
 
 /* dmlc LOG(INFO)-style stream: one line per statement */
 struct LogBlob {

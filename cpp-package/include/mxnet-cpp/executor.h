@@ -13,6 +13,15 @@
 namespace mxnet {
 namespace cpp {
 
+/* ref: include/mxnet/op_attr_types.h OpReqType (examples pass these to
+ * the raw Executor ctor, mlp.cpp:134) */
+enum OpReqType {
+  kNullOp = 0,
+  kWriteTo = 1,
+  kWriteInplace = 2,
+  kAddTo = 3,
+};
+
 class Executor {
  public:
   Executor(void *handle, std::vector<NDArray> args,
@@ -22,6 +31,39 @@ class Executor {
         h_(handle, [](void *p) {
           if (p) MXExecutorFree(p);
         }) {
+    RefreshOutputs();
+  }
+
+  /* the raw bind ctor the examples use (ref executor.h: Executor(sym,
+   * ctx, in_args, arg_grad_store, grad_req_type, aux_states)) */
+  Executor(const Symbol &symbol, const Context &context,
+           const std::vector<NDArray> &arg_arrays_in,
+           const std::vector<NDArray> &grad_arrays_in,
+           const std::vector<OpReqType> &grad_reqs,
+           const std::vector<NDArray> &aux_arrays_in)
+      : arg_arrays(arg_arrays_in), grad_arrays(grad_arrays_in),
+        aux_arrays(aux_arrays_in) {
+    if (grad_arrays.size() != arg_arrays.size() ||
+        grad_reqs.size() != arg_arrays.size())
+      throw std::runtime_error(
+          "Executor: args/grads/reqs must have equal length (use "
+          "empty NDArray{} + kNullOp entries for no-grad arguments)");
+    std::vector<void *> args, grads, auxs;
+    std::vector<mx_uint> reqs;
+    for (auto &a : arg_arrays) args.push_back(a.GetHandle());
+    for (auto &g : grad_arrays) grads.push_back(g.GetHandle());
+    for (auto r : grad_reqs) reqs.push_back(static_cast<mx_uint>(r));
+    for (auto &a : aux_arrays) auxs.push_back(a.GetHandle());
+    void *out = nullptr;
+    MXCPP_CHECK(MXExecutorBindEX(
+        symbol.GetHandle(), context.GetDeviceType(), context.GetDeviceId(),
+        0, nullptr, nullptr, nullptr,
+        static_cast<mx_uint>(args.size()), args.data(), grads.data(),
+        reqs.data(), static_cast<mx_uint>(auxs.size()),
+        auxs.empty() ? nullptr : auxs.data(), nullptr, &out));
+    h_.reset(out, [](void *p) {
+      if (p) MXExecutorFree(p);
+    });
     RefreshOutputs();
   }
 

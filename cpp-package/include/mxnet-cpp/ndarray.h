@@ -54,6 +54,10 @@ class NDArray {
     out->resize(Size());
     MXCPP_CHECK(MXNDArraySyncCopyToCPU(h_.get(), out->data(), out->size()));
   }
+  /* raw-pointer flavor (mlp.cpp:158 out[0].SyncCopyToCPU(cptr, n)) */
+  void SyncCopyToCPU(mx_float *data, size_t size) const {
+    MXCPP_CHECK(MXNDArraySyncCopyToCPU(h_.get(), data, size));
+  }
   std::vector<mx_float> Copy() const {
     std::vector<mx_float> out;
     SyncCopyToCPU(&out);
@@ -68,8 +72,114 @@ class NDArray {
   void WaitToRead() const { MXCPP_CHECK(MXNDArrayWaitToRead(h_.get())); }
   static void WaitAll() { MXCPP_CHECK(MXNDArrayWaitAll()); }
 
+  /* -- arithmetic surface the reference examples drive ---------------
+   * (mlp.cpp:104 `array_w_1 = 0.5f`, :168 `in_args[i] -=
+   * arg_grad_store[i] * learning_rate`; lenet.cpp Slice/Copy chains) */
+
+  NDArray &operator=(mx_float scalar) {
+    std::vector<mx_float> fill(Size(), scalar);
+    SyncCopyFromCPU(fill.data(), fill.size());
+    return *this;
+  }
+
+  /* one-output imperative invoke over the same ABI the optimizers use */
+  static NDArray Invoke(const std::string &op,
+                        const std::vector<NDArray> &ins,
+                        const std::vector<const char *> &keys = {},
+                        const std::vector<const char *> &vals = {},
+                        NDArray *out = nullptr) {
+    std::vector<void *> handles;
+    for (auto &a : ins) handles.push_back(a.GetHandle());
+    int n_out = out ? 1 : 0;
+    void *out_h = out ? out->GetHandle() : nullptr;
+    void **outs = out ? &out_h : nullptr;
+    MXCPP_CHECK(MXImperativeInvoke(
+        FindOpCreator(op), static_cast<int>(handles.size()),
+        handles.data(), &n_out, &outs,
+        static_cast<int>(keys.size()),
+        const_cast<const char **>(keys.data()),
+        const_cast<const char **>(vals.data())));
+    return out ? *out : NDArray(outs[0]);
+  }
+
+  NDArray operator+(const NDArray &rhs) const {
+    return Invoke("elemwise_add", {*this, rhs});
+  }
+  NDArray operator-(const NDArray &rhs) const {
+    return Invoke("elemwise_sub", {*this, rhs});
+  }
+  NDArray operator*(const NDArray &rhs) const {
+    return Invoke("elemwise_mul", {*this, rhs});
+  }
+  NDArray operator/(const NDArray &rhs) const {
+    return Invoke("elemwise_div", {*this, rhs});
+  }
+  NDArray operator*(mx_float s) const {
+    std::string v = std::to_string(s);
+    return Invoke("_mul_scalar", {*this}, {"scalar"}, {v.c_str()});
+  }
+  NDArray operator+(mx_float s) const {
+    std::string v = std::to_string(s);
+    return Invoke("_plus_scalar", {*this}, {"scalar"}, {v.c_str()});
+  }
+  NDArray operator-(mx_float s) const {
+    std::string v = std::to_string(s);
+    return Invoke("_minus_scalar", {*this}, {"scalar"}, {v.c_str()});
+  }
+  NDArray operator/(mx_float s) const {
+    std::string v = std::to_string(s);
+    return Invoke("_div_scalar", {*this}, {"scalar"}, {v.c_str()});
+  }
+  NDArray &operator-=(const NDArray &rhs) {
+    Invoke("elemwise_sub", {*this, rhs}, {}, {}, this);
+    return *this;
+  }
+  NDArray &operator+=(const NDArray &rhs) {
+    Invoke("elemwise_add", {*this, rhs}, {}, {}, this);
+    return *this;
+  }
+
+  /* first-axis slice view-copy (ref ndarray.h Slice; value semantics
+   * here — XLA buffers are immutable, and every example use is read) */
+  NDArray Slice(mx_uint begin, mx_uint end) const {
+    std::string b = std::to_string(begin), e = std::to_string(end);
+    return Invoke("slice_axis", {*this},
+                  {"axis", "begin", "end"},
+                  {"0", b.c_str(), e.c_str()});
+  }
+
+  /* in-place samplers (ref ndarray.h; lenet_with_mxdataiter.cpp:85) */
+  static void SampleGaussian(mx_float mu, mx_float sigma, NDArray *out) {
+    Shape s = out->GetShape();
+    std::string loc = std::to_string(mu), sc = std::to_string(sigma),
+        shp = s.Str();
+    Invoke("_random_normal", {}, {"loc", "scale", "shape"},
+           {loc.c_str(), sc.c_str(), shp.c_str()}, out);
+  }
+  static void SampleUniform(mx_float low, mx_float high, NDArray *out) {
+    Shape s = out->GetShape();
+    std::string lo = std::to_string(low), hi = std::to_string(high),
+        shp = s.Str();
+    Invoke("_random_uniform", {}, {"low", "high", "shape"},
+           {lo.c_str(), hi.c_str(), shp.c_str()}, out);
+  }
+
+  /* device copy (lenet.cpp `.Copy(ctx_dev)`) */
+  NDArray Copy(const Context &ctx) const {
+    NDArray dst(GetShape(), ctx);
+    CopyTo(&dst);
+    return dst;
+  }
+
+  /* host pointer into a cached copy (lenet.cpp GetData readback) */
+  const mx_float *GetData() const {
+    host_cache_ = std::make_shared<std::vector<mx_float>>(Copy());
+    return host_cache_->data();
+  }
+
  private:
   std::shared_ptr<void> h_;
+  mutable std::shared_ptr<std::vector<mx_float>> host_cache_;
 };
 
 }  // namespace cpp

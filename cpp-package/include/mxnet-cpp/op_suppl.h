@@ -1,0 +1,67 @@
+/* ref: cpp-package/include/mxnet-cpp/op_suppl.h — hand-maintained
+ * supplements beside the generated op.h: Symbol arithmetic operators
+ * (resnet.cpp:108 `lhs + shortcut`) and the string-typed Activation
+ * overload (resnet.cpp:73 Activation(name, sym, "relu")).
+ * Reimplemented over this build's symbol ABI. */
+#ifndef MXNET_CPP_OP_SUPPL_H_
+#define MXNET_CPP_OP_SUPPL_H_
+
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/symbol.h"
+
+namespace mxnet {
+namespace cpp {
+
+inline Symbol _BinaryOp(const char *op, Symbol lhs, Symbol rhs) {
+  Symbol atomic = Symbol::CreateAtomic(op, {}, {});
+  return atomic.Compose("", {"lhs", "rhs"}, {lhs, rhs});
+}
+
+inline Symbol _ScalarOp(const char *op, Symbol data, mx_float scalar) {
+  std::string s = std::to_string(scalar);
+  std::vector<const char *> keys{"scalar"}, vals{s.c_str()};
+  Symbol atomic = Symbol::CreateAtomic(op, keys, vals);
+  return atomic.Compose("", {"data"}, {data});
+}
+
+inline Symbol operator+(Symbol lhs, Symbol rhs) {
+  return _BinaryOp("elemwise_add", lhs, rhs);
+}
+inline Symbol operator-(Symbol lhs, Symbol rhs) {
+  return _BinaryOp("elemwise_sub", lhs, rhs);
+}
+inline Symbol operator*(Symbol lhs, Symbol rhs) {
+  return _BinaryOp("elemwise_mul", lhs, rhs);
+}
+inline Symbol operator/(Symbol lhs, Symbol rhs) {
+  return _BinaryOp("elemwise_div", lhs, rhs);
+}
+inline Symbol operator+(Symbol lhs, mx_float s) {
+  return _ScalarOp("_plus_scalar", lhs, s);
+}
+inline Symbol operator-(Symbol lhs, mx_float s) {
+  return _ScalarOp("_minus_scalar", lhs, s);
+}
+inline Symbol operator*(Symbol lhs, mx_float s) {
+  return _ScalarOp("_mul_scalar", lhs, s);
+}
+inline Symbol operator/(Symbol lhs, mx_float s) {
+  return _ScalarOp("_div_scalar", lhs, s);
+}
+
+/* string-typed Activation: the reference keeps this beside the
+ * enum-typed generated one (op_suppl.h) because examples pass "relu"
+ * literals */
+inline Symbol Activation(const std::string &symbol_name, Symbol act_input,
+                         const std::string &act_type) {
+  std::vector<const char *> keys{"act_type"}, vals{act_type.c_str()};
+  Symbol atomic = Symbol::CreateAtomic("Activation", keys, vals);
+  return atomic.Compose(symbol_name, {"data"}, {act_input});
+}
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_OP_SUPPL_H_

@@ -1,0 +1,71 @@
+/* ref: cpp-package/include/mxnet-cpp/operator.h — the stringly-typed
+ * op builder (Operator("Convolution").SetParam(...).SetInput(...)
+ * .CreateSymbol(name)) used throughout the reference's examples
+ * (alexnet.cpp:35, resnet.cpp:40, googlenet.cpp, charRNN.cpp).
+ * Reimplemented over this build's symbol ABI: params collect as
+ * strings, CreateSymbol lowers to MXSymbolCreateAtomicSymbol +
+ * MXSymbolCompose exactly like the generated typed wrappers in op.h. */
+#ifndef MXNET_CPP_OPERATOR_H_
+#define MXNET_CPP_OPERATOR_H_
+
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/base.h"
+#include "mxnet-cpp/symbol.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Operator {
+ public:
+  explicit Operator(const std::string &operator_name)
+      : op_name_(operator_name) {}
+
+  template <typename T>
+  Operator &SetParam(const std::string &name, const T &value) {
+    std::ostringstream os;
+    os << value;
+    params_[name] = os.str();
+    return *this;
+  }
+
+  Operator &SetInput(const std::string &name, Symbol symbol) {
+    input_names_.push_back(name);
+    inputs_.push_back(symbol);
+    return *this;
+  }
+
+  /* positional input (reference op_util.h shift operator path) */
+  Operator &PushInput(const Symbol &symbol) {
+    input_names_.push_back("arg" + std::to_string(inputs_.size()));
+    inputs_.push_back(symbol);
+    return *this;
+  }
+
+  Operator &operator()(const Symbol &symbol) { return PushInput(symbol); }
+
+  Symbol CreateSymbol(const std::string &name = "") {
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    Symbol atomic = Symbol::CreateAtomic(op_name_, keys, vals);
+    std::vector<const char *> in_names;
+    for (auto &n : input_names_) in_names.push_back(n.c_str());
+    return atomic.Compose(name, in_names, inputs_);
+  }
+
+ private:
+  std::string op_name_;
+  std::map<std::string, std::string> params_;
+  std::vector<std::string> input_names_;
+  std::vector<Symbol> inputs_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+#endif  // MXNET_CPP_OPERATOR_H_

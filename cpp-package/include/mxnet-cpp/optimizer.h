@@ -10,6 +10,7 @@
 #include <vector>
 
 #include "mxnet-cpp/base.h"
+#include "mxnet-cpp/lr_scheduler.h"
 #include "mxnet-cpp/ndarray.h"
 
 namespace mxnet {
@@ -24,6 +25,16 @@ class Optimizer {
     std::ostringstream os;
     os << value;
     params_[name] = os.str();
+    if (name == "lr" && scheduler_) scheduler_->SetLR(std::stof(params_[name]));
+    return this;
+  }
+
+  /* ref optimizer.h SetLRScheduler: the scheduler owns the rate from
+   * now on, seeded from any lr already set (test_score.cpp:97) */
+  Optimizer *SetLRScheduler(std::unique_ptr<LRScheduler> scheduler) {
+    scheduler_ = std::move(scheduler);
+    auto it = params_.find("lr");
+    if (it != params_.end()) scheduler_->SetLR(std::stof(it->second));
     return this;
   }
 
@@ -31,22 +42,16 @@ class Optimizer {
 
  protected:
   void *Creator(const std::string &op) {
-    mx_uint n = 0;
-    void **arr = nullptr;
-    MXCPP_CHECK(MXSymbolListAtomicSymbolCreators(&n, &arr));
-    for (mx_uint i = 0; i < n; ++i) {
-      const char *name = nullptr;
-      MXCPP_CHECK(MXSymbolGetAtomicSymbolName(arr[i], &name));
-      if (op == name) return arr[i];
-    }
-    throw std::runtime_error("optimizer op not found: " + op);
+    return FindOpCreator(op);  /* cached, base.h */
   }
   void Invoke(const std::string &op, std::vector<NDArrayHandle> ins,
               NDArrayHandle out,
               const std::map<std::string, std::string> &extra) {
     std::vector<const char *> keys, vals;
     for (auto &kv : params_) {
-      if (kv.first == "momentum") continue; /* state op selection only */
+      /* momentum selects the state op; lr always arrives via `extra`
+       * (scheduler-resolved) — both would duplicate keys here */
+      if (kv.first == "momentum" || kv.first == "lr") continue;
       keys.push_back(kv.first.c_str());
       vals.push_back(kv.second.c_str());
     }
@@ -60,12 +65,26 @@ class Optimizer {
         Creator(op), static_cast<int>(ins.size()), ins.data(), &n_out,
         &outs, static_cast<int>(keys.size()), keys.data(), vals.data()));
   }
+  /* per-index update counts -> num_update, the scheduler's clock
+   * (reference optimizer.hpp UpdateCount_/GetLR_) */
+  float ScheduledLR(int index) {
+    unsigned c = ++count_[index];
+    if (c > num_update_) num_update_ = c;
+    if (scheduler_) return scheduler_->GetLR(num_update_);
+    auto it = params_.find("lr");
+    return it != params_.end() ? std::stof(it->second) : 0.01f;
+  }
   std::map<std::string, std::string> params_;
+  std::unique_ptr<LRScheduler> scheduler_;
+  std::map<int, unsigned> count_;
+  unsigned num_update_ = 0;
 };
 
 class SGDOptimizer : public Optimizer {
  public:
   void Update(int index, NDArray weight, NDArray grad) override {
+    std::map<std::string, std::string> extra
+        {{"lr", std::to_string(ScheduledLR(index))}};
     auto it = params_.find("momentum");
     if (it != params_.end() && it->second != "0" && it->second != "0.0") {
       NDArray &mom = states_[index];
@@ -74,12 +93,13 @@ class SGDOptimizer : public Optimizer {
         std::vector<mx_float> z(weight.Size(), 0.0f);
         mom.SyncCopyFromCPU(z.data(), z.size());
       }
+      extra["momentum"] = it->second;
       Invoke("sgd_mom_update",
              {weight.GetHandle(), grad.GetHandle(), mom.GetHandle()},
-             weight.GetHandle(), {{"momentum", it->second}});
+             weight.GetHandle(), extra);
     } else {
       Invoke("sgd_update", {weight.GetHandle(), grad.GetHandle()},
-             weight.GetHandle(), {});
+             weight.GetHandle(), extra);
     }
   }
 

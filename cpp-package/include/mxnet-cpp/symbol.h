@@ -24,6 +24,10 @@ class Symbol {
       : h_(handle, [](void *p) {
           if (p) MXSymbolFree(p);
         }) {}
+  /* named-variable shorthand (ref symbol.h: Symbol("conv1_w") in
+   * lenet.cpp:47 and friends creates a Variable) */
+  explicit Symbol(const char *name) { *this = Variable(name); }
+  explicit Symbol(const std::string &name) { *this = Variable(name); }
 
   static Symbol Variable(const std::string &name) {
     void *out = nullptr;
@@ -113,7 +117,12 @@ class Symbol {
     for (mx_uint i = 0; i < in_size && i < names.size(); ++i) {
       if (args_map->count(names[i])) continue;
       std::vector<mx_uint> dims(in_data[i], in_data[i] + in_ndim[i]);
-      (*args_map)[names[i]] = NDArray(Shape(dims), ctx);
+      NDArray arr(Shape(dims), ctx);
+      /* reference semantics (symbol.hpp:322): unspecified arguments
+       * are N(0,1)-initialized, which the examples rely on to break
+       * symmetry before training */
+      NDArray::SampleGaussian(0, 1, &arr);
+      (*args_map)[names[i]] = arr;
     }
   }
 
@@ -129,15 +138,7 @@ class Symbol {
     return std::vector<std::string>(arr, arr + n);
   }
   static void *FindCreator(const std::string &op) {
-    mx_uint n = 0;
-    void **arr = nullptr;
-    MXCPP_CHECK(MXSymbolListAtomicSymbolCreators(&n, &arr));
-    for (mx_uint i = 0; i < n; ++i) {
-      const char *name = nullptr;
-      MXCPP_CHECK(MXSymbolGetAtomicSymbolName(arr[i], &name));
-      if (op == name) return arr[i];
-    }
-    throw std::runtime_error("operator not found: " + op);
+    return FindOpCreator(op);  /* cached, base.h */
   }
   std::shared_ptr<void> h_;
 };

@@ -142,7 +142,11 @@ def nd_wait(arr: NDArray) -> None:
 # operator registry + imperative invoke
 # ---------------------------------------------------------------------------
 def op_names() -> List[str]:
-    return _op_registry.list_ops()
+    """Every resolvable op name, ALIASES INCLUDED — the reference's
+    creator list carries both canonical and aliased names (e.g.
+    elemwise_add beside _binary_add), and cpp-package callers compose
+    through whichever the example uses."""
+    return _op_registry.list_ops(include_aliases=True)
 
 
 def op_info(name: str) -> Tuple[str, str, List[str]]:

@@ -221,7 +221,12 @@ def exists(name: str) -> bool:
     return name in _REGISTRY
 
 
-def list_ops() -> List[str]:
+def list_ops(include_aliases: bool = False) -> List[str]:
+    """Registered op names; with ``include_aliases`` every resolvable
+    lookup name (the reference's creator list carries both — e.g.
+    elemwise_add beside _binary_add)."""
+    if include_aliases:
+        return sorted(_REGISTRY.keys())
     return sorted({op.name for op in _REGISTRY.values()})
 
 
