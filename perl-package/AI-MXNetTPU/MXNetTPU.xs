@@ -190,3 +190,145 @@ void
 pred_free(void *h)
   CODE:
     MXPredFree(h);
+
+ # ------------------------------------------------------------------
+ # training slice (VERDICT r3 item 4): infer-shape, bind, forward/
+ # backward, imperative optimizer ops — enough to train a model to
+ # convergence driven entirely from perl.
+ # ------------------------------------------------------------------
+
+AV *
+nd_shape(void *h)
+  CODE:
+    mx_uint nd = 0;
+    const mx_uint *shp = NULL;
+    if (MXNDArrayGetShape(h, &nd, &shp) != 0)
+      croak_mx(aTHX_ "MXNDArrayGetShape");
+    AV *out = newAV();
+    for (mx_uint i = 0; i < nd; ++i) av_push(out, newSVuv(shp[i]));
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+AV *
+sym_infer_arg_shapes(void *h, const char *data_key, AV *data_shape)
+  CODE:
+    /* infer every argument shape from the data input's shape — the
+     * binding's SimpleBind front half (ref MXSymbolInferShape) */
+    mx_uint dims[8];
+    mx_uint nd = (mx_uint)(av_len(data_shape) + 1);
+    if (nd > 8) croak("shape rank > 8");
+    for (mx_uint i = 0; i < nd; ++i)
+      dims[i] = (mx_uint)SvUV(*av_fetch(data_shape, i, 0));
+    mx_uint indptr[2] = {0, nd};
+    const char *keys[1] = {data_key};
+    mx_uint in_n = 0, out_n = 0, aux_n = 0;
+    const mx_uint *in_nd = NULL, *out_nd = NULL, *aux_nd = NULL;
+    const mx_uint **in_d = NULL, **out_d = NULL, **aux_d = NULL;
+    int complete = 0;
+    if (MXSymbolInferShape(h, 1, keys, indptr, dims, &in_n, &in_nd, &in_d,
+                           &out_n, &out_nd, &out_d, &aux_n, &aux_nd,
+                           &aux_d, &complete) != 0)
+      croak_mx(aTHX_ "MXSymbolInferShape");
+    AV *out = newAV();
+    for (mx_uint i = 0; i < in_n; ++i) {
+      AV *s = newAV();
+      for (mx_uint d = 0; d < in_nd[i]; ++d)
+        av_push(s, newSVuv(in_d[i][d]));
+      av_push(out, newRV_noinc((SV *)s));
+    }
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+void *
+exec_bind(void *sym, AV *args, AV *grads, AV *reqs)
+  CODE:
+    /* ref MXExecutorBindEX; grads entries may be undef (kNullOp) */
+    mx_uint n = (mx_uint)(av_len(args) + 1);
+    NDArrayHandle *arg_h = (NDArrayHandle *)malloc(n * sizeof(void *));
+    NDArrayHandle *grad_h = (NDArrayHandle *)malloc(n * sizeof(void *));
+    mx_uint *req = (mx_uint *)malloc(n * sizeof(mx_uint));
+    for (mx_uint i = 0; i < n; ++i) {
+      arg_h[i] = INT2PTR(void *, SvIV(*av_fetch(args, i, 0)));
+      SV **g = av_fetch(grads, i, 0);
+      grad_h[i] = (g && SvOK(*g)) ? INT2PTR(void *, SvIV(*g)) : NULL;
+      req[i] = (mx_uint)SvUV(*av_fetch(reqs, i, 0));
+    }
+    ExecutorHandle out = NULL;
+    int rc = MXExecutorBindEX(sym, 1, 0, 0, NULL, NULL, NULL, n, arg_h,
+                              grad_h, req, 0, NULL, NULL, &out);
+    free(arg_h); free(grad_h); free(req);
+    if (rc != 0) croak_mx(aTHX_ "MXExecutorBindEX");
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+void
+exec_forward(void *h, int is_train)
+  CODE:
+    if (MXExecutorForward(h, is_train) != 0)
+      croak_mx(aTHX_ "MXExecutorForward");
+
+void
+exec_backward(void *h)
+  CODE:
+    if (MXExecutorBackwardEx(h, 0, NULL, 1) != 0)
+      croak_mx(aTHX_ "MXExecutorBackwardEx");
+
+AV *
+exec_outputs(void *h)
+  CODE:
+    mx_uint n = 0;
+    NDArrayHandle *arr = NULL;
+    if (MXExecutorOutputs(h, &n, &arr) != 0)
+      croak_mx(aTHX_ "MXExecutorOutputs");
+    AV *out = newAV();
+    for (mx_uint i = 0; i < n; ++i)
+      av_push(out, newSViv(PTR2IV(arr[i])));
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+void
+exec_free(void *h)
+  CODE:
+    MXExecutorFree(h);
+
+void
+op_invoke(const char *op_name, AV *ins, SV *out_sv, AV *pkeys, AV *pvals)
+  CODE:
+    /* imperative invoke with a preallocated output (the optimizer-op
+     * path: sgd_update(weight, grad) -> weight in place); out_sv undef
+     * means no output capture needed */
+    mx_uint nc = 0;
+    AtomicSymbolCreator *creators = NULL;
+    if (MXSymbolListAtomicSymbolCreators(&nc, &creators) != 0)
+      croak_mx(aTHX_ "MXSymbolListAtomicSymbolCreators");
+    AtomicSymbolCreator creator = NULL;
+    for (mx_uint i = 0; i < nc; ++i) {
+      const char *name = NULL;
+      if (MXSymbolGetAtomicSymbolName(creators[i], &name) != 0)
+        croak_mx(aTHX_ "MXSymbolGetAtomicSymbolName");
+      if (strcmp(name, op_name) == 0) { creator = creators[i]; break; }
+    }
+    if (!creator) croak("op not found: %s", op_name);
+    int n_in = (int)(av_len(ins) + 1);
+    NDArrayHandle in_h[16];
+    if (n_in > 16) croak("op_invoke: too many inputs");
+    for (int i = 0; i < n_in; ++i)
+      in_h[i] = INT2PTR(void *, SvIV(*av_fetch(ins, i, 0)));
+    int n_params = (int)(av_len(pkeys) + 1);
+    const char *keys[16]; const char *vals[16];
+    if (n_params > 16) croak("op_invoke: too many params");
+    for (int i = 0; i < n_params; ++i) {
+      keys[i] = SvPV_nolen(*av_fetch(pkeys, i, 0));
+      vals[i] = SvPV_nolen(*av_fetch(pvals, i, 0));
+    }
+    int n_out = SvOK(out_sv) ? 1 : 0;
+    NDArrayHandle out_h = SvOK(out_sv) ? INT2PTR(void *, SvIV(out_sv))
+                                       : NULL;
+    NDArrayHandle *outs = n_out ? &out_h : NULL;
+    if (MXImperativeInvoke(creator, n_in, in_h, &n_out, &outs, n_params,
+                           keys, vals) != 0)
+      croak_mx(aTHX_ "MXImperativeInvoke");
