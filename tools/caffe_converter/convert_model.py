@@ -1,0 +1,189 @@
+"""Caffe -> mxnet_tpu model converter (LeNet/CaffeNet layer families).
+
+The reference's tools/caffe_converter/{convert_symbol,convert_model}.py
+walk a protoc-compiled NetParameter and emit mx.symbol calls + param
+NDArrays; this build does the same over proto_lite/prototxt (no protoc,
+no caffe install).  Supported layer types — the classic model-zoo set:
+Input/Data, Convolution, Pooling (MAX/AVE, global), InnerProduct, ReLU,
+Dropout, LRN, Softmax/SoftmaxWithLoss, Flatten, Concat, Eltwise(SUM).
+
+Weight layouts match directly: caffe conv blobs are (out, in, kh, kw)
+and InnerProduct blobs (out, in) — the same layouts Convolution /
+FullyConnected consume here, so blobs copy over without transposition
+(ref convert_model.py does the identical passthrough).
+
+CLI (ref run.sh):  python convert_model.py net.prototxt net.caffemodel
+                   out_prefix   -> out_prefix-symbol.json + -0000.params
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tools.caffe_converter.proto_lite import parse_caffemodel
+from tools.caffe_converter.prototxt import parse_prototxt
+
+__all__ = ["convert", "convert_symbol"]
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _kernel_pair(param, base, default=0):
+    """caffe allows kernel_size or kernel_h/kernel_w (same for stride,
+    pad)."""
+    if base + "_size" in param:
+        k = int(param[base + "_size"])
+        return (k, k)
+    if base in param:  # stride / pad spelled bare
+        k = int(param[base])
+        return (k, k)
+    h = int(param.get(base + "_h", default))
+    w = int(param.get(base + "_w", default))
+    return (h, w)
+
+
+def convert_symbol(prototxt_text):
+    """-> (Symbol, input_name).  Mirrors the reference convert_symbol.py
+    layer walk."""
+    import mxnet_tpu as mx
+
+    net = parse_prototxt(prototxt_text)
+    layers = _as_list(net.get("layer"))
+    if not layers:
+        raise ValueError("prototxt has no V2 'layer' entries")
+
+    tops = {}
+    input_name = None
+
+    # standalone inputs: `input: "data"` or Input layers
+    for inp in _as_list(net.get("input")):
+        tops[inp] = mx.sym.Variable(inp)
+        input_name = input_name or inp
+
+    for layer in layers:
+        ltype = layer.get("type")
+        name = layer.get("name")
+        bottoms = _as_list(layer.get("bottom"))
+        ins = [tops[b] for b in bottoms if b in tops]
+        top = _as_list(layer.get("top"))
+        out = None
+        if ltype in ("Input", "Data"):
+            out = mx.sym.Variable(top[0] if top else name)
+            input_name = input_name or (top[0] if top else name)
+        elif ltype == "Convolution":
+            p = layer.get("convolution_param", {})
+            out = mx.sym.Convolution(
+                ins[0], name=name,
+                num_filter=int(p["num_output"]),
+                kernel=_kernel_pair(p, "kernel"),
+                stride=_kernel_pair(p, "stride", 1),
+                pad=_kernel_pair(p, "pad", 0),
+                num_group=int(p.get("group", 1)),
+                no_bias=not bool(p.get("bias_term", True)))
+        elif ltype == "Pooling":
+            p = layer.get("pooling_param", {})
+            pool = str(p.get("pool", "MAX")).upper()
+            ptype = {"MAX": "max", "AVE": "avg"}[pool]
+            if p.get("global_pooling"):
+                out = mx.sym.Pooling(ins[0], name=name, global_pool=True,
+                                     kernel=(1, 1), pool_type=ptype)
+            else:
+                out = mx.sym.Pooling(
+                    ins[0], name=name, pool_type=ptype,
+                    kernel=_kernel_pair(p, "kernel"),
+                    stride=_kernel_pair(p, "stride", 1),
+                    pad=_kernel_pair(p, "pad", 0),
+                    # caffe pooling rounds UP (ceil) — the reference
+                    # converter emits pooling_convention='full'
+                    pooling_convention="full")
+        elif ltype == "InnerProduct":
+            p = layer.get("inner_product_param", {})
+            out = mx.sym.FullyConnected(
+                mx.sym.Flatten(ins[0]), name=name,
+                num_hidden=int(p["num_output"]),
+                no_bias=not bool(p.get("bias_term", True)))
+        elif ltype == "ReLU":
+            out = mx.sym.Activation(ins[0], name=name, act_type="relu")
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            out = mx.sym.Dropout(ins[0], name=name,
+                                 p=float(p.get("dropout_ratio", 0.5)))
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = mx.sym.LRN(ins[0], name=name,
+                             nsize=int(p.get("local_size", 5)),
+                             alpha=float(p.get("alpha", 1e-4)),
+                             beta=float(p.get("beta", 0.75)))
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            out = mx.sym.SoftmaxOutput(ins[0], name=name)
+        elif ltype == "Flatten":
+            out = mx.sym.Flatten(ins[0], name=name)
+        elif ltype == "Concat":
+            out = mx.sym.Concat(*ins, name=name)
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", {})
+            op = str(p.get("operation", "SUM")).upper()
+            if op != "SUM":
+                raise ValueError("Eltwise %s not supported" % op)
+            out = ins[0]
+            for extra in ins[1:]:
+                out = out + extra
+        elif ltype in ("Accuracy",):
+            continue  # eval-only layers drop out of the deploy graph
+        else:
+            raise ValueError("unsupported caffe layer type %r (%s)"
+                             % (ltype, name))
+        for t in (top or [name]):
+            tops[t] = out
+        tops[name] = out
+
+    last = layers[-1]
+    last_top = _as_list(last.get("top"))
+    sym = tops[(last_top or [last["name"]])[0]]
+    return sym, input_name or "data"
+
+
+def convert(prototxt_path, caffemodel_path):
+    """-> (Symbol, arg_params, aux_params) — the reference
+    convert_model.py contract."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    with open(prototxt_path) as f:
+        sym, input_name = convert_symbol(f.read())
+    with open(caffemodel_path, "rb") as f:
+        model = parse_caffemodel(f.read())
+
+    arg_params = {}
+    for layer in model["layers"]:
+        blobs = layer["blobs"]
+        if not blobs:
+            continue
+        name = layer["name"]
+        w = np.asarray(blobs[0]["data"], np.float32).reshape(
+            blobs[0]["shape"])
+        arg_params[name + "_weight"] = nd.array(w)
+        if len(blobs) > 1:
+            b = np.asarray(blobs[1]["data"], np.float32).reshape(-1)
+            arg_params[name + "_bias"] = nd.array(b)
+    return sym, arg_params, {}
+
+
+def main():
+    import mxnet_tpu as mx
+
+    prototxt, caffemodel, prefix = sys.argv[1:4]
+    sym, arg_params, aux_params = convert(prototxt, caffemodel)
+    mx.model.save_checkpoint(prefix, 0, sym, arg_params, aux_params)
+    print("saved %s-symbol.json / %s-0000.params" % (prefix, prefix))
+
+
+if __name__ == "__main__":
+    main()

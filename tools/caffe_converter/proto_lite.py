@@ -1,0 +1,160 @@
+"""Minimal protobuf wire-format reader/writer for Caffe model blobs.
+
+The reference converter (tools/caffe_converter/caffe_parser.py) compiles
+the full caffe.proto with protoc and loads .caffemodel files through
+generated classes.  This build needs only the weight-carrying subset —
+NetParameter / LayerParameter / BlobProto / BlobShape — so a ~100-line
+wire reader replaces the 1,500-line schema: no protoc step, no
+third-party schema file, same bytes understood.
+
+Field numbers (from the public caffe.proto schema, V2 'layer' format):
+  NetParameter:   name=1 (string), layer=100 (repeated LayerParameter)
+  LayerParameter: name=1, type=2 (strings), blobs=7 (repeated BlobProto)
+  BlobProto:      data=5 (repeated float, usually packed),
+                  shape=7 (BlobShape), legacy dims num=1 channels=2
+                  height=3 width=4
+  BlobShape:      dim=1 (repeated int64, usually packed)
+
+The writer emits the same subset — used by tests to fabricate golden
+.caffemodel fixtures offline (no caffe install exists here).
+"""
+import struct
+
+__all__ = ["parse_caffemodel", "build_caffemodel"]
+
+
+# ---------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _write_varint(value):
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _scan(buf):
+    """Yield (field_number, wire_type, value) over one message body.
+    wt 0 -> int, wt 2 -> bytes, wt 5 -> 4 raw bytes, wt 1 -> 8 raw."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wt == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+        yield field, wt, val
+
+
+def _field(tag, wt):
+    return _write_varint((tag << 3) | wt)
+
+
+def _len_delim(tag, payload):
+    return _field(tag, 2) + _write_varint(len(payload)) + payload
+
+
+# ---------------------------------------------------------------------
+# reading .caffemodel
+# ---------------------------------------------------------------------
+def _parse_blob(buf):
+    data = []
+    dims = []
+    legacy = {}
+    for field, wt, val in _scan(buf):
+        if field == 5:  # data: packed (wt2) or repeated fixed32 (wt5)
+            if wt == 2:
+                data.extend(struct.unpack("<%df" % (len(val) // 4), val))
+            else:
+                data.append(struct.unpack("<f", val)[0])
+        elif field == 7 and wt == 2:  # shape: BlobShape{dim=1}
+            for f2, wt2, v2 in _scan(val):
+                if f2 == 1:
+                    if wt2 == 2:  # packed varints
+                        pos = 0
+                        while pos < len(v2):
+                            d, pos = _read_varint(v2, pos)
+                            dims.append(d)
+                    else:
+                        dims.append(v2)
+        elif field in (1, 2, 3, 4) and wt == 0:  # legacy NCHW dims
+            legacy[field] = val
+    if not dims and legacy:
+        dims = [legacy.get(i, 1) for i in (1, 2, 3, 4)]
+    return {"shape": tuple(int(d) for d in dims), "data": data}
+
+
+def _parse_layer(buf):
+    layer = {"name": "", "type": "", "blobs": []}
+    for field, wt, val in _scan(buf):
+        if field == 1 and wt == 2:
+            layer["name"] = val.decode("utf-8")
+        elif field == 2 and wt == 2:
+            layer["type"] = val.decode("utf-8")
+        elif field == 7 and wt == 2:
+            layer["blobs"].append(_parse_blob(val))
+    return layer
+
+
+def parse_caffemodel(data: bytes):
+    """-> {"name": str, "layers": [{"name","type","blobs"}...]} (V2)."""
+    net = {"name": "", "layers": []}
+    for field, wt, val in _scan(data):
+        if field == 1 and wt == 2:
+            net["name"] = val.decode("utf-8")
+        elif field == 100 and wt == 2:
+            net["layers"].append(_parse_layer(val))
+    return net
+
+
+# ---------------------------------------------------------------------
+# writing .caffemodel (test fixtures)
+# ---------------------------------------------------------------------
+def _build_blob(shape, values):
+    body = b""
+    dims = b"".join(_write_varint(int(d)) for d in shape)
+    body += _len_delim(7, _len_delim(1, dims))
+    payload = struct.pack("<%df" % len(values), *[float(v) for v in values])
+    body += _len_delim(5, payload)
+    return body
+
+
+def build_caffemodel(name, layers):
+    """layers: [(layer_name, layer_type, [(shape, flat_values), ...])]."""
+    out = _len_delim(1, name.encode("utf-8"))
+    for lname, ltype, blobs in layers:
+        body = _len_delim(1, lname.encode("utf-8"))
+        body += _len_delim(2, ltype.encode("utf-8"))
+        for shape, values in blobs:
+            body += _len_delim(7, _build_blob(shape, values))
+        out += _len_delim(100, body)
+    return out

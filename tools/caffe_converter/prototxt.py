@@ -1,0 +1,78 @@
+"""Prototxt text-format parser (the subset Caffe net definitions use).
+
+The format is protobuf text: `key: value` scalars and `key { ... }`
+nested blocks, repeated keys accumulating.  ~60 lines replace the
+text_format.Merge + generated-schema path of the reference's
+caffe_parser.py for the conversion use case.
+"""
+import re
+
+__all__ = ["parse_prototxt"]
+
+_TOKEN = re.compile(r"""
+    (?P<comment>\#[^\n]*)
+  | (?P<brace>[{}])
+  | (?P<key>[A-Za-z_][A-Za-z0-9_]*)\s*:?\s*
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
+  | (?P<space>\s+)
+""", re.X)
+
+
+def _tokens(text):
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise ValueError("prototxt parse error at %r" % text[pos:pos + 40])
+        pos = m.end()
+        kind = m.lastgroup
+        if kind in ("comment", "space"):
+            continue
+        yield kind, m.group().strip().rstrip(":").strip()
+
+
+def _coerce(tok_kind, raw):
+    if tok_kind == "string":
+        return raw[1:-1]
+    if tok_kind == "number":
+        f = float(raw)
+        return int(f) if f == int(f) and "." not in raw and "e" not in \
+            raw.lower() else f
+    # bare identifier: bool or enum name
+    if raw in ("true", "True"):
+        return True
+    if raw in ("false", "False"):
+        return False
+    return raw
+
+
+def parse_prototxt(text):
+    """-> nested dict; repeated keys become lists."""
+    stream = _tokens(text)
+
+    def parse_block():
+        block = {}
+
+        def put(key, value):
+            if key in block:
+                if not isinstance(block[key], list):
+                    block[key] = [block[key]]
+                block[key].append(value)
+            else:
+                block[key] = value
+
+        for kind, tok in stream:
+            if kind == "brace" and tok == "}":
+                return block
+            if kind != "key":
+                raise ValueError("expected key, got %r" % tok)
+            key = tok
+            kind2, tok2 = next(stream)
+            if kind2 == "brace" and tok2 == "{":
+                put(key, parse_block())
+            else:
+                put(key, _coerce(kind2, tok2))
+        return block
+
+    return parse_block()
