@@ -600,7 +600,7 @@ def _sym_resnet50(num_classes=1000):
     return mx.sym.SoftmaxOutput(x, name="softmax")
 
 
-def bench_fit_loop(batch=32, bulk_k=8, n_batches=8):
+def bench_fit_loop(batch=32, bulk_k=8, n_batches=8, img=None):
     """Module.fit throughput on synthetic data — the number a user's
     training script sees, not the raw fused step.  engine.set_bulk_size
     makes fit run K steps per dispatch (module/bulk.py), the reference's
@@ -610,7 +610,8 @@ def bench_fit_loop(batch=32, bulk_k=8, n_batches=8):
     import mxnet_tpu as mx
     from mxnet_tpu import engine, io as mio
 
-    img = int(os.environ.get("BENCH_FIT_IMG", "224"))
+    if img is None:
+        img = int(os.environ.get("BENCH_FIT_IMG", "224"))
     sym = _sym_resnet50(1000)
     X = np.random.rand(batch * n_batches, 3, img, img).astype(np.float32)
     y = np.random.randint(0, 1000, batch * n_batches).astype(np.float32)
@@ -636,6 +637,30 @@ def bench_fit_loop(batch=32, bulk_k=8, n_batches=8):
     marks = [t0] + clock.marks
     best = min(b - a for a, b in zip(marks[1:], marks[2:]))
     return batch * n_batches / best
+
+
+def bench_fit_with_comparator(img, batch=32, bulk_k=8):
+    """Congested-tunnel fallback body: the fit loop AND its fused-step
+    twin at the SAME (smaller) image size, so fit_vs_fused stays a fair
+    same-shape ratio when the 224 compile won't fit the window."""
+    fit_ips = bench_fit_loop(batch=batch, bulk_k=bulk_k, img=img)
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.dp import FusedTrainStep
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    import jax
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    mesh = make_mesh((1,), ("dp",), jax.devices()[:1])
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, learning_rate=0.05, momentum=0.9)
+    X = nd.random.uniform(shape=(batch, 3, img, img))
+    y = nd.array(np.random.randint(0, 1000, batch).astype("float32"))
+    sps = _time_step(step, X, y, bulk_k, windows=2)
+    return fit_ips, batch / sps
 
 
 def bench_memory_remat(per_probe_timeout=300):
@@ -900,34 +925,75 @@ def main():
         # compile must never hang the whole bench past the driver's
         # window (observed: uploads of the K-step symbolic program can
         # block indefinitely on a congested tunnel)
-        fit_timeout = min(900, max(30, BENCH_BUDGET_S * 0.9 - elapsed()))
-        proc = _tracked_run(
-            [sys.executable, "-c",
-             "import bench; print('FIT_IPS', bench.bench_fit_loop())"],
-            text=True, timeout=fit_timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+        fit_timeout = min(600, max(30, BENCH_BUDGET_S * 0.35))
         fit_ips = None
-        for ln in proc.stdout.splitlines():
-            if ln.startswith("FIT_IPS "):
-                fit_ips = float(ln.split()[1])
-        if fit_ips is None:
-            raise RuntimeError("fit subprocess rc=%d: %s"
-                               % (proc.returncode,
-                                  (proc.stdout + proc.stderr)[-400:]))
-        headline = _STATE["headline"]
-        _STATE["fit_loop"] = {
-            "pipeline": "Module.fit (bulk_size=8)",
-            "model": "resnet50_v1(sym)", "batch": 32, "dtype": "float32",
-            "images_per_sec": round(fit_ips, 2),
-            "fit_vs_fused_step": round(fit_ips / headline, 3)
-            if headline else None}
+        timed_out = False
+        try:
+            proc = _tracked_run(
+                [sys.executable, "-c",
+                 "import bench; print('FIT_IPS', bench.bench_fit_loop())"],
+                text=True, timeout=fit_timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            for ln in proc.stdout.splitlines():
+                if ln.startswith("FIT_IPS "):
+                    fit_ips = float(ln.split()[1])
+            if fit_ips is None:
+                # a CRASH is not congestion: surface the first run's
+                # diagnostics instead of burning the retry budget
+                raise RuntimeError(
+                    "fit subprocess rc=%d: %s"
+                    % (proc.returncode,
+                       (proc.stdout + proc.stderr)[-400:]))
+        except subprocess.TimeoutExpired:
+            timed_out = True
+        if fit_ips is not None:
+            headline = _STATE["headline"]
+            _STATE["fit_loop"] = {
+                "pipeline": "Module.fit (bulk_size=8)",
+                "model": "resnet50_v1(sym)", "batch": 32,
+                "dtype": "float32", "img": 224,
+                "images_per_sec": round(fit_ips, 2),
+                "fit_vs_fused_step": round(fit_ips / headline, 3)
+                if headline else None}
+        else:
+            # congested-tunnel fallback: the 224 compile won't fit the
+            # window — measure fit AND its fused twin at 112 in one
+            # subprocess so fit_vs_fused stays a same-shape ratio
+            retry_timeout = min(600, max(
+                60, BENCH_BUDGET_S * 0.75 - elapsed()))
+            proc = _tracked_run(
+                [sys.executable, "-c",
+                 "import bench; f, c = bench.bench_fit_with_comparator("
+                 "112); print('FIT2_IPS', f, c)"],
+                text=True, timeout=retry_timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            pair = None
+            for ln in proc.stdout.splitlines():
+                if ln.startswith("FIT2_IPS "):
+                    pair = [float(v) for v in ln.split()[1:3]]
+            if pair is None:
+                raise RuntimeError(
+                    "fit retry subprocess rc=%d (after 224 compile "
+                    "exceeded %ds): %s"
+                    % (proc.returncode, fit_timeout,
+                       (proc.stdout + proc.stderr)[-400:]))
+            assert timed_out  # only the congestion path reaches here
+            _STATE["fit_loop"] = {
+                "pipeline": "Module.fit (bulk_size=8)",
+                "model": "resnet50_v1(sym)", "batch": 32,
+                "dtype": "float32", "img": 112,
+                "note": "224 compile exceeded %ds (congested tunnel); "
+                        "fit and fused twin measured at 112 for a "
+                        "same-shape ratio" % fit_timeout,
+                "images_per_sec": round(pair[0], 2),
+                "fit_vs_fused_step": round(pair[0] / pair[1], 3)}
     except Exception as exc:
         _STATE["fit_loop"] = {"pipeline": "Module.fit", "error": repr(exc)}
     _progress({"fit_loop": _STATE["fit_loop"]})
 
     # ---- phase 4: bare-JAX ceiling twins + numeric vs_ceiling -------
     for name, batch, dtype, bulk_k in BARE_CONFIGS:
-        if elapsed() > BENCH_BUDGET_S * 0.7:
+        if elapsed() > BENCH_BUDGET_S * 0.75:
             _STATE["bare_jax"].append(
                 {"skipped": "%s/%s bs%d — budget" % (name, dtype, batch)})
             continue
@@ -951,7 +1017,7 @@ def main():
 
     # ---- phase 5: remaining table rows (bf16 first) -----------------
     for spec in REST_CONFIGS:
-        if elapsed() > BENCH_BUDGET_S * 0.8:
+        if elapsed() > BENCH_BUDGET_S * 0.85:
             _STATE["table"].append(
                 {"skipped": "%s/%s bs%d — model time budget spent "
                  "(BENCH_BUDGET_S=%d)" % (spec[0], spec[3], spec[1],
@@ -968,11 +1034,11 @@ def main():
 
     # ---- phase 6: remat memory row ----------------------------------
     try:
-        if elapsed() > BENCH_BUDGET_S * 0.85:
+        if elapsed() > BENCH_BUDGET_S * 0.9:
             raise RuntimeError("time budget spent before memory row")
         _STATE["memory"] = bench_memory_remat(
             per_probe_timeout=min(300, max(
-                30, (BENCH_BUDGET_S - elapsed()) / 2)))
+                120, (BENCH_BUDGET_S - elapsed()) / 2)))
     except Exception as exc:
         _STATE["memory"] = {"pipeline": "memory/remat", "error": repr(exc)}
     _progress({"memory": _STATE["memory"]})

@@ -1,0 +1,98 @@
+"""Training-memory benchmark (ref: benchmark/python/sparse/
+memory_benchmark.py measures allocator behavior; this build's analogue
+reports the number that matters on TPU — compiled peak HBM per training
+step — across batch sizes, with and without the mirror/remat knob
+(MXNET_BACKWARD_DO_MIRROR, remat.py).
+
+Prints one row per (batch, mirror): peak bytes from XLA's memory
+analysis of the compiled fused step, images/sec, and the batch-doubling
+headroom the mirror buys (the reference documents the same trade for
+Inception-v3: batch 64 -> 128 in fixed memory at ~10% slowdown,
+example/image-classification/README.md:370-373).
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.abspath(os.path.join(HERE, "..", ".."))
+
+
+def probe(batch, mirror, model="resnet50_v1", bulk_k=4, img=224):
+    """One (batch, mirror) config in a fresh process (the env knob is
+    read at trace time; a clean process keeps the measurement pure)."""
+    code = """
+import json, os
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.parallel.dp import FusedTrainStep
+from mxnet_tpu.parallel.mesh import make_mesh
+import jax, time
+
+batch, model, bulk_k, img = %d, %r, %d, %d
+net = vision.get_model(model, classes=1000)
+net.initialize(mx.init.Xavier())
+mesh = make_mesh((1,), ("dp",), jax.devices()[:1])
+step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                      mesh=mesh, learning_rate=0.05, momentum=0.9,
+                      dtype="bfloat16")
+X = nd.random.uniform(shape=(batch, 3, img, img))
+y = nd.array(np.random.randint(0, 1000, batch).astype("float32"))
+losses = step.run_steps(X, y, steps=bulk_k)
+float(np.asarray(losses.asnumpy()).reshape(-1)[0])
+t0 = time.time()
+losses = step.run_steps(X, y, steps=bulk_k)
+float(np.asarray(losses.asnumpy()).reshape(-1)[0])
+dt = (time.time() - t0) / bulk_k
+rec = {"batch": batch, "images_per_sec": round(batch / dt, 2)}
+try:
+    raw = jax.device_put(X._data.astype("bfloat16"), step._data_sh)
+    lab = jax.device_put(y._data, step._data_sh)
+    comp = step._multi_step_same[bulk_k].lower(
+        step._param_vals, step._moms, raw, lab, step._key_root,
+        step._key_ctr).compile()
+    ma = comp.memory_analysis()
+    if ma is not None:
+        rec["peak_bytes"] = int(getattr(ma, "temp_size_in_bytes", 0) +
+                                getattr(ma, "output_size_in_bytes", 0))
+except Exception as exc:
+    rec["peak_bytes_error"] = repr(exc)
+print("MEMROW " + json.dumps(rec))
+""" % (batch, model, bulk_k, img)
+    env = dict(os.environ)
+    env["MXNET_BACKWARD_DO_MIRROR"] = "1" if mirror else "0"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=900)
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("MEMROW "):
+            return json.loads(ln[7:])
+    return {"batch": batch, "error": (proc.stdout + proc.stderr)[-400:]}
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="resnet50_v1")
+    p.add_argument("--batches", default="32,64")
+    p.add_argument("--bulk-k", type=int, default=4)
+    p.add_argument("--img", type=int, default=224)
+    a = p.parse_args()
+    rows = []
+    for batch in [int(b) for b in a.batches.split(",")]:
+        for mirror in (False, True):
+            rec = probe(batch, mirror, model=a.model, bulk_k=a.bulk_k,
+                        img=a.img)
+            rec["mirror"] = mirror
+            rows.append(rec)
+            print("batch=%-4d mirror=%d peak=%s img/s=%s"
+                  % (batch, mirror, rec.get("peak_bytes", "?"),
+                     rec.get("images_per_sec", "?")))
+    print(json.dumps({"memory_benchmark": rows}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Storage-cast benchmark (ref: benchmark/python/sparse/cast_storage.py).
+
+dense->csr and dense->row_sparse cast cost across densities on
+synthetic matrices (the reference sweeps the same axes on GPU/CPU).
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def measure_cost(repeat, f, *args, **kwargs):
+    out = f(*args, **kwargs)
+    _ = out.asnumpy()
+    start = time.time()
+    for _i in range(repeat):
+        out = f(*args, **kwargs)
+    _ = out.asnumpy()
+    return (time.time() - start) / repeat
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rows", type=int, default=1024)
+    p.add_argument("--cols", type=int, default=1024)
+    p.add_argument("--densities", default="0.01,0.05,0.2")
+    p.add_argument("--repeat", type=int, default=5)
+    a = p.parse_args()
+    rng = np.random.RandomState(0)
+    print("%8s %12s %14s" % ("density", "to_csr_ms", "to_rowsparse_ms"))
+    for d in [float(x) for x in a.densities.split(",")]:
+        mask = rng.rand(a.rows, a.cols) < d
+        dense = nd.array((rng.randn(a.rows, a.cols) * mask)
+                         .astype(np.float32))
+        t_csr = measure_cost(a.repeat, dense.tostype, "csr")
+        t_rsp = measure_cost(a.repeat, dense.tostype, "row_sparse")
+        print("%8.3f %12.3f %14.3f" % (d, t_csr * 1e3, t_rsp * 1e3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Sparse dot benchmark (ref: benchmark/python/sparse/dot.py).
+
+The reference benches csr x dense against the LibSVM datasets (kdda,
+avazu — network downloads); this environment is offline, so synthetic
+CSR matrices sweep the same density/shape axes.  Methodology kept:
+warmup + repeated timed windows around a device-drained op call,
+cost reported per call with the dense-equivalent ratio.
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def measure_cost(repeat, f, *args, **kwargs):
+    """ref dot.py measure_cost: one warmup, then wall-time over
+    `repeat` calls, draining the device each call."""
+    out = f(*args, **kwargs)
+    _ = out.asnumpy()
+    start = time.time()
+    for _i in range(repeat):
+        out = f(*args, **kwargs)
+    _ = out.asnumpy()
+    return (time.time() - start) / repeat
+
+
+def bench_dot(m, k, n, density, repeat):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    rng = np.random.RandomState(0)
+    mask = rng.rand(m, k) < density
+    dense_lhs = (rng.randn(m, k) * mask).astype(np.float32)
+    rhs = rng.randn(k, n).astype(np.float32)
+
+    lhs_csr = nd.array(dense_lhs).tostype("csr")
+    lhs_dense = nd.array(dense_lhs)
+    rhs_nd = nd.array(rhs)
+
+    t_sparse = measure_cost(repeat, nd.sparse.dot, lhs_csr, rhs_nd)
+    t_dense = measure_cost(repeat, nd.dot, lhs_dense, rhs_nd)
+    return t_sparse, t_dense
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--m", type=int, default=512)
+    p.add_argument("--k", type=int, default=2048)
+    p.add_argument("--n", type=int, default=64)
+    p.add_argument("--densities", default="0.01,0.05,0.2")
+    p.add_argument("--repeat", type=int, default=5)
+    a = p.parse_args()
+    print("%8s %10s %12s %12s %8s" % ("density", "shape", "csr_dot_ms",
+                                      "dense_ms", "ratio"))
+    for d in [float(x) for x in a.densities.split(",")]:
+        ts, td = bench_dot(a.m, a.k, a.n, d, a.repeat)
+        print("%8.3f %10s %12.3f %12.3f %8.2f"
+              % (d, "%dx%dx%d" % (a.m, a.k, a.n), ts * 1e3, td * 1e3,
+                 td / ts if ts else float("inf")))
+
+
+if __name__ == "__main__":
+    main()
