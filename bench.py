@@ -925,7 +925,10 @@ def main():
         # compile must never hang the whole bench past the driver's
         # window (observed: uploads of the K-step symbolic program can
         # block indefinitely on a congested tunnel)
-        fit_timeout = min(600, max(30, BENCH_BUDGET_S * 0.35))
+        # tight cap: on a congested day the fit compile must not starve
+        # the bare-ceiling twins downstream (observed: 600s + 523s fit
+        # attempts left zero budget for phase 4)
+        fit_timeout = min(420, max(30, BENCH_BUDGET_S * 0.2))
         fit_ips = None
         timed_out = False
         try:
@@ -959,8 +962,13 @@ def main():
             # congested-tunnel fallback: the 224 compile won't fit the
             # window — measure fit AND its fused twin at 112 in one
             # subprocess so fit_vs_fused stays a same-shape ratio
-            retry_timeout = min(600, max(
-                60, BENCH_BUDGET_S * 0.75 - elapsed()))
+            if elapsed() > BENCH_BUDGET_S * 0.55:
+                raise RuntimeError(
+                    "fit 224 compile exceeded %ds and no budget left "
+                    "for the 112 retry (elapsed %.0fs)"
+                    % (fit_timeout, elapsed()))
+            retry_timeout = min(300, max(
+                60, BENCH_BUDGET_S * 0.65 - elapsed()))
             proc = _tracked_run(
                 [sys.executable, "-c",
                  "import bench; f, c = bench.bench_fit_with_comparator("
