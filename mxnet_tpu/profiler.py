@@ -68,6 +68,7 @@ def set_state(state="stop"):
     MXSetProfilerState)."""
     global _state, _t0
     assert state in ("run", "stop")
+    stopped_run = False
     with _lock:
         if state == "run" and _state != "run":
             _events.clear()
@@ -83,7 +84,15 @@ def set_state(state="stop"):
                 import jax
 
                 jax.profiler.stop_trace()
+            stopped_run = True
         _state = state
+    if stopped_run:
+        # the 1.x profiler persisted the trace on stop/shutdown — old
+        # example code (example/profiler/profiler_matmul.py) never
+        # calls dump and expects the file to exist afterwards.  Only
+        # the run->stop TRANSITION dumps: a redundant stop must not
+        # clobber a previously dumped trace with an empty one
+        dump(finished=False)
 
 
 profiler_set_state = set_state

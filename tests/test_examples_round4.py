@@ -230,3 +230,32 @@ def test_reference_multi_task_byte_identical(tmp_path):
     assert accs and all(np.isfinite(a) for a in accs), out[-2000:]
     # both heads see the same labels here, so accuracy must climb
     assert max(accs) > 0.5, accs
+
+
+@pytest.mark.slow
+def test_reference_profiler_matmul_byte_identical(tmp_path):
+    """example/profiler/profiler_matmul.py runs unmodified: the legacy
+    profiler surface (profiler_set_config(mode=...), profiler_set_state
+    run/stop) around a bound executor, dumping a chrome-trace JSON.
+    Launcher restores py<3.8 time.clock (removed upstream)."""
+    import json
+
+    script = os.path.join(REFERENCE, "example", "profiler",
+                          "profiler_matmul.py")
+    prof = str(tmp_path / "profile_matmul.json")
+    code = ("import time\n"
+            "if not hasattr(time, 'clock'): time.clock = time.process_time\n"
+            "import sys, runpy\n"
+            "sys.argv = ['profiler_matmul.py', '--profile_filename', %r,\n"
+            "  '--iter_num', '8', '--begin_profiling_iter', '2',\n"
+            "  '--end_profiling_iter', '6']\n"
+            "runpy.run_path(%r, run_name='__main__')\n" % (prof, script))
+    proc = subprocess.run([sys.executable, "-c", code],
+                          cwd=str(tmp_path), env=_env(),
+                          capture_output=True, text=True, timeout=1500)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    data = json.load(open(prof))
+    events = data.get("traceEvents", data)
+    names = {e.get("name") for e in events if isinstance(e, dict)}
+    assert any(n and "dot" in n for n in names), sorted(names)[:20]
