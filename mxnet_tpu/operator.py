@@ -27,7 +27,8 @@ import numpy as _np
 
 from .base import MXNetError
 
-__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "PythonOp", "NumpyOp", "NDArrayOp"]
 
 
 class CustomOp(object):
@@ -181,7 +182,9 @@ def _custom_fn(*arrays, op_type: str, _training: bool = False, **kwargs):
         in_data = [_nd_array(_np.asarray(a)) for a in np_in]
         out_data = [_nd_array(_np.zeros(tuple(s), dtype=_np.dtype(t)))
                     for s, t in zip(oshapes, otypes)]
-        op.forward(is_train=is_train, req=["write"] * len(in_data),
+        # req is per-OUTPUT (ref CustomOp.forward contract) — sizing it
+        # by inputs truncated multi-output ops' copy-back
+        op.forward(is_train=is_train, req=["write"] * len(out_data),
                    in_data=in_data, out_data=out_data, aux=[])
         return tuple(_np.asarray(o.asnumpy(), dtype=_np.dtype(t))
                      for o, t in zip(out_data, otypes))
@@ -307,3 +310,111 @@ def register_c_creator(op_type: str, trampoline) -> None:
             return _CBackedOp()
 
     _REGISTRY[op_type] = _CBackedProp
+
+
+# ---------------------------------------------------------------------------
+# Legacy python-op surface (ref: operator.py:37 PythonOp, :144 NumpyOp,
+# :244 NDArrayOp — the pre-CustomOp API old example code subclasses,
+# e.g. example/numpy-ops/numpy_softmax.py).  Each instance adapts itself
+# into the CustomOp machinery: get_symbol registers a one-off prop
+# backed by the instance and returns the composed Custom symbol.
+# ---------------------------------------------------------------------------
+class PythonOp(object):
+    """Base class for operators implemented in Python (legacy API).
+
+    Overridables mirror the reference: ``forward``/``backward`` with
+    positional array lists, ``infer_shape(in_shape) -> (in_shapes,
+    out_shapes)``, ``list_arguments``, ``list_outputs``.
+    """
+
+    _ref_holder: List[Any] = []
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+def _legacy_symbol(op_instance, to_host, from_host, *args, **kwargs):
+    """Register a CustomOpProp adapter around a legacy op instance and
+    compose the Custom symbol (shared by NumpyOp/NDArrayOp)."""
+
+    class _LegacyAdapter(CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            ins = [to_host(a) for a in in_data]
+            outs = [to_host(a) for a in out_data]
+            op_instance.forward(in_data=ins, out_data=outs)
+            for dst, src, r in zip(out_data, outs, req):
+                self.assign(dst, r, from_host(src))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            ograds = [to_host(a) for a in out_grad]
+            ins = [to_host(a) for a in in_data]
+            outs = [to_host(a) for a in out_data]
+            igrads = [to_host(a) for a in in_grad]
+            op_instance.backward(out_grad=ograds, in_data=ins,
+                                 out_data=outs, in_grad=igrads)
+            for dst, src, r in zip(in_grad, igrads, req):
+                self.assign(dst, r, from_host(src))
+
+    class _LegacyProp(CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=op_instance.need_top_grad())
+
+        def list_arguments(self):
+            return list(op_instance.list_arguments())
+
+        def list_outputs(self):
+            return list(op_instance.list_outputs())
+
+        def infer_shape(self, in_shape):
+            ins, outs = op_instance.infer_shape(in_shape)
+            return ins, outs, []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _LegacyAdapter()
+
+    reg_name = "_legacy_pyop_%d" % id(op_instance)
+    _REGISTRY[reg_name] = _LegacyProp
+    PythonOp._ref_holder.append(op_instance)
+    from .symbol import Custom as _Custom
+
+    return _Custom(*args, op_type=reg_name, **kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Legacy numpy operator (ref operator.py:144): forward/backward
+    receive WRITABLE numpy arrays mutated in place."""
+
+    def get_symbol(self, *args, **kwargs):
+        from .ndarray import array as _nd_array
+
+        return _legacy_symbol(self, lambda a: a.asnumpy(), _nd_array,
+                              *args, **kwargs)
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray operator (ref operator.py:244): forward/backward
+    receive NDArrays."""
+
+    def get_symbol(self, *args, **kwargs):
+        return _legacy_symbol(self, lambda a: a, lambda a: a,
+                              *args, **kwargs)

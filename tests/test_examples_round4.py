@@ -259,3 +259,59 @@ def test_reference_profiler_matmul_byte_identical(tmp_path):
     events = data.get("traceEvents", data)
     names = {e.get("name") for e in events if isinstance(e, dict)}
     assert any(n and "dot" in n for n in names), sorted(names)[:20]
+
+
+@pytest.mark.slow
+def test_reference_numpy_softmax_byte_identical(tmp_path):
+    """example/numpy-ops/numpy_softmax.py runs unmodified: the LEGACY
+    NumpyOp API (pre-CustomOp; in-place numpy forward/backward) inside
+    Module.fit."""
+    _seed_mnist_idx(str(tmp_path / "data"))
+    script = os.path.join(REFERENCE, "example", "numpy-ops",
+                          "numpy_softmax.py")
+    code = (_NPCOMPAT +
+            "import sys, runpy\n"
+            "sys.argv = ['numpy_softmax.py']\n"
+            "runpy.run_path(%r, run_name='__main__')\n" % script)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          cwd=str(tmp_path), env=_env(),
+                          capture_output=True, text=True, timeout=1800)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    accs = [float(a) for a in
+            re.findall(r"Validation-accuracy=([\d.]+)", out)]
+    assert len(accs) == 10, out[-2000:]
+    assert accs[-1] > 0.9, accs
+
+
+def test_reference_weighted_logistic_regression_byte_identical(tmp_path):
+    """example/numpy-ops/weighted_logistic_regression.py runs
+    unmodified: parameterized CustomOpProp (constructor kwargs through
+    mx.sym.Custom) + simple_bind/backward/grad_dict; the weighted
+    gradient must scale positives vs negatives exactly as coded."""
+    script = os.path.join(REFERENCE, "example", "numpy-ops",
+                          "weighted_logistic_regression.py")
+    proc = subprocess.run([sys.executable, script], cwd=str(tmp_path),
+                          env=_env(), capture_output=True, text=True,
+                          timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    heads = ["Weighted Logistic Regression output:",
+             "\nLogistic Regression output:",
+             "Weighted Logistic Regression gradients:",
+             "\nLogistic Regression gradients:"]
+    pos = [out.index(h) for h in heads]
+    assert pos == sorted(pos), out[-2000:]
+    blocks = [out[p + len(h):(pos + [len(out)])[i + 1]]
+              for i, (p, h) in enumerate(zip(pos, heads))]
+
+    def parse(b):
+        return np.array([float(v) for v in
+                         re.findall(r"-?\d+\.\d+(?:e-?\d+)?", b)])
+
+    w_out, out_, w_grad, grad = [parse(b) for b in blocks]
+    # same sigmoid forward; weighted grads differ from unweighted by
+    # the pos/neg scales (pos=1, neg=0.1, normalized by n=5 columns)
+    np.testing.assert_allclose(w_out, out_, rtol=1e-5)
+    assert np.all(np.isfinite(w_grad)) and np.all(np.isfinite(grad))
+    assert not np.allclose(w_grad, grad)
