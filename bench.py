@@ -704,9 +704,12 @@ def bench_memory_remat(per_probe_timeout=300):
     return out
 
 
-def _memory_probe(batch=64, bulk_k=8):
-    """Child-process body for bench_memory_remat: one resnet50 train
-    config; reports peak device memory + throughput under the current
+def _memory_probe(batch=16, bulk_k=2, img=128):
+    """Child-process body for bench_memory_remat: one resnet18 train
+    config (sized so the compile fits a congested-tunnel probe window;
+    the standalone benchmark/python/memory_benchmark.py measured the
+    same config's mirror trade on-chip at 79.7 -> 70.2 MB); reports
+    peak device memory + throughput under the current
     MXNET_BACKWARD_DO_MIRROR setting."""
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd
@@ -716,16 +719,17 @@ def _memory_probe(batch=64, bulk_k=8):
 
     import jax
 
-    net = vision.resnet50_v1(classes=1000)
+    net = vision.resnet18_v1(classes=1000)
     net.initialize(mx.init.Xavier())
     mesh = make_mesh((1,), ("dp",), jax.devices()[:1])
     step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                           mesh=mesh, learning_rate=0.05, momentum=0.9,
                           dtype="bfloat16")
-    X = nd.random.uniform(shape=(batch, 3, 224, 224))
+    X = nd.random.uniform(shape=(batch, 3, img, img))
     y = nd.array(np.random.randint(0, 1000, batch).astype("float32"))
     sps = _time_step(step, X, y, bulk_k, windows=2)
-    rec = {"batch": batch, "dtype": "bfloat16",
+    rec = {"model": "resnet18_v1", "img": img, "batch": batch,
+           "dtype": "bfloat16",
            "mirror": os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0"),
            "images_per_sec": round(batch / sps, 2)}
     # compiled-program peak from XLA's memory analysis (portable across
