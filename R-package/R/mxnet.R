@@ -1,0 +1,190 @@
+# mxnet for R over the mxnet_tpu C ABI — the training slice of the
+# reference R-package (ref: R-package/R/ndarray.R, symbol.R,
+# executor.R, model.R mx.model.FeedForward.create).
+#
+# The .Call glue (src/mxnet_r.c) wraps the same C entry points the perl
+# binding exercises; everything numeric originates in R.
+#
+# Loading: mx.init(shim_path) dyn.load()s the compiled shim
+# (R CMD SHLIB src/mxnet_r.c with the include/lib paths from
+# $MXTPU_ROOT — see tests/train_test.R).
+
+mx.init <- function(shim_path) {
+  dyn.load(shim_path)
+  invisible(TRUE)
+}
+
+# ---------------------------------------------------------------- misc
+mx.version <- function() .Call("RMX_version")
+mx.list.ops <- function() .Call("RMX_list_ops")
+
+# ------------------------------------------------------------- ndarray
+# ref: R-package/R/ndarray.R mx.nd.array / as.array.  Stored row-major
+# on the C side (the ABI is C-contiguous); R arrays are column-major,
+# so the copy transposes via aperm for rank-2.
+mx.nd.create <- function(shape) {
+  structure(list(handle = .Call("RMX_nd_create", as.integer(shape)),
+                 shape = as.integer(shape)),
+            class = "MXNDArray")
+}
+
+mx.nd.set <- function(nd, values) {
+  .Call("RMX_nd_set", nd$handle, as.double(values))
+  invisible(nd)
+}
+
+mx.nd.get <- function(nd) {
+  .Call("RMX_nd_get", nd$handle)
+}
+
+mx.nd.shape <- function(nd) .Call("RMX_nd_shape", nd$handle)
+
+# -------------------------------------------------------------- symbol
+# ref: R-package/R/symbol.R mx.symbol.load / arguments / infer.shape
+mx.symbol.load <- function(path) {
+  structure(list(handle = .Call("RMX_sym_load", path)),
+            class = "MXSymbol")
+}
+
+mx.symbol.arguments <- function(sym) {
+  .Call("RMX_sym_arguments", sym$handle)
+}
+
+mx.symbol.infer.arg.shapes <- function(sym, key, shape) {
+  .Call("RMX_sym_infer_arg_shapes", sym$handle, key, as.integer(shape))
+}
+
+# ------------------------------------------------------------ executor
+# ref: R-package/R/executor.R mx.simple.bind / mx.exec.forward /
+# mx.exec.backward; grad_req codes 0=null, 1=write
+mx.executor.bind <- function(sym, args, grads, reqs) {
+  handles <- lapply(args, function(a) a$handle)
+  ghandles <- lapply(grads, function(g) if (is.null(g)) NULL else g$handle)
+  structure(list(handle = .Call("RMX_exec_bind", sym$handle, handles,
+                                ghandles, as.integer(reqs))),
+            class = "MXExecutor")
+}
+
+mx.executor.forward <- function(ex, is.train = TRUE) {
+  .Call("RMX_exec_forward", ex$handle, as.integer(is.train))
+  invisible(ex)
+}
+
+mx.executor.backward <- function(ex) {
+  .Call("RMX_exec_backward", ex$handle)
+  invisible(ex)
+}
+
+mx.executor.outputs <- function(ex) {
+  lapply(.Call("RMX_exec_outputs", ex$handle),
+         function(h) structure(list(handle = h), class = "MXNDArray"))
+}
+
+# --------------------------------------------------- imperative invoke
+# the optimizer-op path: mx.op.invoke("sgd_mom_update",
+#   list(weight, grad, mom), out = weight, lr = "0.01", ...)
+mx.op.invoke <- function(op, inputs, out = NULL, params = list()) {
+  .Call("RMX_op_invoke", op,
+        lapply(inputs, function(a) a$handle),
+        if (is.null(out)) NULL else out$handle,
+        as.character(names(params)),
+        as.character(unlist(params)))
+  invisible(out)
+}
+
+# ---------------------------------------------------------- mlp model
+# mx.model.FeedForward.create, the training loop of the reference's
+# model.R:541 distilled to the slice this binding supports: bind once
+# at batch shape, epoch loop of forward/backward + per-parameter
+# sgd_mom_update, accuracy evaluation from R.
+mx.model.FeedForward.create <- function(symbol, X, y, batch.size,
+                                        num.round = 10,
+                                        learning.rate = 0.01,
+                                        momentum = 0.9,
+                                        eval.data = NULL,
+                                        verbose = TRUE) {
+  arg.names <- mx.symbol.arguments(symbol)
+  n.features <- ncol(X)
+  shapes <- mx.symbol.infer.arg.shapes(symbol, "data",
+                                       c(batch.size, n.features))
+  args <- list()
+  grads <- list()
+  moms <- list()
+  reqs <- integer(length(arg.names))
+  for (i in seq_along(arg.names)) {
+    name <- arg.names[[i]]
+    shape <- shapes[[i]]
+    size <- prod(shape)
+    nd <- mx.nd.create(shape)
+    if (name == "data" || grepl("label", name)) {
+      mx.nd.set(nd, rep(0, size))
+      grads[[i]] <- list(NULL)   # placeholder, fixed below
+      grads[i] <- list(NULL)
+      reqs[[i]] <- 0L
+    } else {
+      # uniform init, every float minted in R
+      mx.nd.set(nd, (runif(size) - 0.5) * 0.14)
+      g <- mx.nd.create(shape)
+      mx.nd.set(g, rep(0, size))
+      grads[[i]] <- g
+      m <- mx.nd.create(shape)
+      mx.nd.set(m, rep(0, size))
+      moms[[i]] <- m
+      reqs[[i]] <- 1L
+    }
+    args[[i]] <- nd
+  }
+  exec <- mx.executor.bind(symbol, args, grads, reqs)
+  data.idx <- match("data", arg.names)
+  label.idx <- grep("label", arg.names)[1]
+
+  n <- nrow(X)
+  n.batch <- n %/% batch.size
+  for (round in seq_len(num.round)) {
+    for (b in seq_len(n.batch)) {
+      rows <- ((b - 1) * batch.size + 1):(b * batch.size)
+      # row-major flatten: t() because R is column-major
+      mx.nd.set(args[[data.idx]], as.double(t(X[rows, ])))
+      mx.nd.set(args[[label.idx]], as.double(y[rows]))
+      mx.executor.forward(exec, is.train = TRUE)
+      mx.executor.backward(exec)
+      for (i in seq_along(arg.names)) {
+        if (reqs[[i]] == 1L) {
+          mx.op.invoke("sgd_mom_update",
+                       list(args[[i]], grads[[i]], moms[[i]]),
+                       out = args[[i]],
+                       params = list(lr = learning.rate,
+                                     momentum = momentum,
+                                     rescale_grad = 1.0 / batch.size))
+        }
+      }
+    }
+    if (verbose) cat(sprintf("round %d done\n", round))
+  }
+  structure(list(symbol = symbol, exec = exec, args = args,
+                 arg.names = arg.names, data.idx = data.idx,
+                 label.idx = label.idx, batch.size = batch.size),
+            class = "MXFeedForwardModel")
+}
+
+mx.model.predict <- function(model, X) {
+  n <- nrow(X)
+  bs <- model$batch.size
+  out <- NULL
+  b <- 1
+  while ((b - 1) * bs < n) {
+    rows <- ((b - 1) * bs + 1):min(b * bs, n)
+    pad <- bs - length(rows)
+    block <- X[rows, , drop = FALSE]
+    if (pad > 0)
+      block <- rbind(block, matrix(0, pad, ncol(X)))
+    mx.nd.set(model$args[[model$data.idx]], as.double(t(block)))
+    mx.executor.forward(model$exec, is.train = FALSE)
+    probs <- mx.nd.get(mx.executor.outputs(model$exec)[[1]])
+    k <- length(probs) / bs
+    m <- matrix(probs, nrow = bs, byrow = TRUE)
+    out <- rbind(out, m[seq_along(rows), , drop = FALSE])
+    b <- b + 1
+  }
+  out
+}

@@ -1,0 +1,281 @@
+/* R .Call glue over the mxnet_tpu C ABI — the same ~20 entry points the
+ * perl XS binding exercises (perl-package/AI-MXNetTPU/MXNetTPU.xs),
+ * wrapped for R's C API.  Mirrors the reference R-package's src/ layer
+ * (R-package/src/ndarray.cc, executor.cc, symbol.cc) at the scale of
+ * the training slice: ndarray create/copy, symbol load/infer, executor
+ * bind/forward/backward, imperative optimizer invoke.
+ *
+ * Built by tests/test_r_binding.py via `R CMD SHLIB` with
+ *   PKG_CPPFLAGS=-I$MXTPU_ROOT/include
+ *   PKG_LIBS=-L$MXTPU_ROOT/native -lmxnet_tpu
+ */
+#include <R.h>
+#include <Rinternals.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxnet_tpu/c_api.h"
+
+static void fail_mx(const char *what) {
+  Rf_error("%s: %s", what, MXGetLastError());
+}
+
+/* ---------------- handle wrappers -------------------------------- */
+static void nd_finalizer(SEXP p) {
+  void *h = R_ExternalPtrAddr(p);
+  if (h) {
+    MXNDArrayFree(h);
+    R_ClearExternalPtr(p);
+  }
+}
+
+static void sym_finalizer(SEXP p) {
+  void *h = R_ExternalPtrAddr(p);
+  if (h) {
+    MXSymbolFree(h);
+    R_ClearExternalPtr(p);
+  }
+}
+
+static void exec_finalizer(SEXP p) {
+  void *h = R_ExternalPtrAddr(p);
+  if (h) {
+    MXExecutorFree(h);
+    R_ClearExternalPtr(p);
+  }
+}
+
+static SEXP wrap_ptr(void *h, R_CFinalizer_t fin) {
+  SEXP p = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  if (fin) R_RegisterCFinalizerEx(p, fin, TRUE);
+  UNPROTECT(1);
+  return p;
+}
+
+static void *unwrap(SEXP p, const char *what) {
+  void *h = R_ExternalPtrAddr(p);
+  if (!h) Rf_error("%s: NULL handle", what);
+  return h;
+}
+
+/* ---------------- registry --------------------------------------- */
+SEXP RMX_list_ops(void) {
+  uint32_t n = 0;
+  const char **names = NULL;
+  if (MXListAllOpNames(&n, &names) != 0) fail_mx("MXListAllOpNames");
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (uint32_t i = 0; i < n; ++i)
+    SET_STRING_ELT(out, i, Rf_mkChar(names[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP RMX_version(void) {
+  int v = 0;
+  MXGetVersion(&v);
+  return Rf_ScalarInteger(v);
+}
+
+/* ---------------- ndarray ---------------------------------------- */
+SEXP RMX_nd_create(SEXP shape) {
+  int nd = LENGTH(shape);
+  mx_uint dims[16];
+  if (nd > 16) Rf_error("nd_create: too many dims");
+  for (int i = 0; i < nd; ++i) dims[i] = (mx_uint)INTEGER(shape)[i];
+  NDArrayHandle h = NULL;
+  if (MXNDArrayCreateEx(dims, (mx_uint)nd, 1, 0, 0, 0, &h) != 0)
+    fail_mx("MXNDArrayCreateEx");
+  return wrap_ptr(h, nd_finalizer);
+}
+
+static size_t nd_size(NDArrayHandle h) {
+  mx_uint ndim = 0;
+  const mx_uint *dims = NULL;
+  if (MXNDArrayGetShape(h, &ndim, &dims) != 0)
+    fail_mx("MXNDArrayGetShape");
+  size_t n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= dims[i];
+  return n;
+}
+
+SEXP RMX_nd_shape(SEXP nd) {
+  NDArrayHandle h = unwrap(nd, "nd_shape");
+  mx_uint ndim = 0;
+  const mx_uint *dims = NULL;
+  if (MXNDArrayGetShape(h, &ndim, &dims) != 0)
+    fail_mx("MXNDArrayGetShape");
+  SEXP out = PROTECT(Rf_allocVector(INTSXP, ndim));
+  for (mx_uint i = 0; i < ndim; ++i) INTEGER(out)[i] = (int)dims[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP RMX_nd_set(SEXP nd, SEXP values) {
+  NDArrayHandle h = unwrap(nd, "nd_set");
+  size_t n = nd_size(h);
+  if ((size_t)LENGTH(values) != n)
+    Rf_error("nd_set: length %d != ndarray size %lu", LENGTH(values),
+             (unsigned long)n);
+  float *buf = (float *)malloc(n * sizeof(float));
+  if (!buf) Rf_error("nd_set: oom");
+  double *src = REAL(values);
+  for (size_t i = 0; i < n; ++i) buf[i] = (float)src[i];
+  int rc = MXNDArraySyncCopyFromCPU(h, buf, n);
+  free(buf);
+  if (rc != 0) fail_mx("MXNDArraySyncCopyFromCPU");
+  return R_NilValue;
+}
+
+SEXP RMX_nd_get(SEXP nd) {
+  NDArrayHandle h = unwrap(nd, "nd_get");
+  size_t n = nd_size(h);
+  float *buf = (float *)malloc(n * sizeof(float));
+  if (!buf) Rf_error("nd_get: oom");
+  if (MXNDArraySyncCopyToCPU(h, buf, n) != 0) {
+    free(buf);
+    fail_mx("MXNDArraySyncCopyToCPU");
+  }
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  for (size_t i = 0; i < n; ++i) REAL(out)[i] = (double)buf[i];
+  free(buf);
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---------------- symbol ----------------------------------------- */
+SEXP RMX_sym_load(SEXP path) {
+  SymbolHandle h = NULL;
+  if (MXSymbolCreateFromFile(CHAR(STRING_ELT(path, 0)), &h) != 0)
+    fail_mx("MXSymbolCreateFromFile");
+  return wrap_ptr(h, sym_finalizer);
+}
+
+SEXP RMX_sym_arguments(SEXP sym) {
+  SymbolHandle h = unwrap(sym, "sym_arguments");
+  mx_uint n = 0;
+  const char **names = NULL;
+  if (MXSymbolListArguments(h, &n, &names) != 0)
+    fail_mx("MXSymbolListArguments");
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (mx_uint i = 0; i < n; ++i)
+    SET_STRING_ELT(out, i, Rf_mkChar(names[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+/* infer every argument shape from one named input (the training-slice
+ * usage: key="data", shape=c(batch, features)) */
+SEXP RMX_sym_infer_arg_shapes(SEXP sym, SEXP key, SEXP shape) {
+  SymbolHandle h = unwrap(sym, "sym_infer_arg_shapes");
+  const char *keys[1] = {CHAR(STRING_ELT(key, 0))};
+  int nd = LENGTH(shape);
+  mx_uint ind_ptr[2] = {0, (mx_uint)nd};
+  mx_uint dims[16];
+  if (nd > 16) Rf_error("infer: too many dims");
+  for (int i = 0; i < nd; ++i) dims[i] = (mx_uint)INTEGER(shape)[i];
+  mx_uint in_n = 0, out_n = 0, aux_n = 0;
+  const mx_uint *in_ndim = NULL, *out_ndim = NULL, *aux_ndim = NULL;
+  const mx_uint **in_data = NULL, **out_data = NULL, **aux_data = NULL;
+  int complete = 0;
+  if (MXSymbolInferShape(h, 1, keys, ind_ptr, dims, &in_n, &in_ndim,
+                         &in_data, &out_n, &out_ndim, &out_data, &aux_n,
+                         &aux_ndim, &aux_data, &complete) != 0)
+    fail_mx("MXSymbolInferShape");
+  if (!complete) Rf_error("infer_shape: incomplete");
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, in_n));
+  for (mx_uint i = 0; i < in_n; ++i) {
+    SEXP s = Rf_allocVector(INTSXP, in_ndim[i]);
+    SET_VECTOR_ELT(out, i, s);
+    for (mx_uint d = 0; d < in_ndim[i]; ++d)
+      INTEGER(s)[d] = (int)in_data[i][d];
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---------------- executor --------------------------------------- */
+SEXP RMX_exec_bind(SEXP sym, SEXP args, SEXP grads, SEXP reqs) {
+  SymbolHandle h = unwrap(sym, "exec_bind");
+  int n = LENGTH(args);
+  if (n > 256) Rf_error("exec_bind: too many args");
+  NDArrayHandle in_h[256], grad_h[256];
+  mx_uint req[256];
+  for (int i = 0; i < n; ++i) {
+    in_h[i] = unwrap(VECTOR_ELT(args, i), "exec_bind arg");
+    SEXP g = VECTOR_ELT(grads, i);
+    grad_h[i] = (g == R_NilValue) ? NULL : unwrap(g, "exec_bind grad");
+    req[i] = (mx_uint)INTEGER(reqs)[i];
+  }
+  ExecutorHandle out = NULL;
+  if (MXExecutorBindEX(h, 1, 0, 0, NULL, NULL, NULL, (mx_uint)n, in_h,
+                       grad_h, req, 0, NULL, NULL, &out) != 0)
+    fail_mx("MXExecutorBindEX");
+  return wrap_ptr(out, exec_finalizer);
+}
+
+SEXP RMX_exec_forward(SEXP ex, SEXP is_train) {
+  if (MXExecutorForward(unwrap(ex, "exec_forward"),
+                        INTEGER(is_train)[0]) != 0)
+    fail_mx("MXExecutorForward");
+  return R_NilValue;
+}
+
+SEXP RMX_exec_backward(SEXP ex) {
+  if (MXExecutorBackwardEx(unwrap(ex, "exec_backward"), 0, NULL, 1) != 0)
+    fail_mx("MXExecutorBackwardEx");
+  return R_NilValue;
+}
+
+SEXP RMX_exec_outputs(SEXP ex) {
+  mx_uint n = 0;
+  NDArrayHandle *arr = NULL;
+  if (MXExecutorOutputs(unwrap(ex, "exec_outputs"), &n, &arr) != 0)
+    fail_mx("MXExecutorOutputs");
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, n));
+  for (mx_uint i = 0; i < n; ++i)
+    /* borrowed handles: the executor owns them, no finalizer */
+    SET_VECTOR_ELT(out, i, wrap_ptr(arr[i], NULL));
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---------------- imperative op invoke ---------------------------- */
+SEXP RMX_op_invoke(SEXP opname, SEXP ins, SEXP out_nd, SEXP pkeys,
+                   SEXP pvals) {
+  mx_uint nc = 0;
+  AtomicSymbolCreator *creators = NULL;
+  if (MXSymbolListAtomicSymbolCreators(&nc, &creators) != 0)
+    fail_mx("MXSymbolListAtomicSymbolCreators");
+  const char *want = CHAR(STRING_ELT(opname, 0));
+  AtomicSymbolCreator creator = NULL;
+  for (mx_uint i = 0; i < nc; ++i) {
+    const char *name = NULL;
+    if (MXSymbolGetAtomicSymbolName(creators[i], &name) != 0)
+      fail_mx("MXSymbolGetAtomicSymbolName");
+    if (strcmp(name, want) == 0) {
+      creator = creators[i];
+      break;
+    }
+  }
+  if (!creator) Rf_error("op not found: %s", want);
+  int n_in = LENGTH(ins);
+  NDArrayHandle in_h[16];
+  if (n_in > 16) Rf_error("op_invoke: too many inputs");
+  for (int i = 0; i < n_in; ++i)
+    in_h[i] = unwrap(VECTOR_ELT(ins, i), "op_invoke in");
+  int n_params = LENGTH(pkeys);
+  const char *keys[16], *vals[16];
+  if (n_params > 16) Rf_error("op_invoke: too many params");
+  for (int i = 0; i < n_params; ++i) {
+    keys[i] = CHAR(STRING_ELT(pkeys, i));
+    vals[i] = CHAR(STRING_ELT(pvals, i));
+  }
+  int n_out = (out_nd == R_NilValue) ? 0 : 1;
+  NDArrayHandle out_h = n_out ? unwrap(out_nd, "op_invoke out") : NULL;
+  NDArrayHandle *outs = n_out ? &out_h : NULL;
+  if (MXImperativeInvoke(creator, n_in, in_h, &n_out, &outs, n_params,
+                         keys, vals) != 0)
+    fail_mx("MXImperativeInvoke");
+  return R_NilValue;
+}

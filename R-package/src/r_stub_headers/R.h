@@ -1,0 +1,4 @@
+/* stub companion of Rinternals.h — see that file's header comment */
+#ifndef R_STUB_R_H_
+#define R_STUB_R_H_
+#endif

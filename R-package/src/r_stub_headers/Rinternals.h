@@ -1,0 +1,38 @@
+/* Minimal stand-in for R's Rinternals.h: JUST the declarations
+ * src/mxnet_r.c uses, so the glue can be compile-CHECKED on boxes with
+ * no R installation (the round-5 build image — see ../README.md).
+ * Never installed; real builds use the real headers via R CMD SHLIB. */
+#ifndef R_STUB_RINTERNALS_H_
+#define R_STUB_RINTERNALS_H_
+typedef struct SEXPREC *SEXP;
+typedef void (*R_CFinalizer_t)(SEXP);
+extern SEXP R_NilValue;
+SEXP R_MakeExternalPtr(void *p, SEXP tag, SEXP prot);
+void *R_ExternalPtrAddr(SEXP s);
+void R_ClearExternalPtr(SEXP s);
+void R_RegisterCFinalizerEx(SEXP s, R_CFinalizer_t fun, int onexit);
+SEXP Rf_allocVector(unsigned int type, long n);
+SEXP Rf_protect(SEXP);
+void Rf_unprotect(int);
+#define PROTECT(x) Rf_protect(x)
+#define UNPROTECT(n) Rf_unprotect(n)
+#define STRSXP 16
+#define VECSXP 19
+#define INTSXP 13
+#define REALSXP 14
+int LENGTH(SEXP);
+int *INTEGER(SEXP);
+double *REAL(SEXP);
+SEXP STRING_ELT(SEXP, long);
+void SET_STRING_ELT(SEXP, long, SEXP);
+SEXP VECTOR_ELT(SEXP, long);
+void SET_VECTOR_ELT(SEXP, long, SEXP);
+const char *CHAR(SEXP);
+SEXP Rf_mkChar(const char *);
+SEXP Rf_ScalarInteger(int);
+void Rf_error(const char *, ...);
+#endif
+#ifndef TRUE
+#define TRUE 1
+#define FALSE 0
+#endif

@@ -13,6 +13,7 @@
 #include <iostream>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <sstream>
 #include <stdexcept>
 #include <string>
@@ -52,21 +53,29 @@ class Context {
  * optimizers (hot paths like mlp.cpp's 20k-iteration update loop call
  * this per op) */
 inline void *FindOpCreator(const std::string &op) {
-  static std::map<std::string, void *> *cache = [] {
-    auto *m = new std::map<std::string, void *>();
+  static std::mutex cache_mu;
+  static std::map<std::string, void *> cache;
+  std::lock_guard<std::mutex> lock(cache_mu);
+  auto refresh = [] {
     mx_uint n = 0;
     void **arr = nullptr;
     MXCPP_CHECK(MXSymbolListAtomicSymbolCreators(&n, &arr));
     for (mx_uint i = 0; i < n; ++i) {
       const char *name = nullptr;
       MXCPP_CHECK(MXSymbolGetAtomicSymbolName(arr[i], &name));
-      (*m)[name] = arr[i];
+      cache[name] = arr[i];
     }
-    return m;
-  }();
-  auto it = cache->find(op);
-  if (it == cache->end())
-    throw std::runtime_error("op not found: " + op);
+  };
+  if (cache.empty()) refresh();
+  auto it = cache.find(op);
+  if (it == cache.end()) {
+    // ops can register after the first walk (custom-op registration
+    // path): re-walk once before declaring the name unknown
+    refresh();
+    it = cache.find(op);
+    if (it == cache.end())
+      throw std::runtime_error("op not found: " + op);
+  }
   return it->second;
 }
 

@@ -171,9 +171,19 @@ class NDArray {
     return dst;
   }
 
-  /* host pointer into a cached copy (lenet.cpp GetData readback) */
+  /* host pointer into a cached copy (lenet.cpp GetData readback).
+   * Refreshes IN PLACE when the element count is unchanged, so a
+   * pointer from an earlier GetData() on the same object stays valid
+   * across calls — matching the reference, where GetData points at
+   * stable CPU chunk memory. */
   const mx_float *GetData() const {
-    host_cache_ = std::make_shared<std::vector<mx_float>>(Copy());
+    std::vector<mx_float> fresh = Copy();
+    if (host_cache_ && host_cache_->size() == fresh.size()) {
+      std::copy(fresh.begin(), fresh.end(), host_cache_->begin());
+    } else {
+      host_cache_ =
+          std::make_shared<std::vector<mx_float>>(std::move(fresh));
+    }
     return host_cache_->data();
   }
 

@@ -104,7 +104,10 @@ class Predictor:
         progress bookkeeping against the graph's node count, preserving
         the documented call contract (loop until step_left == 0).
         """
-        n = max(1, len(self.symbol.get_internals().list_outputs()))
+        n = getattr(self, "_n_internal_nodes", None)
+        if n is None:  # cache: the count is O(graph) to recompute
+            n = max(1, len(self.symbol.get_internals().list_outputs()))
+            self._n_internal_nodes = n
         if step == 0:
             self.forward()
         return max(0, n - 1 - int(step))

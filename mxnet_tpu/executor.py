@@ -595,10 +595,32 @@ class Executor:
         ex = Executor.simple_bind(self._symbol, ctx=self._ctx,
                                   grad_req=self._grad_req,
                                   group2ctx=self._group2ctx, **new_shapes)
+        # unchanged-shape arrays are SHARED, not copied — the reference
+        # Reshape keeps the same NDArray chunks (graph_executor.cc:1572),
+        # and callers rely on it: e.g. the DQN example's target network
+        # forwards through a reshaped executor while copy_params_to
+        # writes the ORIGINAL param arrays in place
+        # (example/reinforcement-learning/dqn/base.py:297); a copy here
+        # would freeze that executor's parameters forever.
         for name, arr in self.arg_dict.items():
-            if name in ex.arg_dict and ex.arg_dict[name].shape == arr.shape:
-                arr.copyto(ex.arg_dict[name])
+            tgt = ex.arg_dict.get(name)
+            if tgt is None or tgt.shape != arr.shape:
+                continue
+            if tgt.dtype == arr.dtype:
+                ex.arg_dict[name] = arr
+            else:  # dtype changed under the new shapes: copy-with-cast
+                arr.copyto(tgt)
+        for name, arr in self.grad_dict.items():
+            if arr is not None and ex.grad_dict.get(name) is not None \
+                    and ex.grad_dict[name].shape == arr.shape \
+                    and ex.grad_dict[name].dtype == arr.dtype:
+                ex.grad_dict[name] = arr
         for name, arr in self.aux_dict.items():
-            if name in ex.aux_dict and ex.aux_dict[name].shape == arr.shape:
-                arr.copyto(ex.aux_dict[name])
+            tgt = ex.aux_dict.get(name)
+            if tgt is None or tgt.shape != arr.shape:
+                continue
+            if tgt.dtype == arr.dtype:
+                ex.aux_dict[name] = arr
+            else:
+                arr.copyto(tgt)
         return ex

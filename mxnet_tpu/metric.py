@@ -336,8 +336,13 @@ class CustomMetric(EvalMetric):
     """ref: metric.py CustomMetric."""
 
     def __init__(self, feval, name=None, allow_extra_outputs=False, **kwargs):
-        name = name or getattr(feval, "__name__", "custom")
-        super().__init__("custom(%s)" % name, **kwargs)
+        if name is None:
+            # reference naming (metric.py:1123): the feval's own name;
+            # only anonymous callables get the custom(...) wrapper
+            name = getattr(feval, "__name__", "<custom>")
+            if "<" in name:
+                name = "custom(%s)" % name
+        super().__init__(name, **kwargs)
         self._feval = feval
         self._allow_extra_outputs = allow_extra_outputs
 

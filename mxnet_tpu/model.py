@@ -94,12 +94,26 @@ class FeedForward:
         return io.NDArrayIter(X, y, batch_size=batch,
                               shuffle=bool(is_train))
 
-    def _build_module(self, ctx):
+    def _build_module(self, ctx, data_iter=None):
         from . import module as _mod
 
-        label_names = [n for n in self.symbol.list_arguments()
-                       if n.endswith("_label")] or ["softmax_label"]
-        return _mod.Module(self.symbol, data_names=["data"],
+        # input/label names come from the ITERATOR when it declares them
+        # (ref model.py _init_iter + executor_manager bind: nets like
+        # example/recommenders' MF feed 'user'/'item' with label
+        # 'score', not 'data'/'softmax_label')
+        data_names, label_names = None, None
+        if data_iter is not None and getattr(data_iter, "provide_data",
+                                             None):
+            data_names = [d[0] for d in data_iter.provide_data]
+        if data_iter is not None and getattr(data_iter, "provide_label",
+                                             None):
+            label_names = [d[0] for d in data_iter.provide_label]
+        if data_names is None:
+            data_names = ["data"]
+        if not label_names:
+            label_names = [n for n in self.symbol.list_arguments()
+                           if n.endswith("_label")] or ["softmax_label"]
+        return _mod.Module(self.symbol, data_names=data_names,
                            label_names=label_names, context=ctx)
 
     # -- training (ref: model.py:774 fit) ------------------------------
@@ -115,12 +129,14 @@ class FeedForward:
                                                  "provide_data"):
             eval_data = self._init_iter(eval_data[0], eval_data[1],
                                         is_train=False)
-        self._module = self._build_module(self.ctx)
+        self._module = self._build_module(self.ctx, data_iter=train)
         opt_params = dict(self.kwargs)
         self._module.fit(
             train, eval_data=eval_data, eval_metric=eval_metric,
             epoch_end_callback=epoch_end_callback,
             batch_end_callback=batch_end_callback, kvstore=kvstore,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
             optimizer=self.optimizer,
             optimizer_params=opt_params or (("learning_rate", 0.01),),
             initializer=self.initializer, arg_params=self.arg_params,
@@ -139,7 +155,7 @@ class FeedForward:
         # changes (ref: model.py:593 _init_predictor re-binds likewise)
         shapes = tuple(tuple(d.shape) for d in data.provide_data)
         if getattr(self, "_pred_shapes", None) != shapes:
-            mod = self._build_module(self.ctx)
+            mod = self._build_module(self.ctx, data_iter=data)
             mod.bind(data_shapes=data.provide_data,
                      label_shapes=data.provide_label, for_training=False)
             mod.set_params(self.arg_params or {}, self.aux_params or {},
@@ -176,7 +192,7 @@ class FeedForward:
         if self._module is None or not self._module.binded:
             if self.arg_params is None:
                 raise MXNetError("score before fit/load")
-            mod = self._build_module(self.ctx)
+            mod = self._build_module(self.ctx, data_iter=data)
             mod.bind(data_shapes=data.provide_data,
                      label_shapes=data.provide_label, for_training=False)
             mod.set_params(self.arg_params, self.aux_params or {})

@@ -1,0 +1,3 @@
+"""``mx.notebook`` — training-visualization callbacks
+(ref: python/mxnet/notebook/__init__.py)."""
+from . import callback  # noqa: F401

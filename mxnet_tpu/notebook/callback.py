@@ -1,0 +1,302 @@
+"""Notebook training callbacks — PandasLogger and live learning-curve
+charts (ref: python/mxnet/notebook/callback.py:45 PandasLogger, :201
+LiveBokehChart, :300 LiveLearningCurve, :388 args_wrapper).
+
+The reference renders through bokeh inside Jupyter.  Here rendering is
+OPTIONAL: with bokeh importable the charts draw exactly like the
+reference; without it (headless CI, scripts like
+example/recommenders/matrix_fact.py that only read the captured
+metrics back) every callback still records the same ``_data`` /
+dataframe structures — the data contract is the API, the chart is a
+view.
+"""
+from __future__ import annotations
+
+import datetime
+import time
+from collections import defaultdict
+
+try:
+    import bokeh.io
+    import bokeh.plotting
+
+    _HAVE_BOKEH = True
+except ImportError:  # headless: capture-only mode
+    _HAVE_BOKEH = False
+
+try:
+    import pandas as pd
+
+    _HAVE_PANDAS = True
+except ImportError:
+    _HAVE_PANDAS = False
+
+__all__ = ["PandasLogger", "LiveBokehChart", "LiveTimeSeries",
+           "LiveLearningCurve", "args_wrapper"]
+
+
+def _add_new_columns(dataframe, metrics):
+    """Add new metrics as new columns to selected pandas dataframe
+    (ref :96)."""
+    new_columns = set(metrics.keys()) - set(dataframe.columns)
+    for col in new_columns:
+        dataframe[col] = None
+
+
+def _extend(baseData, newData):
+    """Assuming a is shorter than b, copy the end of b onto a
+    (ref :105)."""
+    baseData.extend(newData[len(baseData):])
+
+
+class PandasLogger(object):
+    """Logs statistics about a training run into pandas dataframes:
+    train, eval, epoch (ref :45)."""
+
+    def __init__(self, batch_size, frequent=50):
+        if not _HAVE_PANDAS:
+            raise ImportError("PandasLogger requires pandas")
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self._dataframes = {
+            "train": pd.DataFrame(),
+            "eval": pd.DataFrame(),
+            "epoch": pd.DataFrame(),
+        }
+        self.last_time = time.time()
+        self.start_time = datetime.datetime.now()
+        self.last_epoch_time = datetime.datetime.now()
+
+    @property
+    def train_df(self):
+        return self._dataframes["train"]
+
+    @property
+    def eval_df(self):
+        return self._dataframes["eval"]
+
+    @property
+    def epoch_df(self):
+        return self._dataframes["epoch"]
+
+    @property
+    def all_dataframes(self):
+        return self._dataframes
+
+    def elapsed(self):
+        return datetime.datetime.now() - self.start_time
+
+    def append_metrics(self, metrics, df_name):
+        dataframe = self._dataframes[df_name]
+        _add_new_columns(dataframe, metrics)
+        dataframe.loc[len(dataframe)] = metrics
+
+    def train_cb(self, param):
+        if param.nbatch % self.frequent == 0:
+            self._process_batch(param, "train")
+
+    def eval_cb(self, param):
+        self._process_batch(param, "eval")
+
+    def _process_batch(self, param, dataframe):
+        now = time.time()
+        if param.eval_metric is not None:
+            metrics = dict(param.eval_metric.get_name_value())
+            param.eval_metric.reset()
+        else:
+            metrics = {}
+        speed = self.frequent / (now - self.last_time)
+        metrics["batches_per_sec"] = speed * self.batch_size
+        metrics["records_per_sec"] = speed
+        metrics["elapsed"] = self.elapsed()
+        metrics["minibatch_count"] = param.nbatch
+        metrics["epoch"] = param.epoch
+        self.append_metrics(metrics, dataframe)
+        self.last_time = now
+
+    def epoch_cb(self):
+        metrics = {}
+        metrics["elapsed"] = self.elapsed()
+        now = datetime.datetime.now()
+        metrics["epoch_time"] = now - self.last_epoch_time
+        self.append_metrics(metrics, "epoch")
+        self.last_epoch_time = now
+
+    def callback_args(self):
+        return {
+            "batch_end_callback": self.train_cb,
+            "eval_end_callback": self.eval_cb,
+            "epoch_end_callback": self.epoch_cb,
+        }
+
+
+class LiveBokehChart(object):
+    """Live-updating chart; abstract base (ref :201).  Rendering is a
+    no-op without bokeh — subclasses still capture their data."""
+
+    def __init__(self, pandas_logger, metric_name, display_freq=10,
+                 batch_size=None, frequent=50):
+        if pandas_logger:
+            self.pandas_logger = pandas_logger
+        elif _HAVE_PANDAS:
+            self.pandas_logger = PandasLogger(batch_size=batch_size,
+                                              frequent=frequent)
+        else:
+            self.pandas_logger = None
+        self.display_freq = display_freq
+        self.last_update = time.time()
+        self.metric_name = metric_name
+        if _HAVE_BOKEH:
+            bokeh.io.output_notebook()
+        self.handle = self.setup_chart()
+
+    def setup_chart(self):
+        raise NotImplementedError(
+            "Incomplete base class: LiveBokehChart must be sub-classed")
+
+    def update_chart_data(self):
+        raise NotImplementedError(
+            "Incomplete base class: LiveBokehChart must be sub-classed")
+
+    def interval_elapsed(self):
+        return time.time() - self.last_update > self.display_freq
+
+    def _push_render(self):
+        if _HAVE_BOKEH and self.handle is not None:
+            bokeh.io.push_notebook(handle=self.handle)
+        self.last_update = time.time()
+
+    def _do_update(self):
+        self.update_chart_data()
+        self._push_render()
+
+    def batch_cb(self, param):
+        if self.interval_elapsed():
+            self._do_update()
+
+    def eval_cb(self, param):
+        self._do_update()
+
+    def callback_args(self):
+        return {
+            "batch_end_callback": self.batch_cb,
+            "eval_end_callback": self.eval_cb,
+        }
+
+
+class LiveTimeSeries(LiveBokehChart):
+    """Time-series of a live quantity (ref :320)."""
+
+    def __init__(self, **fig_params):
+        self.fig_params = fig_params
+        super(LiveTimeSeries, self).__init__(None, None)
+
+    def setup_chart(self):
+        self.start_time = datetime.datetime.now()
+        self.x_axis_val = []
+        self.y_axis_val = []
+        if not _HAVE_BOKEH:
+            return None
+        self.fig = bokeh.plotting.Figure(x_axis_type="datetime",
+                                         x_axis_label="Elapsed time",
+                                         **self.fig_params)
+        self.fig.line(self.x_axis_val, self.y_axis_val)
+        return bokeh.plotting.show(self.fig, notebook_handle=True)
+
+    def elapsed(self):
+        return datetime.datetime.now() - self.start_time
+
+    def update_chart_data(self, value):
+        self.x_axis_val.append(self.elapsed())
+        self.y_axis_val.append(value)
+        self._push_render()
+
+
+class LiveLearningCurve(LiveBokehChart):
+    """Training & validation metric over time as the network trains
+    (ref :300).  ``_data`` carries the captured series — the structure
+    example scripts read back after fit()."""
+
+    def __init__(self, metric_name, display_freq=10, frequent=50):
+        self.frequent = frequent
+        self.start_time = datetime.datetime.now()
+        self._data = {
+            "train": {"elapsed": []},
+            "eval": {"elapsed": []},
+        }
+        super(LiveLearningCurve, self).__init__(None, metric_name,
+                                                display_freq, frequent)
+
+    def setup_chart(self):
+        self.x_axis_val1 = []
+        self.y_axis_val1 = []
+        self.x_axis_val2 = []
+        self.y_axis_val2 = []
+        if not _HAVE_BOKEH:
+            return None
+        self.fig = bokeh.plotting.Figure(x_axis_type="datetime",
+                                         x_axis_label="Training time")
+        self.train1 = self.fig.line(self.x_axis_val1, self.y_axis_val1,
+                                    line_dash="dotted", alpha=0.3,
+                                    legend="train")
+        self.train2 = self.fig.circle(self.x_axis_val1, self.y_axis_val1,
+                                      size=1.5, line_alpha=0.3,
+                                      fill_alpha=0.3, legend="train")
+        self.train2.visible = False
+        self.valid1 = self.fig.line(self.x_axis_val2, self.y_axis_val2,
+                                    line_color="green", line_width=2,
+                                    legend="validation")
+        self.valid2 = self.fig.circle(self.x_axis_val2, self.y_axis_val2,
+                                      line_color="green", line_width=2,
+                                      legend=None)
+        self.fig.legend.location = "bottom_right"
+        self.fig.yaxis.axis_label = self.metric_name
+        return bokeh.plotting.show(self.fig, notebook_handle=True)
+
+    def batch_cb(self, param):
+        if param.nbatch % self.frequent == 0:
+            self._process_batch(param, "train")
+        if self.interval_elapsed():
+            self._do_update()
+
+    def eval_cb(self, param):
+        self._process_batch(param, "eval")
+        self._do_update()
+
+    def _process_batch(self, param, df_name):
+        if param.eval_metric is not None:
+            metrics = dict(param.eval_metric.get_name_value())
+            param.eval_metric.reset()
+        else:
+            metrics = {}
+        metrics["elapsed"] = datetime.datetime.now() - self.start_time
+        for key, value in metrics.items():
+            if key not in self._data[df_name]:
+                self._data[df_name][key] = []
+            self._data[df_name][key].append(value)
+
+    def update_chart_data(self):
+        if not _HAVE_BOKEH:
+            return
+        dataframe = self._data["train"]
+        if len(dataframe["elapsed"]):
+            _extend(self.x_axis_val1, dataframe["elapsed"])
+            _extend(self.y_axis_val1, dataframe[self.metric_name])
+        dataframe = self._data["eval"]
+        if len(dataframe["elapsed"]):
+            _extend(self.x_axis_val2, dataframe["elapsed"])
+            _extend(self.y_axis_val2, dataframe[self.metric_name])
+        if len(dataframe) > 10:
+            self.train1.visible = False
+            self.train2.visible = True
+
+
+def args_wrapper(*args):
+    """Generates callback arguments for model.fit() for a set of
+    callback objects (ref :388)."""
+    out = defaultdict(list)
+    for callback in args:
+        callback_args = callback.callback_args()
+        for k, v in callback_args.items():
+            out[k].append(v)
+    return dict(out)

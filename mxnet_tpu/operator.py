@@ -231,10 +231,37 @@ def _custom_fn(*arrays, op_type: str, _training: bool = False, **kwargs):
     return outs if n_out > 1 else outs[0]
 
 
+def _native_fn(*arrays, info: str, _training: bool = False, **kwargs):
+    """Creator body for the legacy ``_Native``/``_NDArray`` ops
+    (ref: src/operator/custom/native_op.cc:41, ndarray_op.cc:150
+    MXNET_REGISTER_OP_PROPERTY).  ``info`` is the adapter-prop token
+    minted by ``_legacy_symbol`` (the reference passes a C struct
+    pointer; a registry token is the process-local equivalent)."""
+    if info not in _REGISTRY:
+        raise MXNetError(
+            "legacy op token %r is not alive in this process — build "
+            "the symbol through NumpyOp/NDArrayOp.get_symbol()" % (info,))
+    return _custom_fn(*arrays, op_type=info, _training=_training)
+
+
+def _native_arg_names(params) -> List[str]:
+    """Input names from the live legacy prop, so symbol.create
+    auto-materializes unfed inputs (the reference NumpyOp's label
+    variable) and infer sees them by name."""
+    prop_cls = _REGISTRY.get(params.get("info"))
+    if prop_cls is None:
+        return []
+    return list(prop_cls().list_arguments())
+
+
 def _register_custom_op():
     from .ops import registry as _reg
 
     _reg.register("Custom", input_names=[], train_aware=True)(_custom_fn)
+    _reg.register("_Native", input_names=[], train_aware=True,
+                  dyn_input_names=_native_arg_names)(_native_fn)
+    _reg.register("_NDArray", input_names=[], train_aware=True,
+                  dyn_input_names=_native_arg_names)(_native_fn)
     # the nd/sym namespaces were generated before this module imported;
     # refresh them so mx.nd.Custom / mx.sym.Custom appear
     from . import ndarray as _nd_pkg
@@ -395,9 +422,14 @@ def _legacy_symbol(op_instance, to_host, from_host, *args, **kwargs):
     reg_name = "_legacy_pyop_%d" % id(op_instance)
     _REGISTRY[reg_name] = _LegacyProp
     PythonOp._ref_holder.append(op_instance)
-    from .symbol import Custom as _Custom
+    # compose through the legacy CREATOR (ref python/mxnet/operator.py
+    # NumpyOp.get_symbol calls the _Native creator with an info pointer;
+    # NDArrayOp the _NDArray creator) so the node's op name round-trips
+    # the same as reference-produced symbols
+    from .symbol.symbol import create as _sym_create
 
-    return _Custom(*args, op_type=reg_name, **kwargs)
+    creator = "_Native" if isinstance(op_instance, NumpyOp) else "_NDArray"
+    return _sym_create(creator, *args, info=reg_name, **kwargs)
 
 
 class NumpyOp(PythonOp):

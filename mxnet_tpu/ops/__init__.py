@@ -20,3 +20,4 @@ from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import linalg  # noqa: F401
 from . import extra  # noqa: F401
+from . import plugin  # noqa: F401

@@ -73,7 +73,30 @@ def infer_reshape(src_shape: Tuple[int, ...], target: Sequence[int], reverse: bo
 
 
 @register("Reshape", aliases=("reshape",))
-def _reshape(data, shape=(), reverse=False, **_):
+def _reshape(data, shape=(), reverse=False, target_shape=None,
+             keep_highest=False, **_):
+    if not shape and target_shape:
+        # legacy pre-0.9 interface (ref: matrix_op-inl.h ReshapeParam
+        # target_shape/keep_highest; still used by e.g.
+        # example/cnn_text_classification/text_cnn.py): 0 in
+        # target_shape means infer that dim, keep_highest preserves
+        # dim 0 unchanged
+        tgt = list(target_shape)
+        if keep_highest:
+            tgt = [data.shape[0]] + tgt[1:]
+        known = 1
+        infer_at = None
+        for i, d in enumerate(tgt):
+            if d == 0 and not (keep_highest and i == 0):
+                infer_at = i
+            else:
+                known *= d
+        if infer_at is not None:
+            total = 1
+            for d in data.shape:
+                total *= d
+            tgt[infer_at] = total // known
+        return jnp.reshape(data, tuple(tgt))
     return jnp.reshape(data, infer_reshape(data.shape, shape, reverse))
 
 

@@ -275,8 +275,10 @@ def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
     def f_bwd(res, g):
         prob, label = res
         if multi_output:
-            # prob: (N, C, ...), label: (N, ...)
-            lab = label.astype(jnp.int32)
+            # prob: (N, C, ...); label may arrive flat (N, prod(...)) —
+            # the reference accepts both (fcn-xs feeds (N, H*W))
+            lab = label.astype(jnp.int32).reshape(
+                (prob.shape[0],) + prob.shape[2:])
             onehot = jax.nn.one_hot(lab, prob.shape[1], dtype=prob.dtype)
             onehot = jnp.moveaxis(onehot, -1, 1)
             grad = prob - onehot
@@ -392,18 +394,37 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     mv = lax.stop_gradient(moving_var)
 
     if _training and not use_global_stats:
-        mean = jnp.mean(data, axis=reduce_axes)
-        var = jnp.var(data, axis=reduce_axes)
-        new_mm = mm * momentum + lax.stop_gradient(mean) * (1.0 - momentum)
-        new_mv = mv * momentum + lax.stop_gradient(var) * (1.0 - momentum)
+        # single-pass statistics: E[x] and E[x²] reduce in ONE read of
+        # the activation where mean-then-E[(x-mean)²] forces a second
+        # dependent pass over HBM.  BN is bandwidth- not compute-bound
+        # on TPU (resnet50-bf16@32 measured: two-pass 2398 img/s,
+        # one-pass 2499, BN removed 3230 — ROUND5_NOTES); fp32
+        # accumulation keeps the E[x²]−E[x]² cancellation benign.
+        acc_t = jnp.promote_types(data.dtype, jnp.float32)
+        xf = data.astype(acc_t)
+        mean32 = jnp.mean(xf, axis=reduce_axes)
+        var32 = jnp.maximum(
+            jnp.mean(xf * xf, axis=reduce_axes) - mean32 * mean32, 0.0)
+        new_mm = mm * momentum + \
+            lax.stop_gradient(mean32).astype(mm.dtype) * (1.0 - momentum)
+        new_mv = mv * momentum + \
+            lax.stop_gradient(var32).astype(mv.dtype) * (1.0 - momentum)
     else:
-        mean, var = mm, mv
+        acc_t = jnp.promote_types(data.dtype, jnp.float32)
+        mean32 = mm.astype(acc_t)
+        var32 = mv.astype(acc_t)
         new_mm, new_mv = mm, mv
 
-    inv = lax.rsqrt(var.reshape(bshape) + eps)
-    out = (data - mean.reshape(bshape)) * inv * g.reshape(bshape) + beta.reshape(bshape)
+    # fold the normalization into per-channel scale/shift vectors so the
+    # big tensor is touched once (x·scale + shift), not three times
+    inv32 = lax.rsqrt(var32 + eps)
+    scale = g.astype(inv32.dtype) * inv32
+    shift = beta.astype(inv32.dtype) - mean32 * scale
+    out = data * scale.reshape(bshape).astype(data.dtype) + \
+        shift.reshape(bshape).astype(data.dtype)
     if output_mean_var:
-        return out, mean, lax.rsqrt(var + eps), new_mm, new_mv
+        return (out, mean32.astype(data.dtype), inv32.astype(data.dtype),
+                new_mm, new_mv)
     return out, new_mm, new_mv
 
 
@@ -604,7 +625,11 @@ def _roi_pooling(data, rois, pooled_size=(0, 0), spatial_scale=1.0, **_):
 @register("Crop", nondiff=False)
 def _crop(*args, offset=(0, 0), h_w=(0, 0), num_args=1, center_crop=False, **_):
     data = args[0]
-    if num_args > 1:
+    # the reference's key_var_num_args creator fills num_args from the
+    # argument count; callers composing Crop(*[data, shape_ref]) rely
+    # on it (example/fcn-xs/symbol_fcnxs.py:158) — infer from the
+    # actual inputs so the param is optional here too
+    if len(args) > 1 or num_args > 1:
         th, tw = args[1].shape[2], args[1].shape[3]
     else:
         th, tw = int(h_w[0]), int(h_w[1])

@@ -1,0 +1,375 @@
+"""Plugin operator bridges: WarpCTC, CaffeOp/CaffeLoss, TorchModule/
+TorchCriterion — the reference's `plugin/` tree as in-graph creators.
+
+The reference links external runtimes (baidu warp-ctc, a full Caffe
+build, LuaTorch) behind MXNET_REGISTER_OP_PROPERTY creators
+(ref: plugin/warpctc/warpctc.cc:43, plugin/caffe/caffe_op.cc:65,
+plugin/caffe/caffe_loss.cc:65, plugin/torch/torch_module.cc:43,
+plugin/torch/torch_criterion.cc:43).  TPU-first there is nothing to
+link: CTC is the differentiable contrib kernel, Caffe layer specs lower
+to the same XLA ops the native layers use, and the Torch `nn.*`
+constructor subset evaluates to pure-JAX bodies.  What this preserves is
+the *creator surface* — `mx.sym.CaffeOp(data_0=..., prototxt=...)`
+scripts (example/caffe/caffe_net.py) compose, train and checkpoint
+without a Caffe install.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = ["parse_layer", "torch_arg_names", "caffe_arg_names"]
+
+
+# ------------------------------------------------------------------ util
+def _parse_prototxt(text: str) -> Dict:
+    # the converter's parser is the single prototxt implementation in
+    # the tree (tools/caffe_converter/prototxt.py); ops import it lazily
+    # so `import mxnet_tpu` never requires the tools/ dir on sys.path
+    try:
+        from tools.caffe_converter.prototxt import parse_prototxt
+    except ImportError as exc:  # pragma: no cover - repo layout issue
+        raise ImportError(
+            "CaffeOp needs tools.caffe_converter.prototxt (run from the "
+            "repository root, which carries the tools/ package)") from exc
+    return parse_prototxt(text)
+
+
+def parse_layer(prototxt: str) -> Dict:
+    """``layer { ... }`` spec → its inner dict (caffe plugin passes one
+    layer per op; ref: plugin/caffe/caffe_op-inl.h:48 CaffeOpParam)."""
+    block = _parse_prototxt(prototxt)
+    layer = block.get("layer", block)
+    if isinstance(layer, list):
+        layer = layer[0]
+    return layer
+
+
+def _as_pair(v, default=0) -> Tuple[int, int]:
+    if v is None:
+        return (default, default)
+    if isinstance(v, list):
+        a = int(v[0])
+        b = int(v[1]) if len(v) > 1 else int(v[0])
+        return (a, b)
+    return (int(v), int(v))
+
+
+def caffe_arg_names(params: Dict) -> List[str]:
+    """ref: caffe_op-inl.h:240 ListArguments — data_i then the odd
+    0_weight / i_bias naming the reference uses."""
+    nd = int(params.get("num_data", 1))
+    nw = int(params.get("num_weight", 0))
+    names = ["data_%d" % i for i in range(nd)]
+    for i in range(nw):
+        names.append("0_weight" if i == 0 else "%d_bias" % i)
+    return names
+
+
+# ------------------------------------------------------------- WarpCTC
+@register("WarpCTC", input_names=["data", "label"])
+def _warpctc(data, label, label_length=0, input_length=0, **_):
+    """Baidu warp-ctc output layer (ref: plugin/warpctc/warpctc-inl.h).
+
+    data (T*N, A) time-major pre-softmax activations, label (N*L,) flat
+    with blank=0 padding (ref :156-190: blank_label fixed at 0, label
+    lengths counted as non-blank entries).  Forward = softmax (ref :95
+    Forward); backward ignores out_grad and writes d(sum_b ctc_cost_b)/
+    d(activations) (ref :208 compute_ctc_loss into in_grad) — computed
+    here by jax.grad over the differentiable contrib CTC kernel instead
+    of the warp-ctc CUDA build.
+    """
+    from .contrib import _ctc_loss
+
+    T = int(input_length)
+    L = int(label_length)
+    A = data.shape[1]
+    N = data.shape[0] // T
+
+    @jax.custom_vjp
+    def f(x, lab):
+        return jax.nn.softmax(x.astype(jnp.float32), axis=-1).astype(x.dtype)
+
+    def f_fwd(x, lab):
+        return f(x, lab), (x, lab)
+
+    def f_bwd(res, _g):
+        x, lab = res
+
+        def total_cost(flat):
+            # (T*N, A) -> (T, N, A); labels (N, L) blank-0 padded
+            act = flat.reshape(T, N, A).astype(jnp.float32)
+            labels = lab.reshape(N, L)
+            return jnp.sum(_ctc_loss(act, labels, blank_label="first"))
+
+        return jax.grad(total_cost)(x).astype(x.dtype), None
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data, label.astype(jnp.int32))
+
+
+# ------------------------------------------------------------ CaffeOp
+def _caffe_layer_forward(layer: Dict, data, weights, key=None,
+                         training=False):
+    ltype = layer.get("type", "")
+    x = data[0]
+    if ltype == "InnerProduct":
+        w, b = weights[0], weights[1] if len(weights) > 1 else None
+        flat = x.reshape(x.shape[0], -1)
+        y = flat @ w.T
+        return y + b if b is not None else y
+    if ltype == "Convolution":
+        p = layer.get("convolution_param", {})
+        kh, kw = _as_pair(p.get("kernel_size"), 1) \
+            if "kernel_size" in p else (int(p.get("kernel_h", 1)),
+                                        int(p.get("kernel_w", 1)))
+        sh, sw = _as_pair(p.get("stride"), 1) if "stride" in p else (
+            int(p.get("stride_h", 1)), int(p.get("stride_w", 1)))
+        ph, pw = _as_pair(p.get("pad"), 0) if "pad" in p else (
+            int(p.get("pad_h", 0)), int(p.get("pad_w", 0)))
+        g = int(p.get("group", 1))
+        w = weights[0]
+        y = lax.conv_general_dilated(
+            x, w, (sh, sw), [(ph, ph), (pw, pw)],
+            rhs_dilation=_as_pair(p.get("dilation"), 1),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=g)
+        if len(weights) > 1:
+            y = y + weights[1][None, :, None, None]
+        return y
+    if ltype == "Pooling":
+        p = layer.get("pooling_param", {})
+        if p.get("global_pooling"):
+            red = jnp.max if p.get("pool", "MAX") == "MAX" else jnp.mean
+            return red(x, axis=(2, 3), keepdims=True)
+        k = _as_pair(p.get("kernel_size"), 1)
+        s = _as_pair(p.get("stride"), 1) if "stride" in p else k
+        pad = _as_pair(p.get("pad"), 0)
+        H, W = x.shape[2], x.shape[3]
+        # caffe rounds output dims UP (ceil mode, pooling_layer.cpp):
+        # extend the high-side padding so reduce_window covers the tail
+        out_h = -(-(H + 2 * pad[0] - k[0]) // s[0]) + 1
+        out_w = -(-(W + 2 * pad[1] - k[1]) // s[1]) + 1
+        hi_h = (out_h - 1) * s[0] + k[0] - H - pad[0]
+        hi_w = (out_w - 1) * s[1] + k[1] - W - pad[1]
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = [(0, 0), (0, 0), (pad[0], hi_h), (pad[1], hi_w)]
+        if p.get("pool", "MAX") == "MAX":
+            return lax.reduce_window(x, -_np.inf, lax.max, window, strides,
+                                     pads)
+        # AVE: zero-padded sum over the fixed kernel area (caffe's edge
+        # divisor clips to the padded image; interior windows identical)
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        return summed / (k[0] * k[1])
+    if ltype == "ReLU":
+        return jnp.maximum(x, 0)
+    if ltype == "TanH":
+        return jnp.tanh(x)
+    if ltype == "Sigmoid":
+        return jax.nn.sigmoid(x)
+    if ltype == "Softmax":
+        return jax.nn.softmax(x, axis=1)
+    if ltype == "Dropout":
+        ratio = float(layer.get("dropout_param", {})
+                      .get("dropout_ratio", 0.5))
+        if not training or key is None or ratio <= 0:
+            return x
+        keep = jax.random.bernoulli(key, 1.0 - ratio, x.shape)
+        return jnp.where(keep, x / (1.0 - ratio), 0).astype(x.dtype)
+    if ltype == "Concat":
+        ax = int(layer.get("concat_param", {}).get("axis", 1))
+        return jnp.concatenate(list(data), axis=ax)
+    if ltype == "Eltwise":
+        op = layer.get("eltwise_param", {}).get("operation", "SUM")
+        y = data[0]
+        for d in data[1:]:
+            y = y * d if op == "PROD" else \
+                jnp.maximum(y, d) if op == "MAX" else y + d
+        return y
+    raise ValueError("CaffeOp: unsupported layer type %r (supported: "
+                     "InnerProduct, Convolution, Pooling, ReLU, TanH, "
+                     "Sigmoid, Softmax, Dropout, Concat, Eltwise)"
+                     % (ltype,))
+
+
+@register("CaffeOp", input_names=[], rng=True, train_aware=True,
+          dyn_input_names=caffe_arg_names)
+def _caffe_op(key, *arrays, prototxt="layer{}", num_data=1, num_weight=0,
+              num_out=1, _training=False, **_):
+    """In-graph Caffe layer (ref: plugin/caffe/caffe_op-inl.h).  The
+    layer spec lowers straight to XLA ops — same math, no Caffe build;
+    weights are ordinary mxnet args so init/optimizers/checkpoints all
+    apply (reference arg naming preserved, see caffe_arg_names)."""
+    layer = parse_layer(prototxt)
+    nd = int(num_data)
+    data = arrays[:nd]
+    weights = arrays[nd:nd + int(num_weight)]
+    return _caffe_layer_forward(layer, list(data), list(weights), key=key,
+                                training=bool(_training))
+
+
+@register("CaffeLoss", input_names=["data", "label"])
+def _caffe_loss(data, label, prototxt="layer{}", num_data=2, num_out=1,
+                grad_scale=1.0, **_):
+    """Caffe loss layer (ref: plugin/caffe/caffe_loss-inl.h).  Output is
+    the layer's normalized response; backward ignores out_grad and
+    injects grad_scale-scaled caffe gradients (ref :137 Backward:
+    caffe gradient × grad_scale, normalized by batch as caffe does)."""
+    layer = parse_layer(prototxt)
+    ltype = layer.get("type", "")
+    gs = float(grad_scale)
+    if ltype == "SoftmaxWithLoss":
+
+        @jax.custom_vjp
+        def f(x, lab):
+            return jax.nn.softmax(x.astype(jnp.float32), axis=1) \
+                .astype(x.dtype)
+
+        def f_fwd(x, lab):
+            return f(x, lab), (x, lab)
+
+        def f_bwd(res, _g):
+            x, lab = res
+            p = jax.nn.softmax(x.astype(jnp.float32), axis=1)
+            onehot = jax.nn.one_hot(lab.astype(jnp.int32), x.shape[1],
+                                    dtype=p.dtype)
+            gx = (p - onehot) * (gs / x.shape[0])
+            return gx.astype(x.dtype), None
+
+        f.defvjp(f_fwd, f_bwd)
+        return f(data, label)
+    if ltype == "EuclideanLoss":
+
+        @jax.custom_vjp
+        def f(x, lab):
+            d = (x - lab).astype(jnp.float32)
+            return (0.5 * jnp.sum(d * d) / x.shape[0]).astype(x.dtype)
+
+        def f_fwd(x, lab):
+            return f(x, lab), (x, lab)
+
+        def f_bwd(res, _g):
+            x, lab = res
+            gx = (x - lab) * (gs / x.shape[0])
+            return gx.astype(x.dtype), None
+
+        f.defvjp(f_fwd, f_bwd)
+        return f(data, label)
+    raise ValueError("CaffeLoss: unsupported layer type %r (supported: "
+                     "SoftmaxWithLoss, EuclideanLoss)" % (ltype,))
+
+
+# -------------------------------------------------------- Torch bridge
+_TORCH_CALL = re.compile(r"nn\.([A-Za-z_][A-Za-z0-9_]*)\s*\(([^)]*)\)")
+
+
+def _parse_lua(lua_string: str) -> Tuple[str, List[float]]:
+    m = _TORCH_CALL.search(lua_string)
+    if not m:
+        raise ValueError("TorchModule: cannot parse lua_string %r — "
+                         "expected an nn.Module constructor like "
+                         "'nn.Linear(784, 128)'" % (lua_string,))
+    name = m.group(1)
+    args = [float(a) for a in m.group(2).replace(" ", "").split(",") if a]
+    return name, args
+
+
+def torch_arg_names(params: Dict) -> List[str]:
+    """data_i then the module's parameter names for the supported
+    subset (the reference asks the live lua module; ref:
+    torch_module-inl.h:283 ListArguments)."""
+    nd = int(params.get("num_data", 1))
+    names = ["data_%d" % i for i in range(nd)]
+    npar = int(params.get("num_params", 0))
+    if npar >= 1:
+        names.append("weight")
+    if npar >= 2:
+        names.append("bias")
+    for i in range(2, npar):
+        names.append("param_%d" % i)
+    return names
+
+
+@register("TorchModule", input_names=[], train_aware=True,
+          dyn_input_names=torch_arg_names)
+def _torch_module(*arrays, lua_string="", num_data=1, num_params=0,
+                  num_outputs=1, _training=False, **_):
+    """LuaTorch nn.Module bridge (ref: plugin/torch/torch_module-inl.h).
+    The lua constructor subset evaluates to the equivalent pure-JAX
+    body; module parameters are ordinary mxnet args."""
+    name, largs = _parse_lua(lua_string)
+    nd = int(num_data)
+    x = arrays[0]
+    params = arrays[nd:nd + int(num_params)]
+    if name == "Linear":
+        w = params[0]
+        y = x.reshape(x.shape[0], -1) @ w.T
+        return y + params[1] if len(params) > 1 else y
+    if name == "Tanh":
+        return jnp.tanh(x)
+    if name == "ReLU":
+        return jnp.maximum(x, 0)
+    if name == "Sigmoid":
+        return jax.nn.sigmoid(x)
+    if name == "SoftMax":
+        return jax.nn.softmax(x, axis=-1)
+    if name == "LogSoftMax":
+        return jax.nn.log_softmax(x, axis=-1)
+    if name == "Identity":
+        return x
+    raise ValueError("TorchModule: unsupported lua module nn.%s "
+                     "(supported: Linear, Tanh, ReLU, Sigmoid, SoftMax, "
+                     "LogSoftMax, Identity)" % (name,))
+
+
+@register("TorchCriterion", input_names=["data", "label"])
+def _torch_criterion(data, label, lua_string="", label_shape=(),
+                     grad_scale=1.0, **_):
+    """LuaTorch criterion bridge (ref: plugin/torch/torch_criterion-inl.h
+    — forward emits the scalar loss, backward injects grad_scale-scaled
+    criterion gradients, ignoring out_grad)."""
+    name, _largs = _parse_lua(lua_string)
+    gs = float(grad_scale)
+
+    if name == "MSECriterion":
+
+        def loss(x, lab):
+            d = (x - lab).astype(jnp.float32)
+            return jnp.mean(d * d)
+    elif name == "ClassNLLCriterion":
+
+        def loss(x, lab):
+            # torch convention: input is log-probabilities, 1-based
+            # class labels
+            idx = lab.astype(jnp.int32).reshape(-1) - 1
+            picked = jnp.take_along_axis(
+                x.astype(jnp.float32), idx[:, None], axis=1)[:, 0]
+            return -jnp.mean(picked)
+    else:
+        raise ValueError("TorchCriterion: unsupported criterion nn.%s "
+                         "(supported: MSECriterion, ClassNLLCriterion)"
+                         % (name,))
+
+    @jax.custom_vjp
+    def f(x, lab):
+        return loss(x, lab).astype(jnp.float32).reshape(1)
+
+    def f_fwd(x, lab):
+        return f(x, lab), (x, lab)
+
+    def f_bwd(res, _g):
+        x, lab = res
+        gx = jax.grad(lambda xx: loss(xx, lab))(x) * gs
+        return gx.astype(x.dtype), None
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data, label)

@@ -61,6 +61,7 @@ class Op:
         "aliases",
         "input_names",
         "remat",
+        "dyn_input_names",
     )
 
     def __init__(
@@ -75,6 +76,7 @@ class Op:
         train_aware: bool = False,
         doc: str = "",
         input_names: Optional[Sequence[str]] = None,
+        dyn_input_names: Optional[Callable] = None,
     ):
         self.name = name
         self.fn = fn
@@ -90,6 +92,10 @@ class Op:
         # whole-program ops (CachedOp) opt in to the mirror/remat wrap;
         # primitive ops never do — remat granularity is the block trace
         self.remat = False
+        # param-dependent input arity/naming (CaffeOp's num_data/
+        # num_weight, TorchModule's num_params): fn(params)->names, the
+        # FListInputNames-with-attrs analogue
+        self.dyn_input_names = dyn_input_names
         self.aliases: List[str] = []
         if input_names is None:
             # derive from the body's leading positional params (skip the rng

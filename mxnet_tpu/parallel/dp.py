@@ -300,7 +300,12 @@ class FusedTrainStep:
         from .. import random as _random
 
         self._moms = [jnp.zeros_like(p.data()._data) for p in self._cells]
-        self._key_root = jax.device_put(_random._next_key(), rep)
+        try:
+            self._key_root = jax.device_put(_random._next_key(), rep)
+        except Exception:
+            # abstract-topology mesh (AOT lowering via lower_only):
+            # nothing executes, so placement is irrelevant
+            self._key_root = _random._next_key()
         self._key_gen = _random._generation
         self._key_ctr = 0
         self._placed = False
@@ -380,6 +385,39 @@ class FusedTrainStep:
             cell._vt = token
             self._param_vt[i] = token
         return NDArray.from_raw(losses)
+
+    def lower_only(self, data, label):
+        """AOT-lower the single-step program WITHOUT executing — shape
+        specs only, so the mesh may be built from an abstract topology
+        (jax.experimental.topologies) with no attached hardware.  Used
+        by parallel/overlap.py to measure collective/compute overlap
+        from the compiled schedule of the REAL dryrun program."""
+        jax = _jax()
+        import numpy as np
+
+        if not self._built:
+            self._build(data if isinstance(data, NDArray) else
+                        NDArray(data))
+        raw_data = data._data if isinstance(data, NDArray) else data
+        raw_label = label._data if isinstance(label, NDArray) else label
+        dtype = self._dtype if self._dtype is not None else raw_data.dtype
+
+        def spec(shape, dt, sh):
+            return jax.ShapeDtypeStruct(tuple(shape), dt, sharding=sh)
+
+        p_specs = [spec(p.data()._data.shape, p.data()._data.dtype, sh)
+                   for p, sh in zip(self._cells, self._param_sh)]
+        m_specs = [spec(p.data()._data.shape, p.data()._data.dtype, sh)
+                   for p, sh in zip(self._cells, self._param_sh)]
+        d_spec = spec(raw_data.shape, dtype, self._data_sh)
+        l_spec = spec(raw_label.shape, raw_label.dtype, self._data_sh)
+        from .. import random as _random
+
+        key = _random._next_key()
+        k_spec = spec(key.shape, key.dtype, self._rep)
+        c_spec = spec((), np.int32, self._rep)
+        return self._step.lower(p_specs, m_specs, d_spec, l_spec, k_spec,
+                                c_spec)
 
     def __call__(self, data, label):
         """Run one optimizer step; returns (loss, logits) NDArrays."""

@@ -1,0 +1,316 @@
+"""Measured collective/compute overlap from the compiled XLA schedule
+(VERDICT r4 weak #6 / next-round #7: the scaling projection's 0.7
+overlap was assumed; the compiled dryrun program contains the
+async-start/done spans needed to measure it).
+
+How: the SAME FusedTrainStep program the dryrun jits is AOT-compiled
+against an abstract TPU topology (``jax.experimental.topologies`` —
+v5e:2x4, 8 chips, no hardware needed), and the scheduled HLO is walked:
+
+* every ``all-reduce-start``/``all-reduce-done`` pair is an async
+  collective whose transfer rides ICI while the instructions scheduled
+  BETWEEN the pair execute on the MXU;
+* the FLOPs of those in-flight instructions (convolution/dot shapes
+  parsed from the text, fusions resolved through their called
+  computations) convert to hiding time via the bench's measured
+  achieved-FLOPs rate;
+* overlap = Σ min(t_comm_i, t_hidden_i) / Σ t_comm_i — the fraction of
+  communication time the schedule actually hides.
+
+ICI bandwidth still enters t_comm (no multi-chip hardware to measure
+it; the public v5e figure stays an assumption, labeled as such) — but
+the load-bearing unknown, whether XLA's schedule interleaves compute
+with the gradient all-reduces at all, becomes a measurement.
+
+Reference contract being replaced: ps-lite/NCCL overlap via engine
+dependency tracking (src/kvstore/kvstore_nccl.h, comm.h) — XLA's
+latency-hiding scheduler is the TPU-side equivalent.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["measure_overlap", "schedule_overlap_from_text"]
+
+
+_SHAPE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def _shape_elems(type_str: str) -> int:
+    m = _SHAPE.search(type_str)
+    if not m:
+        return 0
+    dims = m.group(2)
+    if not dims:
+        return 1
+    n = 1
+    for d in dims.split(","):
+        n *= int(d)
+    return n
+
+
+def _dtype_bytes(type_str: str) -> int:
+    m = _SHAPE.search(type_str)
+    if not m:
+        return 4
+    return {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+            "s8": 1, "u8": 1, "pred": 1}.get(m.group(1), 4)
+
+
+def _operand_names(line: str, op: str) -> List[str]:
+    seg = line.split(" " + op + "(", 1)
+    if len(seg) < 2:
+        return []
+    body = seg[1].split(")", 1)[0]
+    return [t.strip().lstrip("%") for t in body.split(",") if t.strip()]
+
+
+def _dims_of(type_str: Optional[str]) -> List[int]:
+    if not type_str:
+        return []
+    m = _SHAPE.search(type_str)
+    if not m or not m.group(2):
+        return []
+    return [int(d) for d in m.group(2).split(",")]
+
+
+def _conv_flops(line: str, types: Dict[str, str]) -> float:
+    """2 * out_elems * rhs_input_feature_dim * window_size — valid for
+    forward, dgrad and wgrad forms alike (the contraction is always the
+    rhs 'i' dim times the applied window)."""
+    m = re.search(r"=\s+(\S+)\s+convolution\(", line)
+    if not m:
+        return 0.0
+    out_elems = _shape_elems(m.group(1))
+    opds = _operand_names(line, "convolution")
+    if len(opds) < 2:
+        return 0.0
+    rdims = _dims_of(types.get(opds[1]))
+    dm = re.search(r"dim_labels=\S*?_(\S*?)->", line)
+    i_dim = 1
+    if dm and rdims:
+        pos = dm.group(1).find("i")
+        if 0 <= pos < len(rdims):
+            i_dim = rdims[pos]
+    win = 1
+    wm = re.search(r"window=\{size=([0-9x]+)", line)
+    if wm:
+        for d in wm.group(1).split("x"):
+            win *= int(d)
+    elif dm and rdims:
+        for pos, ch in enumerate(dm.group(1)):
+            if ch.isdigit() and pos < len(rdims):
+                win *= rdims[pos]
+    fl = 2.0 * out_elems * i_dim * win
+    fg = re.search(r"feature_group_count=(\d+)", line)
+    if fg:
+        fl /= int(fg.group(1)) or 1
+    return fl
+
+
+def _dot_flops(line: str, types: Dict[str, str]) -> float:
+    m = re.search(r"=\s+(\S+)\s+dot\(", line)
+    if not m:
+        return 0.0
+    out_elems = _shape_elems(m.group(1))
+    opds = _operand_names(line, "dot")
+    ldims = _dims_of(types.get(opds[0])) if opds else []
+    cm = re.search(r"lhs_contracting_dims=\{([0-9,]*)\}", line)
+    k = 1
+    if cm and cm.group(1) and ldims:
+        for i in (int(x) for x in cm.group(1).split(",")):
+            if i < len(ldims):
+                k *= ldims[i]
+    elif ldims:
+        k = ldims[-1]
+    return 2.0 * out_elems * k
+
+
+def _parse_computations(text: str) -> Dict[str, List[str]]:
+    """computation name -> its instruction lines (flat, in print order).
+    HLO text prints each computation as `%name (params...) -> type {`
+    ... `}` (ENTRY prefixes the entry one).  Headers are matched
+    structurally — types embed nested parens (tiling annotations like
+    T(8,128)), so a paren-balanced regex would be wrong."""
+    comps: Dict[str, List[str]] = {}
+    cur: Optional[str] = None
+    for line in text.splitlines():
+        s = line.strip()
+        if s.endswith("{") and " -> " in s and "=" not in s.split("(")[0]:
+            head = s.split("(")[0].replace("ENTRY", "").strip()
+            cur = head.lstrip("%")
+            comps[cur] = []
+            continue
+        if s.startswith("}"):
+            cur = None
+            continue
+        if cur is not None and "=" in s:
+            comps[cur].append(s)
+    return comps
+
+
+def _entry_name(text: str) -> Optional[str]:
+    m = re.search(r"ENTRY\s+%?([\w.\-]+)", text)
+    return m.group(1) if m else None
+
+
+def _types_map(comps: Dict[str, List[str]]) -> Dict[str, str]:
+    """instruction name -> its printed result type (global: HLO names
+    are unique module-wide)."""
+    types: Dict[str, str] = {}
+    for lines in comps.values():
+        for line in lines:
+            parts = line.split(" = ", 1)
+            if len(parts) != 2:
+                continue
+            name = parts[0].replace("ROOT", "").strip().lstrip("%")
+            rhs = parts[1]
+            cut = rhs.find(" ")
+            types[name] = rhs if cut < 0 else rhs[:cut] \
+                if not rhs.startswith("(") else rhs.split(")")[0] + ")"
+    return types
+
+
+def _inst_flops(line: str, comps: Dict[str, List[str]],
+                memo: Dict[str, float], types: Dict[str, str]) -> float:
+    if " convolution(" in line:
+        return _conv_flops(line, types)
+    if " dot(" in line:
+        return _dot_flops(line, types)
+    if " fusion(" in line or " call(" in line:
+        m = re.search(r"(?:calls|to_apply)=%?([\w.\-]+)", line)
+        if m:
+            return _comp_flops(m.group(1), comps, memo, types)
+    return 0.0
+
+
+def _comp_flops(name: str, comps: Dict[str, List[str]],
+                memo: Dict[str, float], types: Dict[str, str]) -> float:
+    if name in memo:
+        return memo[name]
+    memo[name] = 0.0  # cycle guard
+    total = 0.0
+    for line in comps.get(name, ()):
+        total += _inst_flops(line, comps, memo, types)
+    memo[name] = total
+    return total
+
+
+def schedule_overlap_from_text(text: str,
+                               achieved_flops: float,
+                               ici_GBps: float = 45.0,
+                               n_devices: int = 8) -> Dict:
+    """Walk the scheduled entry computation; for each async all-reduce
+    pair, accumulate the FLOPs of instructions scheduled in-flight."""
+    comps = _parse_computations(text)
+    entry = _entry_name(text)
+    if entry is None or entry not in comps:
+        return {"error": "no ENTRY computation in HLO text"}
+    memo: Dict[str, float] = {}
+    types = _types_map(comps)
+    open_pairs: Dict[str, Dict] = {}
+    pairs: List[Dict] = []
+    sync_bytes = 0.0
+
+    def _bytes_in(s: str) -> float:
+        total = 0
+        for m in _SHAPE.finditer(s):
+            n = 1
+            if m.group(2):
+                for d in m.group(2).split(","):
+                    n *= int(d)
+            total += n * {"f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+                          "s32": 4, "u32": 4, "s8": 1, "u8": 1,
+                          "pred": 1}.get(m.group(1), 4)
+        return float(total)
+
+    for line in comps[entry]:
+        if " all-reduce-start(" in line:
+            name = line.split("=")[0].strip().lstrip("%")
+            lhs = line.split(" all-reduce-start(")[0]
+            open_pairs[name] = {"bytes": _bytes_in(lhs),
+                                "hidden_flops": 0.0}
+            continue
+        dm = re.search(r"all-reduce-done\(\s*%?([\w.\-]+)", line)
+        if dm:
+            rec = open_pairs.pop(dm.group(1), None)
+            if rec is not None:
+                pairs.append(rec)
+            continue
+        if " all-reduce(" in line:
+            sync_bytes += _bytes_in(line.split(" all-reduce(")[0])
+            continue
+        if open_pairs:
+            fl = _inst_flops(line, comps, memo, types)
+            if fl:
+                for rec in open_pairs.values():
+                    rec["hidden_flops"] += fl
+
+    # ring all-reduce moves 2(n-1)/n of the payload over the link
+    ring = 2.0 * (n_devices - 1) / n_devices
+    t_comm_total, t_hidden_total = 0.0, 0.0
+    for rec in pairs:
+        t_comm = ring * rec["bytes"] / (ici_GBps * 1e9)
+        t_hide = rec["hidden_flops"] / achieved_flops
+        t_comm_total += t_comm
+        t_hidden_total += min(t_comm, t_hide)
+    sync_t = ring * sync_bytes / (ici_GBps * 1e9)
+    t_comm_total += sync_t  # sync collectives hide nothing
+    overlap = (t_hidden_total / t_comm_total) if t_comm_total else None
+    total_flops = _comp_flops(entry, comps, memo, types)
+    return {
+        "n_async_pairs": len(pairs),
+        "n_sync_allreduce_bytes": int(sync_bytes),
+        "async_bytes": int(sum(r["bytes"] for r in pairs)),
+        "hidden_flops": sum(r["hidden_flops"] for r in pairs),
+        "program_flops_parsed": total_flops,
+        "achieved_flops_rate": achieved_flops,
+        "ici_GBps_assumed": ici_GBps,
+        "overlap_measured": round(overlap, 4) if overlap is not None
+        else None,
+        "method": "scheduled-HLO walk: flops of instructions between "
+                  "all-reduce-start/done over ring comm time",
+    }
+
+
+def measure_overlap(achieved_flops: float = 54e12,
+                    ici_GBps: float = 45.0,
+                    topology: str = "v5e:2x4",
+                    classes: int = 16,
+                    batch: int = 64) -> Dict:
+    """AOT-compile the dryrun's FusedTrainStep against an abstract TPU
+    topology and measure schedule overlap.  Raises if the TPU compiler
+    is unavailable (caller falls back to a cached measurement)."""
+    import numpy as np
+
+    import jax
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.dp import FusedTrainStep
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=topology)
+    devs = list(topo.devices)
+    n = len(devs)
+    mesh = Mesh(np.array(devs).reshape(n), ("dp",))
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = vision.resnet18_v1(classes=classes)
+    net.initialize(mx.init.Xavier())
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, learning_rate=0.05, momentum=0.9)
+    X = nd.random.uniform(shape=(batch, 3, 32, 32))
+    y = nd.array(np.random.randint(0, classes, batch).astype("float32"))
+    compiled = step.lower_only(X, y).compile()
+    text = compiled.as_text()
+    out = schedule_overlap_from_text(text, achieved_flops,
+                                     ici_GBps=ici_GBps, n_devices=n)
+    out["topology"] = topology
+    out["model"] = "resnet18_v1 dp=%d (the dryrun program)" % n
+    return out

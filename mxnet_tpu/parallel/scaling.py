@@ -299,13 +299,17 @@ def resnet50_grad_bytes(dtype_bytes: int = 4) -> int:
 def project_efficiency(grad_bytes: int, step_time_s: float,
                        chips: Sequence[int] = (8, 16, 32, 64, 128, 256),
                        ici_GBps: float = 45.0,
-                       overlap: float = 0.7) -> Dict:
+                       overlap: float = 0.7,
+                       overlap_source: str = "assumed") -> Dict:
     """Ring-allreduce cost model -> projected scaling efficiency.
 
     t_comm(n) = 2(n-1)/n * grad_bytes / (ici_GBps GB/s); the exposed
-    part is (1-overlap) of it (XLA schedules the psum inside backward).
-    eff(n) = t_step / (t_step + exposed(n)).  Assumptions are returned
-    with the numbers."""
+    part is (1-overlap) of it.  ``overlap`` should come from
+    parallel/overlap.py's scheduled-HLO measurement whenever available
+    (overlap_source='measured (scheduled HLO)'); the r4 default of 0.7
+    was an assumption, and the measured schedule emits the combined
+    gradient all-reduce as a SYNC op — overlap 0.  Assumptions are
+    returned with the numbers."""
     table = {}
     for n in chips:
         t_comm = 2.0 * (n - 1) / n * grad_bytes / (ici_GBps * 1e9)
@@ -317,7 +321,8 @@ def project_efficiency(grad_bytes: int, step_time_s: float,
         "grad_bytes": grad_bytes,
         "step_time_s": step_time_s,
         "ici_GBps_assumed": ici_GBps,
-        "overlap_assumed": overlap,
+        "overlap": overlap,
+        "overlap_source": overlap_source,
         "projected_efficiency": table,
         "reference_resnet152_256gpu": 0.901,
     }

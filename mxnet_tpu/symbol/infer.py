@@ -153,8 +153,107 @@ def _custom_rule(p, s):
             for i, sh in enumerate(inferred[0]) if sh is not None}
 
 
+def _native_rule(p, s):
+    """Legacy _Native/_NDArray nodes: shapes from the live prop named
+    by the info token (mirrors _custom_rule, which keys on op_type)."""
+    from .. import operator as _operator
+
+    prop_cls = _operator._REGISTRY.get(p.get("info"))
+    if prop_cls is None:
+        return {}
+    try:
+        prop = prop_cls()
+        names = list(prop.list_arguments())
+        in_shapes = [list(s[n]) if s.get(n) else None for n in names]
+        inferred = prop.infer_shape(in_shapes)
+    except Exception:
+        return {}
+    return {n: tuple(sh) for n, sh in zip(names, inferred[0])
+            if sh is not None}
+
+
+def _caffe_rule(p, s):
+    """Weight shapes from the layer spec + data shape (the reference
+    asks a live caffe LayerSetUp; ref: plugin/caffe/caffe_op-inl.h:269
+    InferShape)."""
+    from ..ops.plugin import _as_pair, parse_layer
+
+    data = s.get("data_0")
+    if data is None:
+        return {}
+    layer = parse_layer(p.get("prototxt", "layer{}"))
+    t = layer.get("type", "")
+    if t == "InnerProduct":
+        n = int(layer.get("inner_product_param", {}).get("num_output", 0))
+        return {"0_weight": (n, _prod(data[1:])), "1_bias": (n,)}
+    if t == "Convolution":
+        cp = layer.get("convolution_param", {})
+        n = int(cp.get("num_output", 0))
+        kh, kw = _as_pair(cp.get("kernel_size"), 1) \
+            if "kernel_size" in cp else (int(cp.get("kernel_h", 1)),
+                                         int(cp.get("kernel_w", 1)))
+        g = int(cp.get("group", 1))
+        return {"0_weight": (n, data[1] // g, kh, kw), "1_bias": (n,)}
+    return {}
+
+
+def _caffe_loss_rule(p, s):
+    from ..ops.plugin import parse_layer
+
+    data = s.get("data")
+    if data is None:
+        return {}
+    t = parse_layer(p.get("prototxt", "layer{}")).get("type", "")
+    if t == "SoftmaxWithLoss":
+        return {"label": (data[0],)}
+    return {"label": tuple(data)}  # element-wise losses match data
+
+
+def _torch_rule(p, s):
+    from ..ops.plugin import _parse_lua
+
+    name, args = _parse_lua(p.get("lua_string", ""))
+    if name == "Linear" and len(args) >= 2:
+        i, o = int(args[0]), int(args[1])
+        return {"weight": (o, i), "bias": (o,)}
+    return {}
+
+
+def _torch_crit_rule(p, s):
+    from ..ops.plugin import _parse_lua
+
+    data = s.get("data")
+    if data is None:
+        return {}
+    try:
+        name, _args = _parse_lua(p.get("lua_string", ""))
+    except ValueError:
+        return {}
+    if name == "ClassNLLCriterion":
+        return {"label": (data[0],)}
+    return {"label": tuple(data)}
+
+
+def _warpctc_rule(p, s):
+    data = s.get("data")
+    if data is None:
+        return {}
+    t = int(p.get("input_length", 0))
+    l = int(p.get("label_length", 0))
+    if not t or not l:
+        return {}
+    return {"label": ((data[0] // t) * l,)}
+
+
 PARAM_SHAPE_RULES = {
     "Custom": _custom_rule,
+    "_Native": _native_rule,
+    "_NDArray": _native_rule,
+    "CaffeOp": _caffe_rule,
+    "CaffeLoss": _caffe_loss_rule,
+    "TorchModule": _torch_rule,
+    "TorchCriterion": _torch_crit_rule,
+    "WarpCTC": _warpctc_rule,
     "FullyConnected": _fc_rule,
     "Convolution": _conv_rule,
     "Convolution_v1": _conv_rule,
@@ -202,7 +301,10 @@ def _infer_walk(symbol, known_shapes: Dict[str, Tuple[int, ...]],
 
         op = _op_registry.get(node.op)
         params = {k: v for k, v in node.attrs.items() if not k.startswith("__")}
-        in_names = op.input_names or tuple("arg%d" % i for i in range(len(node.inputs)))
+        dyn = getattr(op, "dyn_input_names", None)
+        in_names = op.input_names or (
+            tuple(dyn(params)) if dyn is not None
+            else tuple("arg%d" % i for i in range(len(node.inputs))))
 
         # map known input shapes by name; run the param rule for unknown or
         # partially-known (0-dim, the deferred-init marker) shapes

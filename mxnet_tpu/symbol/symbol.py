@@ -380,6 +380,12 @@ def create(op_name: str, *args, name: Optional[str] = None, **kwargs) -> Symbol:
 
     consumed = 0
     input_names = op.input_names or tuple("arg%d" % i for i in range(len(pos_syms)))
+    dyn_named = getattr(op, "dyn_input_names", None) is not None
+    if dyn_named:
+        # param-dependent arity (CaffeOp, TorchModule): names come from
+        # the non-symbol kwargs, so data_0=... kwargs bind as inputs
+        input_names = tuple(op.dyn_input_names(
+            {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}))
     custom_named = op_name == "Custom" and "op_type" in kwargs
     if custom_named:
         # a Custom op's inputs come from its prop's list_arguments —
@@ -392,7 +398,7 @@ def create(op_name: str, *args, name: Optional[str] = None, **kwargs) -> Symbol:
                 {k: v for k, v in kwargs.items()
                  if k != "op_type" and not isinstance(v, Symbol)}))
         input_names = tuple(prop.list_arguments())
-    if op.input_names or custom_named:
+    if op.input_names or custom_named or dyn_named:
         for iname in input_names:
             if consumed < len(pos_syms):
                 sym_inputs.append(pos_syms[consumed]._entries[0])

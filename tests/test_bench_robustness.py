@@ -88,6 +88,47 @@ bench._emit_final()
     assert out["value"] == 0.0  # an honest failure, not a wrong model
 
 
+def test_watchdog_exits_rc0_while_main_thread_blocked():
+    """Round-5 contract: the watchdog thread bounds TOTAL wall clock,
+    emitting the cumulative JSON and exiting rc=0 even while the main
+    thread is stuck in a blocking call (r4's failure mode: phase gates
+    guard entry only, so one slow compile overran the driver window)."""
+    code = """
+import time
+import bench
+bench._STATE["table"].append({"model": "resnet50_v1",
+                              "images_per_sec_per_chip": 1111.0})
+bench._install_watchdog(1.0)
+time.sleep(60)  # stand-in for a compile the main thread can't escape
+"""
+    proc = _run(code, timeout=30)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    out = json.loads([ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert out["value"] == 1111.0
+    assert "deadline" in out["truncated"]
+
+
+def test_phase_order_fit_and_memory_before_io_and_bare():
+    """The rows the driver has never captured (fit, memory) must run
+    before the rows it has (io, bare, sweep) — pinned at source level so
+    a refactor can't silently demote them again."""
+    src = open(os.path.join(HERE, "bench.py")).read()
+    i_fit = src.index("phase 2: Module.fit")
+    i_mem = src.index("phase 3: remat memory")
+    i_io = src.index("phase 4: decomposed IO")
+    i_bare = src.index("phase 5: bare-JAX")
+    assert i_fit < i_mem < i_io < i_bare
+
+
+def test_deadline_leaves_emit_margin():
+    src = open(os.path.join(HERE, "bench.py")).read()
+    import re
+
+    m = re.search(r"_EMIT_MARGIN_S\s*=\s*(\d+(?:\.\d+)?)", src)
+    assert m and float(m.group(1)) >= 120.0
+
+
 def test_budget_default_inside_driver_window():
     """r3 regression: the 4200 s default demonstrably exceeded the
     driver's timeout.  Pin the SOURCE default (not any env override the

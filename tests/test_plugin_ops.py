@@ -1,0 +1,160 @@
+"""Plugin creator bridges (ref: plugin/warpctc, plugin/caffe,
+plugin/torch) — functional checks:
+
+* the caffe_net.py MLP composition (example/caffe/caffe_net.py:28-35)
+  binds, trains, and reaches >0.9 accuracy on a separable problem;
+* caffe Pooling keeps caffe's ceil-mode output shapes
+  (pooling_layer.cpp), which FLOOR-mode frameworks get wrong;
+* WarpCTC's backward equals finite differences of the summed CTC cost
+  (warpctc-inl.h:208 compute_ctc_loss contract: in_grad = dcost/dact,
+  out_grad ignored);
+* TorchModule/TorchCriterion match REAL pytorch (an independent oracle
+  for the lua-subset semantics, incl. ClassNLL's 1-based labels).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _train_caffe_mlp():
+    rng = np.random.RandomState(0)
+    n, d = 256, 8
+    X = rng.randn(n, d).astype("float32")
+    w_true = rng.randn(d, 3).astype("float32")
+    y = np.argmax(X @ w_true, axis=1).astype("float32")
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.CaffeOp(data_0=data, num_weight=2, name="fc1",
+                         prototxt='layer{type:"InnerProduct" '
+                                  'inner_product_param{num_output: 32} }')
+    act1 = mx.sym.CaffeOp(data_0=fc1, prototxt='layer{type:"TanH"}')
+    fc2 = mx.sym.CaffeOp(data_0=act1, num_weight=2, name="fc2",
+                         prototxt='layer{type:"InnerProduct" '
+                                  'inner_product_param{num_output: 3}}')
+    out = mx.sym.CaffeLoss(data=fc2, label=mx.sym.Variable("label"),
+                           grad_scale=1, name="softmax",
+                           prototxt='layer{type:"SoftmaxWithLoss"}')
+
+    mod = mx.mod.Module(out, data_names=("data",), label_names=("label",))
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="label")
+    mod.fit(it, num_epoch=30, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5),),
+            initializer=mx.init.Xavier())
+    it.reset()
+    preds = mod.predict(it).asnumpy()
+    return float((np.argmax(preds, axis=1) == y).mean())
+
+
+def test_caffe_mlp_trains():
+    acc = _train_caffe_mlp()
+    assert acc > 0.9, "caffe-bridge MLP stuck at %.3f" % acc
+
+
+def test_caffe_pooling_ceil_mode():
+    # caffe: out = ceil((H + 2p - k) / s) + 1  ->  H=5,k=2,s=2 gives 3
+    # (floor-mode frameworks give 2)
+    x = mx.sym.Variable("x")
+    pool = mx.sym.CaffeOp(
+        data_0=x, prototxt='layer{type:"Pooling" pooling_param '
+                           '{ pool: MAX kernel_size: 2 stride: 2}}')
+    _, out_shapes, _ = pool.infer_shape(x=(1, 1, 5, 5))
+    assert out_shapes[0] == (1, 1, 3, 3)
+    ex = pool.bind(mx.cpu(), {"x": mx.nd.array(
+        np.arange(25, dtype="float32").reshape(1, 1, 5, 5))})
+    got = ex.forward()[0].asnumpy()
+    expect = np.array([[6., 8., 9.], [16., 18., 19.], [21., 23., 24.]],
+                      dtype="float32")
+    np.testing.assert_allclose(got[0, 0], expect)
+
+
+def test_warpctc_forward_softmax_and_grad():
+    rng = np.random.RandomState(1)
+    T, N, A, L = 6, 2, 5, 2
+    acts = rng.randn(T * N, A).astype("float32")
+    labels = np.array([1, 2, 3, 0], dtype="float32")  # blank-0 padded
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.WarpCTC(data=data, label=label, label_length=L,
+                         input_length=T)
+    args = {"data": mx.nd.array(acts), "label": mx.nd.array(labels)}
+    grads = {"data": mx.nd.zeros((T * N, A)),
+             "label": mx.nd.zeros((N * L,))}
+    ex = sym.bind(mx.cpu(), args, args_grad=grads,
+                  grad_req={"data": "write", "label": "null"})
+    out = ex.forward(is_train=True)[0].asnumpy()
+    # forward = row softmax (warpctc-inl.h:95)
+    e = np.exp(acts - acts.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(out, e / e.sum(axis=1, keepdims=True),
+                               rtol=1e-5, atol=1e-6)
+
+    ex.backward([mx.nd.ones((T * N, A))])
+    got = ex.grad_dict["data"].asnumpy()
+
+    # finite differences of the summed CTC cost
+    from mxnet_tpu.ops.contrib import _ctc_loss
+
+    def cost(a):
+        import jax.numpy as jnp
+
+        act = jnp.asarray(a.reshape(T, N, A), dtype=jnp.float32)
+        lab = jnp.asarray(labels.reshape(N, L))
+        return float(np.sum(np.asarray(
+            _ctc_loss(act, lab, blank_label="first"))))
+
+    eps = 1e-3
+    for idx in [(0, 0), (3, 2), (11, 4)]:
+        ap = acts.copy()
+        ap[idx] += eps
+        am = acts.copy()
+        am[idx] -= eps
+        fd = (cost(ap) - cost(am)) / (2 * eps)
+        assert abs(fd - got[idx]) < 5e-3, (idx, fd, got[idx])
+
+
+@pytest.mark.skipif(not pytest.importorskip("torch"), reason="no torch")
+def test_torch_bridge_matches_pytorch():
+    import torch as th
+
+    rng = np.random.RandomState(2)
+    B, D, C = 4, 6, 3
+    x = rng.randn(B, D).astype("float32")
+    w = rng.randn(C, D).astype("float32")
+    b = rng.randn(C).astype("float32")
+    y = np.array([1, 0, 2, 1], dtype="float32")  # 0-based; lua adds 1
+
+    xs = mx.sym.Variable("x")
+    lin = mx.sym.TorchModule(data_0=xs, lua_string="nn.Linear(%d, %d)"
+                             % (D, C), num_data=1, num_params=2,
+                             num_outputs=1, name="lin")
+    lsm = mx.sym.TorchModule(data_0=lin, lua_string="nn.LogSoftMax()",
+                             num_data=1, num_params=0, num_outputs=1)
+    crit = mx.sym.TorchCriterion(data=lsm, label=mx.sym.Variable("lab"),
+                                 lua_string="nn.ClassNLLCriterion()")
+    args = {"x": mx.nd.array(x), "lin_weight": mx.nd.array(w),
+            "lin_bias": mx.nd.array(b), "lab": mx.nd.array(y + 1.0)}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+    ex = crit.bind(mx.cpu(), args, args_grad=grads,
+                   grad_req={"x": "write", "lin_weight": "write",
+                             "lin_bias": "write", "lab": "null"})
+    loss = ex.forward(is_train=True)[0].asnumpy()
+
+    tx = th.tensor(x, requires_grad=True)
+    tw = th.tensor(w, requires_grad=True)
+    tb = th.tensor(b, requires_grad=True)
+    tloss = th.nn.functional.nll_loss(
+        th.log_softmax(th.nn.functional.linear(tx, tw, tb), dim=1),
+        th.tensor(y.astype("int64")))
+    np.testing.assert_allclose(loss, [tloss.item()], rtol=1e-5, atol=1e-6)
+
+    ex.backward([mx.nd.ones((1,))])
+    tloss.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                               tx.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict["lin_weight"].asnumpy(),
+                               tw.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict["lin_bias"].asnumpy(),
+                               tb.grad.numpy(), rtol=1e-4, atol=1e-5)

@@ -328,20 +328,21 @@ def test_reference_ssd_train_unmodified(tmp_path):
 @pytest.mark.slow
 def test_reference_ssd_evaluate_map(tmp_path):
     """The reference's OWN evaluation path end-to-end (VERDICT r3 item
-    9): train.py byte-identical long enough for real detections
-    (single bright class, 128px, lr 0.002 with the script's own
-    step-decay schedule — sweep-validated: constant lr either leaves
-    every anchor background by 40 epochs or diverges to NaN by 80;
-    the scheduled run reaches mAP ~0.58), then evaluate.py
-    byte-identical — DetRecordIter, NMS decode, VOC07MApMetric —
-    asserting mAP above chance.  Train-set eval, disclosed: with 32
-    images the claim is that the train->checkpoint->evaluate pipeline
-    discriminates, not generalization (the reference's own README
-    trains days on VOC from a pretrained backbone for its 77.8 mAP)."""
+    9, held-out split per VERDICT r4 item 8): train.py byte-identical
+    long enough for real detections (single bright class, 128px, lr
+    0.002 with the script's own step-decay schedule — sweep-validated:
+    constant lr either leaves every anchor background by 40 epochs or
+    diverges to NaN by 80), then evaluate.py byte-identical —
+    DetRecordIter, NMS decode, VOC07MApMetric — TWICE: on the train rec
+    (pipeline-discriminates bar, as r4) and on a FRESH same-distribution
+    rec the detector never saw (generalization bar).  Both mAPs are
+    printed for the record."""
     import re
 
     rec = str(tmp_path / "train.rec")
     _write_ssd_rec(rec, 32, seed=0, classes=1)
+    heldout = str(tmp_path / "heldout.rec")
+    _write_ssd_rec(heldout, 32, seed=1, classes=1)
     (tmp_path / "model").mkdir()
     end_epoch = 60
     code = (
@@ -364,29 +365,37 @@ def test_reference_ssd_evaluate_map(tmp_path):
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-4000:]
 
-    eval_code = (
-        _SSD_ALIAS_PREAMBLE +
-        "sys.path.insert(0, %r)\n"
-        "sys.argv = ['evaluate.py', '--cpu', '--rec-path', %r,\n"
-        "  '--network', 'resnet50', '--data-shape', '128',\n"
-        "  '--batch-size', '8', '--num-class', '1', '--class-names',\n"
-        "  'a', '--prefix', %r, '--epoch', '%d']\n"
-        "runpy.run_path(%r, run_name='__main__')\n"
-        % (os.path.join(REFERENCE, "example", "ssd"), rec,
-           str(tmp_path / "model" / "ssd_resnet50"), end_epoch,
-           os.path.join(REFERENCE, "example", "ssd", "evaluate.py")))
-    proc = subprocess.run([sys.executable, "-c", eval_code],
-                          cwd=str(tmp_path), env=_env(),
-                          capture_output=True, text=True, timeout=900)
-    out = proc.stdout + proc.stderr
-    assert proc.returncode == 0, out[-4000:]
-    m = re.search(r"mAP: ([\d.naife]+)", out)
-    assert m, out[-2000:]
-    map_val = float(m.group(1))
-    assert np.isfinite(map_val), out[-1000:]
-    # chance for random boxes at 0.5 IoU on this set is ~0; the VOC07
-    # machinery must see real true positives from the trained detector
-    assert map_val > 0.02, (map_val, out[-1500:])
+    def _evaluate(rec_path):
+        eval_code = (
+            _SSD_ALIAS_PREAMBLE +
+            "sys.path.insert(0, %r)\n"
+            "sys.argv = ['evaluate.py', '--cpu', '--rec-path', %r,\n"
+            "  '--network', 'resnet50', '--data-shape', '128',\n"
+            "  '--batch-size', '8', '--num-class', '1', '--class-names',\n"
+            "  'a', '--prefix', %r, '--epoch', '%d']\n"
+            "runpy.run_path(%r, run_name='__main__')\n"
+            % (os.path.join(REFERENCE, "example", "ssd"), rec_path,
+               str(tmp_path / "model" / "ssd_resnet50"), end_epoch,
+               os.path.join(REFERENCE, "example", "ssd", "evaluate.py")))
+        proc = subprocess.run([sys.executable, "-c", eval_code],
+                              cwd=str(tmp_path), env=_env(),
+                              capture_output=True, text=True, timeout=900)
+        eout = proc.stdout + proc.stderr
+        assert proc.returncode == 0, eout[-4000:]
+        m = re.search(r"mAP: ([\d.naife]+)", eout)
+        assert m, eout[-2000:]
+        map_val = float(m.group(1))
+        assert np.isfinite(map_val), eout[-1000:]
+        return map_val, eout
+
+    map_train, train_eval_log = _evaluate(rec)
+    map_heldout, _ = _evaluate(heldout)
+    print("SSD_MAP train=%.4f heldout=%.4f" % (map_train, map_heldout))
+    # chance for random boxes at 0.5 IoU is ~0; the VOC07 machinery must
+    # see real true positives BOTH on the train set (pipeline
+    # discriminates) and on images the detector never saw (generalizes)
+    assert map_train > 0.02, (map_train, train_eval_log[-1500:])
+    assert map_heldout > 0.02, (map_train, map_heldout)
 
 
 @pytest.mark.slow

@@ -37,13 +37,18 @@ def _as_list(v):
 
 def _kernel_pair(param, base, default=0):
     """caffe allows kernel_size or kernel_h/kernel_w (same for stride,
-    pad)."""
+    pad); repeated keys (legal protobuf text for per-dim values) parse
+    to lists — h then w."""
+
+    def _pair(v):
+        if isinstance(v, list):
+            return (int(v[0]), int(v[1]) if len(v) > 1 else int(v[0]))
+        return (int(v), int(v))
+
     if base + "_size" in param:
-        k = int(param[base + "_size"])
-        return (k, k)
+        return _pair(param[base + "_size"])
     if base in param:  # stride / pad spelled bare
-        k = int(param[base])
-        return (k, k)
+        return _pair(param[base])
     h = int(param.get(base + "_h", default))
     w = int(param.get(base + "_w", default))
     return (h, w)
@@ -144,9 +149,18 @@ def convert_symbol(prototxt_text):
             tops[t] = out
         tops[name] = out
 
-    last = layers[-1]
-    last_top = _as_list(last.get("top"))
-    sym = tops[(last_top or [last["name"]])[0]]
+    # the network output is the last CONVERTED layer's top: a train-
+    # style prototxt may end in eval-only layers (Accuracy) that were
+    # skipped above and never populated `tops`
+    sym = None
+    for layer in reversed(layers):
+        key = (_as_list(layer.get("top")) or [layer.get("name")])[0]
+        if key in tops:
+            sym = tops[key]
+            break
+    if sym is None:
+        raise ValueError("prototxt has no convertible output layer "
+                         "(only eval-only layers found)")
     return sym, input_name or "data"
 
 
