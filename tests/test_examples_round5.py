@@ -13,12 +13,20 @@ no reference file is touched).
   synthetic rt-polarity files with a separable vocabulary.
 * vae: VAE.py imported byte-identical; ELBO falls on synthetic binary
   digits.
-* fcn-xs: symbol_fcnxs.py imported byte-identical (FCN-32s head —
-  Deconvolution upsampling + Crop); trains on synthetic 2-class
-  segmentation until pixel accuracy beats the majority class.
+* fcn-xs: symbol_fcnxs.py imported byte-identical (FCN-8s — three
+  Deconvolution stages, Crop, pool4/pool3 skips); heads train with the
+  trunk fixed until per-pixel CE is well under the uniform floor.
 * dqn: base.py + operators.py imported byte-identical (Base executor
   wrapper, DQNOutput custom op); qnet.copy() + copy_params_to drive the
   target-network parameter-copy path on a tiny numpy MDP.
+* bi-lstm-sort: lstm.bi_lstm_unroll + sort_io.BucketSentenceIter +
+  lstm_sort.Perplexity byte-identical; perplexity dives under the
+  uniform-vocab floor on the sort task.
+* stochastic-depth: sd_mnist.py run byte-identical from a verbatim
+  copy (StochasticDepthModule — a user BaseModule subclass with random
+  train-time block skipping — inside SequentialModule.fit).
+* warpctc: see tests/warpctc_runner.py (the toy OCR task through
+  mx.sym.WarpCTC).
 """
 import json
 import os
@@ -201,14 +209,19 @@ def test_reference_vae_unmodified(tmp_path):
 def test_reference_fcnxs_symbol_trains(tmp_path):
     """FCN-8s from symbol_fcnxs.py byte-identical — full VGG16 trunk,
     three Deconvolution upsampling stages, three Crop ops, pool4/pool3
-    skip fusions, multi-output SoftmaxOutput — trains end-to-end on
-    synthetic 2-class blobs until per-pixel cross-entropy drops well
-    below the ln(2)=0.693 uniform floor.  Scope disclosed: the
-    reference's own workflow REQUIRES a pretrained VGG16 checkpoint
-    (fcn_xs.py --init-type vgg16, README step 2 downloads it); from
-    random init at CI scale the 13-conv trunk learns the class prior
-    but not localization, so the bar here is the loss-level proof that
-    gradients flow through every deconv/crop/skip stage."""
+    skip fusions, multi-output SoftmaxOutput — trained on synthetic
+    2-class blobs with the trunk FIXED and every score/deconv head
+    learning (Module fixed_param_names), mirroring the reference's own
+    staged workflow where the trunk comes pretrained (fcn_xs.py
+    --init-type vgg16; its README downloads the VGG16 checkpoint —
+    unavailable offline, and from random init the 13-conv trunk
+    either sits at the uniform point (lr<=1e-5) or NaNs (lr>=1e-3)
+    under the reference's unnormalized per-pixel gradients, which is
+    why its solver uses lr 1e-10 on pretrained weights).  The bar:
+    per-pixel cross-entropy falls monotonically well below the
+    ln(2)=0.693 uniform floor (measured 0.692 -> 0.370 over 20
+    epochs), with gradients flowing through every deconv/crop/skip
+    stage into the trainable heads."""
     code = """
 import numpy as np
 import mxnet as mx
@@ -226,7 +239,15 @@ for i in range(n):
     X[i, :, y0:y0+16, x0:x0+16] += 0.7
     Y[i, y0:y0+16, x0:x0+16] = 1
 sym = symbol_fcnxs.get_fcn8s_symbol(numclass=classes, workspace_default=128)
-mod = mx.mod.Module(sym, data_names=('data',), label_names=('softmax_label',))
+args = sym.list_arguments()
+heads = [a for a in args if a.startswith(('score', 'bigscore',
+                                          'upsampling'))]
+fixed = [a for a in args if a not in heads
+         and a not in ('data', 'softmax_label')]
+assert len(heads) >= 8, heads   # all three score stages + deconvs
+mod = mx.mod.Module(sym, data_names=('data',),
+                    label_names=('softmax_label',),
+                    fixed_param_names=fixed)
 it = mx.io.NDArrayIter(X, Y.reshape(n, -1), batch_size=4,
                        label_name='softmax_label')
 
@@ -235,25 +256,134 @@ def pixel_ce():
     it.reset()
     pred = mod.predict(it).asnumpy()     # (n, classes, H, W) softmax
     p_true = np.where(Y == 1, pred[:, 1], pred[:, 0])
+    it.reset()
     return float(-np.log(np.clip(p_true, 1e-9, 1)).mean())
 
 
 mod.fit(it, num_epoch=1, optimizer='sgd',
-        optimizer_params=(('learning_rate', 0.2), ('momentum', 0.9)),
+        optimizer_params=(('learning_rate', 1e-4), ('momentum', 0.9)),
         initializer=mx.init.Xavier())
 ce0 = pixel_ce()
-it.reset()
-mod.fit(it, num_epoch=9, optimizer='sgd',
-        optimizer_params=(('learning_rate', 0.2), ('momentum', 0.9)))
+mod.fit(it, num_epoch=19, optimizer='sgd',
+        optimizer_params=(('learning_rate', 1e-4), ('momentum', 0.9)))
 ce1 = pixel_ce()
 print('FCN_CE', ce0, '->', ce1)
 assert np.isfinite(ce1), ce1
 assert ce1 < 0.45, (ce0, ce1)  # well under the 0.693 uniform floor
+assert ce1 < ce0 - 0.1, (ce0, ce1)
 print('FCN_OK')
 """
     out = _run_code(code, str(tmp_path), extra_path=[
         os.path.join(REFERENCE, "example", "fcn-xs")], timeout=3000)
     assert "FCN_OK" in out, out[-2000:]
+
+
+# ----------------------------------------------------- bi-lstm-sort
+@pytest.mark.slow
+def test_reference_bi_lstm_sort(tmp_path):
+    """example/bi-lstm-sort: the reference's bidirectional LSTM
+    seq2seq sorter — lstm.bi_lstm_unroll, sort_io.BucketSentenceIter
+    (labels are the SORTED input sequence) and lstm_sort.Perplexity
+    imported byte-identical; the driver shrinks scale only (its main
+    trains hidden=300/embed=512 on a million generated lines).  The
+    model must drive perplexity far below the uniform-vocab floor."""
+    code = """
+import numpy as np
+# sort_io.py:204 divides a length with py2 `/` and feeds the float to
+# np.zeros; restore the py2 tolerance process-locally (the np.int-alias
+# pattern — no reference file touched)
+_np_zeros = np.zeros
+
+
+def _zeros_py2(shape, *a, **k):
+    if isinstance(shape, float):
+        shape = int(shape)
+    return _np_zeros(shape, *a, **k)
+
+
+np.zeros = _zeros_py2
+import random
+import mxnet as mx
+from lstm import bi_lstm_unroll
+from sort_io import BucketSentenceIter, default_build_vocab
+from lstm_sort import Perplexity
+
+random.seed(7)
+np.random.seed(7)
+mx.random.seed(7)
+SEQ, VLOW, VHIGH = 5, 100, 120   # 20-symbol vocabulary
+with open('sort.train.txt', 'w') as ftr, open('sort.valid.txt', 'w') as fv:
+    for i in range(4000):
+        seq = " ".join(str(random.randint(VLOW, VHIGH - 1))
+                       for _ in range(SEQ))
+        (fv if i % 20 == 0 else ftr).write(seq + "\\n")
+vocab = default_build_vocab('sort.train.txt')
+NH, NE, B = 32, 16, 50
+init_states = [('l%d_init_%s' % (l, s), (B, NH))
+               for l in range(2) for s in ('c', 'h')]
+train = BucketSentenceIter('sort.train.txt', vocab, [SEQ], B, init_states)
+val = BucketSentenceIter('sort.valid.txt', vocab, [SEQ], B, init_states)
+sym = bi_lstm_unroll(SEQ, len(vocab), num_hidden=NH, num_embed=NE,
+                     num_label=len(vocab))
+model = mx.model.FeedForward(ctx=[mx.cpu()], symbol=sym, num_epoch=6,
+                             learning_rate=0.05, momentum=0.9,
+                             wd=0.00001,
+                             initializer=mx.init.Normal(0.1))
+perps = []
+
+
+def cb(params):
+    for name, value in params.eval_metric.get_name_value():
+        perps.append(value)
+
+
+model.fit(X=train, eval_data=val, eval_metric=mx.metric.np(Perplexity),
+          eval_end_callback=cb)
+print('SORT_PERPS', [round(p, 2) for p in perps])
+# uniform over the 20-symbol vocab = perplexity 20; sorting is nearly
+# deterministic given the multiset, so a learning model dives well
+# below it
+assert perps[-1] < 8.0, perps
+assert perps[-1] < perps[0] * 0.6, perps
+print('SORT_OK')
+"""
+    out = _run_code(code, str(tmp_path), extra_path=[
+        os.path.join(REFERENCE, "example", "bi-lstm-sort")], timeout=3000)
+    assert "SORT_OK" in out, out[-2500:]
+
+
+# ------------------------------------------------- stochastic-depth
+@pytest.mark.slow
+def test_reference_stochastic_depth_mnist(tmp_path):
+    """example/stochastic-depth/sd_mnist.py run byte-identical from a
+    verbatim copy of the example dir (the script writes nothing, but
+    resolves its data dir relative to __file__, which is read-only
+    under /root/reference — the copy is bit-for-bit).  Exercises
+    StochasticDepthModule (a user-defined BaseModule subclass with
+    train-time random block skipping) inside SequentialModule.fit."""
+    import shutil
+
+    sd_dir = str(tmp_path / "stochastic-depth")
+    shutil.copytree(os.path.join(REFERENCE, "example", "stochastic-depth"),
+                    sd_dir)
+    # the script does sys.path.insert('..') + `from utils import
+    # get_data`: the copied parent must carry the example-level utils
+    # package (get_data.get_mnist short-circuits on existing files)
+    shutil.copytree(os.path.join(REFERENCE, "example", "utils"),
+                    str(tmp_path / "utils"))
+    from test_examples_round4 import _seed_mnist_idx
+
+    _seed_mnist_idx(os.path.join(sd_dir, "data"))
+    code = ("import runpy\n"
+            "runpy.run_path(%r, run_name='__main__')\n"
+            % os.path.join(sd_dir, "sd_mnist.py"))
+    out = _run_code(code, sd_dir, timeout=3000)
+    accs = [float(m) for m in re.findall(
+        r"Validation-accuracy=([0-9.]+)", out)]
+    assert accs, out[-2500:]
+    # bright-square synthetic digits: the 2-epoch sanity run must get
+    # well past chance (0.1)
+    assert max(accs) > 0.5, (accs, out[-1500:])
 
 
 # --------------------------------------------------------- warpctc
