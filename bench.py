@@ -985,12 +985,17 @@ def _run_model_row(spec, peak, with_flops=True, windows=3):
         if peak:
             row["hw_util_incl_padding"] = round(flops / sps / peak, 4)
     if bytes_acc:
-        # memory-bound attribution: achieved HBM draw over peak BW.
-        # (bytes accessed counts a scan body once — per-step bytes)
+        # memory-bound attribution.  XLA cost analysis counts PRE-fusion
+        # operand accesses (a scan body once), so this over-states
+        # physical traffic; a frac ABOVE 1.0 still pins the diagnosis —
+        # even perfectly-fused traffic would sit at the HBM roofline
+        # (measured 1.58 for resnet50-bf16@32: memory-bound, not MXU-
+        # bound, matching the BN-removal +35% measurement)
         row["xla_step_bytes_gb"] = round(bytes_acc / 1e9, 2)
         hbm = _peak_hbm()
         if hbm:
-            row["achieved_membw_frac"] = round(bytes_acc / sps / hbm, 3)
+            row["prefusion_bytes_over_hbm_peak"] = round(
+                bytes_acc / sps / hbm, 3)
     note = CEILING_NOTES.get((name, dtype))
     if note:
         row["ceiling_note"] = note
@@ -1065,29 +1070,43 @@ def _phase_fit(elapsed, left):
                 "fit_vs_fused_step": round(fit_ips / headline, 3)
                 if headline else None}
         else:
-            # congested-tunnel fallback: measure fit AND its fused twin
-            # at 112 in one subprocess — fit_vs_fused stays a fair
-            # same-shape ratio
-            fb = min(300.0, left() - 120.0)
-            if fb < 60:
+            # congested-tunnel fallbacks: measure fit AND its fused
+            # twin at the SAME smaller shape in one subprocess —
+            # fit_vs_fused stays a fair same-shape ratio.  112 first;
+            # 64 as the last rung (cheapest program that still answers
+            # the dispatch-overhead question)
+            vals = None
+            for img_fb in (112, 64):
+                fb = min(420.0, left() - 120.0)
+                if fb < 90:
+                    break
+                try:
+                    vals, proc = run_child(
+                        "*bench.bench_fit_with_comparator(%d)" % img_fb,
+                        "FIT2_IPS", fb)
+                except subprocess.TimeoutExpired:
+                    vals = None
+                    continue  # congestion: try the cheaper rung
+                if vals is not None and len(vals) >= 2:
+                    break
+                # a CRASH is not congestion: surface the diagnostics
+                # instead of retrying a deterministic failure
                 raise RuntimeError(
-                    "fit 224 attempts exceeded their windows "
-                    "(compile finished first try: %s) and no budget "
-                    "left for the 112 fallback (elapsed %.0fs)"
-                    % (compiled_first_try, elapsed()))
-            vals, proc = run_child(
-                "*bench.bench_fit_with_comparator(112)", "FIT2_IPS", fb)
+                    "fit %d fallback rc=%d: %s"
+                    % (img_fb, proc.returncode,
+                       (proc.stdout + proc.stderr)[-400:]))
             if vals is None or len(vals) < 2:
                 raise RuntimeError(
-                    "fit 112 fallback rc=%d: %s"
-                    % (proc.returncode, (proc.stdout + proc.stderr)[-400:]))
+                    "fit attempts at 224/112/64 all exceeded their "
+                    "windows (224 compile finished first try: %s; "
+                    "elapsed %.0fs)" % (compiled_first_try, elapsed()))
             _STATE["fit_loop"] = {
                 "pipeline": "Module.fit (bulk_size=8)",
                 "model": "resnet50_v1(sym)", "batch": 32,
-                "dtype": "float32", "img": 112,
+                "dtype": "float32", "img": img_fb,
                 "note": "224 compile exceeded its window (congested "
-                        "tunnel); fit and fused twin measured at 112 "
-                        "for a same-shape ratio",
+                        "tunnel); fit and fused twin measured at %d "
+                        "for a same-shape ratio" % img_fb,
                 "images_per_sec": round(vals[0], 2),
                 "fit_vs_fused_step": round(vals[0] / vals[1], 3)}
     except subprocess.TimeoutExpired as exc:
@@ -1218,9 +1237,9 @@ def main():
         if bf16_row:
             attr["bn_cost_frac"] = round(
                 1.0 - bf16_row["images_per_sec_per_chip"] / nobn_ips, 3)
-            if "achieved_membw_frac" in bf16_row:
-                attr["headline_achieved_membw_frac"] = \
-                    bf16_row["achieved_membw_frac"]
+            if "prefusion_bytes_over_hbm_peak" in bf16_row:
+                attr["headline_prefusion_bytes_over_hbm_peak"] = \
+                    bf16_row["prefusion_bytes_over_hbm_peak"]
         _STATE["mfu_attribution"] = attr
         _progress({"mfu_attribution": attr})
     except Exception as exc:

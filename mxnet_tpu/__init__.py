@@ -40,6 +40,7 @@ from . import recordio
 from . import image
 from . import profiler
 from . import monitor
+from . import monitor as mon  # ref: python/mxnet/__init__.py:63 alias
 from .monitor import Monitor
 from . import visualization
 from . import visualization as viz

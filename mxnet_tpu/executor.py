@@ -174,6 +174,24 @@ def build_graph_eval(symbol, collect_internals: bool = False,
     return eval_fn
 
 
+_ALLOC_ALL = None
+
+
+def _alloc_all_jit():
+    """Single jitted zero-fill over a static tuple of (shape, dtype)
+    specs — shared process-wide so identical binds hit the jit cache."""
+    global _ALLOC_ALL
+    if _ALLOC_ALL is None:
+        jax = _jax()
+        import jax.numpy as jnp
+
+        def _alloc_all(specs):
+            return tuple(jnp.zeros(s, dtype=d) for s, d in specs)
+
+        _ALLOC_ALL = jax.jit(_alloc_all, static_argnums=0)
+    return _ALLOC_ALL
+
+
 class Executor:
     """ref: python/mxnet/executor.py Executor."""
 
@@ -272,25 +290,43 @@ class Executor:
 
         jax = _jax()
 
-        def alloc(shape, actx, dt=_np.float32):
-            arr = _nd_mod.zeros(shape, ctx=actx, dtype=dt)
-            if actx is not ctx:  # placed variable: commit the buffer too
-                arr._data = jax.device_put(arr._data, actx.jax_device())
-            return arr
-
-        arg_dict: Dict[str, NDArray] = {}
-        grad_dict: Dict[str, Optional[NDArray]] = {}
+        # one consolidated zero-fill program instead of one tiny
+        # compiled program PER buffer: a resnet50 bind allocates ~320
+        # arrays, and per-array dispatch costs (compile + round-trip)
+        # dominate bind time on a remote/tunnel backend (measured: bind
+        # alone outlasted a 15-minute window on a congested link; a
+        # single fused allocation is one compile)
+        plan = []  # (kind, name, shape, dtype, actx)
         for name, shape in zip(arg_names, arg_shapes):
             if shape is None:
                 raise MXNetError("simple_bind: could not infer shape of %r" % name)
             dt = np_dtype(type_dict.get(name, _np.float32))
             actx = var_ctx.get(name, ctx)
-            arg_dict[name] = alloc(shape, actx, dt)
+            plan.append(("arg", name, tuple(shape), dt, actx))
             req = grad_req if isinstance(grad_req, str) else grad_req.get(name, "null")
-            grad_dict[name] = alloc(shape, actx, dt) if req != "null" else None
-        aux_dict = {}
+            if req != "null":
+                plan.append(("grad", name, tuple(shape), dt, actx))
         for name, shape in zip(aux_names, aux_shapes):
-            aux_dict[name] = alloc(shape, var_ctx.get(name, ctx))
+            plan.append(("aux", name, tuple(shape), _np.dtype(_np.float32),
+                         var_ctx.get(name, ctx)))
+
+        specs = tuple((p[2], _np.dtype(p[3]).name) for p in plan)
+        bufs = _alloc_all_jit()(specs)
+        arg_dict: Dict[str, NDArray] = {}
+        grad_dict: Dict[str, Optional[NDArray]] = {}
+        aux_dict: Dict[str, NDArray] = {}
+        for (kind, name, shape, dt, actx), raw in zip(plan, bufs):
+            if actx is not ctx:  # placed variable: commit the buffer too
+                raw = jax.device_put(raw, actx.jax_device())
+            cell = NDArray.from_raw(raw, actx)
+            if kind == "arg":
+                arg_dict[name] = cell
+            elif kind == "grad":
+                grad_dict[name] = cell
+            else:
+                aux_dict[name] = cell
+        for name in arg_names:
+            grad_dict.setdefault(name, None)
         # out_shapes rides along: the constructor must not re-run the
         # whole-graph inference this bind just performed
         return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
@@ -362,6 +398,47 @@ class Executor:
 
     def _aux_vals(self):
         return {k: v._data for k, v in self.aux_dict.items()}
+
+    def debug_str(self) -> str:
+        """Execution-plan dump whose tail carries the planned memory
+        total — the reference's nnvm memory-plan debug string
+        (graph_executor debug_str; example/memcost/inception_memcost.py
+        reads ``debug_str().split('\\n')[-3]`` for the
+        'Total N MB allocated' line).  The figure here is XLA's
+        compiled-program memory analysis (temp + output buffers) of the
+        program this executor would run: the fused forward+vjp step
+        when any gradient is requested, else the forward program."""
+        jax = _jax()
+        lines = ["Symbol Outputs:"]
+        lines += ["\toutput[%d]=%s" % (i, n)
+                  for i, n in enumerate(self._output_names)]
+        alloc_mb = 0
+        try:
+            # a fixed key, NOT _next_key(): a diagnostics print must not
+            # advance the global RNG stream (only shapes matter here)
+            key = _jax().random.PRNGKey(0)
+            has_grad = any(g is not None for g in self.grad_dict.values())
+            if has_grad and hasattr(self._train_step, "lower"):
+                n_out = len(self._output_names)
+                lowered = self._train_step.lower(
+                    self._arg_vals(), self._aux_vals(), key,
+                    [None] * n_out, n_out)
+            elif hasattr(self._fwd_eval, "lower"):
+                lowered = self._fwd_eval.lower(
+                    self._arg_vals(), self._aux_vals(), key)
+            else:  # placement executors run op-by-op, no single program
+                lowered = None
+            if lowered is not None:
+                ma = lowered.compile().memory_analysis()
+                if ma is not None:
+                    alloc = (getattr(ma, "temp_size_in_bytes", 0) +
+                             getattr(ma, "output_size_in_bytes", 0))
+                    alloc_mb = int(round(alloc / (1 << 20)))
+        except Exception:
+            pass  # a diagnostics string must never fail the caller
+        lines.append("Total %d MB allocated" % alloc_mb)
+        lines.append("Total 0 MB TempSpace resource requested")
+        return "\n".join(lines) + "\n"
 
     def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
         """ref: GraphExecutor::Forward (graph_executor.cc:81)."""

@@ -83,9 +83,19 @@ def _next_key():
 
 # thin imperative wrappers — full sampler op set lives in ops/random_ops.py;
 # these are re-exported through mx.nd.random / mx.random
+def _shape_from_out(shape, out):
+    """``out=`` with no explicit shape samples at OUT's shape (ref:
+    python/mxnet/random.py _random_helper — the in-place fill usage
+    initializers rely on, e.g. random.uniform(-v, v, out=arr))."""
+    if out is not None and (shape == () or shape is None):
+        return tuple(out.shape)
+    return shape
+
+
 def uniform(low=0.0, high=1.0, shape=(), dtype=None, ctx=None, out=None):
     from .ndarray import ndarray as _nd
 
+    shape = _shape_from_out(shape, out)
     return _nd.invoke("_random_uniform", [],
                       {"low": float(low), "high": float(high),
                        "shape": _shape(shape), "dtype": _dt(dtype)},
@@ -95,6 +105,7 @@ def uniform(low=0.0, high=1.0, shape=(), dtype=None, ctx=None, out=None):
 def normal(loc=0.0, scale=1.0, shape=(), dtype=None, ctx=None, out=None):
     from .ndarray import ndarray as _nd
 
+    shape = _shape_from_out(shape, out)
     return _nd.invoke("_random_normal", [],
                       {"loc": float(loc), "scale": float(scale),
                        "shape": _shape(shape), "dtype": _dt(dtype)},
@@ -102,6 +113,7 @@ def normal(loc=0.0, scale=1.0, shape=(), dtype=None, ctx=None, out=None):
 
 
 def randint(low, high, shape=(), dtype="int32", ctx=None, out=None):
+    shape = _shape_from_out(shape, out)
     from .ndarray import ndarray as _nd
 
     return _nd.invoke("_random_randint", [],

@@ -278,6 +278,35 @@ print('FCN_OK')
     assert "FCN_OK" in out, out[-2000:]
 
 
+# ---------------------------------------------------------- memcost
+@pytest.mark.slow
+def test_reference_memcost_unmodified(tmp_path):
+    """example/memcost/inception_memcost.py byte-identical: binds the
+    full Inception-BN at (32,3,224,224) and prints the planned memory
+    from Executor.debug_str() — backed here by XLA's compiled-program
+    memory analysis.  Training allocation must dwarf the
+    forward-only (grad_req='null') plan, the contrast the example
+    exists to demonstrate (its Makefile's no_optimization vs
+    forward_only targets; measured 1602 MB vs 235 MB)."""
+    script = os.path.join(REFERENCE, "example", "memcost",
+                          "inception_memcost.py")
+
+    def run(argv_tail):
+        code = ("import sys, runpy\n"
+                "sys.argv = ['inception_memcost.py'%s]\n"
+                "runpy.run_path(%r, run_name='__main__')\n"
+                % (argv_tail, script))
+        out = _run_code(code, str(tmp_path), timeout=2400)
+        m = re.search(r"Total (\d+) MB allocated", out)
+        assert m, out[-2000:]
+        return int(m.group(1))
+
+    train_mb = run("")
+    fwd_mb = run(", 'null'")
+    assert train_mb > fwd_mb * 2, (train_mb, fwd_mb)
+    assert fwd_mb > 20, (train_mb, fwd_mb)
+
+
 # ----------------------------------------------------- bi-lstm-sort
 @pytest.mark.slow
 def test_reference_bi_lstm_sort(tmp_path):
