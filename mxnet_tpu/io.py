@@ -145,6 +145,12 @@ class NDArrayIter(DataIter):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        # the raw backing arrays, mutable in place (ref io.py:663 —
+        # self-training loops overwrite labels between epochs through
+        # it, e.g. deep-embedded-clustering's refresh)
+        self.data_list = [x[1] for x in self.data] + \
+            [x[1] for x in self.label]
+        self.num_source = len(self.data_list)
         self.num_data = self.data[0][1].shape[0]
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle

@@ -278,6 +278,85 @@ print('FCN_OK')
     assert "FCN_OK" in out, out[-2000:]
 
 
+# ------------------------------------- deep-embedded-clustering
+@pytest.mark.slow
+def test_reference_dec_clustering(tmp_path):
+    """example/deep-embedded-clustering/dec.py byte-identical: DECModel
+    (whose DECLoss is a THREE-input legacy NumpyOp — the _Native
+    creator path), the autoencoder example's AutoEncoderModel/Solver/
+    extract_feature, sklearn KMeans seeding, and the self-training
+    refresh loop.  The driver pre-trains the stacked AE briefly with
+    the class's own methods and saves the checkpoint dec.py probes for
+    (dec_model_pt.arg), so setup() skips its hardcoded 150k-iteration
+    pretrain; clustering then runs on well-separated synthetic blobs
+    and must recover them almost exactly."""
+    code = """
+import numpy as np
+np.int = int
+# sklearn removed utils.linear_assignment_ (dec.py:36 imports it);
+# provide the classic scipy-backed shim process-locally
+import sys, types
+from scipy.optimize import linear_sum_assignment
+_m = types.ModuleType('sklearn.utils.linear_assignment_')
+
+
+def linear_assignment(cost):
+    r, c = linear_sum_assignment(cost)
+    return np.stack([r, c], axis=1)
+
+
+_m.linear_assignment = linear_assignment
+sys.modules['sklearn.utils.linear_assignment_'] = _m
+# fetch_mldata shim (the sklearn_data_launcher pattern): dec.py's
+# data.py import needs the 0.x name even though this driver feeds
+# synthetic X directly
+import sklearn.datasets as skd
+if not hasattr(skd, 'fetch_mldata'):
+    sys.path.insert(0, {TESTS_DIR!r})
+    from sklearn_data_launcher import fetch_mldata
+    skd.fetch_mldata = fetch_mldata
+import mxnet as mx
+import logging
+logging.basicConfig(level=logging.INFO)
+import dec
+from dec import DECModel, cluster_acc
+from autoencoder import AutoEncoderModel
+
+np.random.seed(0)
+mx.random.seed(0)
+# 4 well-separated 784-d blobs
+rng = np.random.RandomState(0)
+protos = rng.uniform(0, 1, (4, 784)) * (rng.rand(4, 784) > 0.7)
+X = np.zeros((1600, 784), 'float32')
+y = np.zeros(1600)
+for i in range(1600):
+    c = i % 4
+    X[i] = protos[c] + rng.normal(0, 0.05, 784)
+    y[i] = c
+X = np.clip(X, 0, 1).astype('float32')
+
+# brief AE pretrain via the example's own methods, saved where
+# DECModel.setup looks before launching its 150k-iteration default
+ae = AutoEncoderModel(mx.cpu(), [784, 500, 500, 2000, 10],
+                      pt_dropout=0.2)
+ae.layerwise_pretrain(X, 256, 600, 'sgd', l_rate=0.1, decay=0.0)
+ae.finetune(X, 256, 600, 'sgd', l_rate=0.1, decay=0.0)
+ae.save('dec_model_pt.arg')
+
+m = DECModel(mx.cpu(), X, 4, 1.0, 'dec_model')
+acc = m.cluster(X, y, update_interval=320)
+print('DEC_ACC', acc)
+assert acc > 0.85, acc
+print('DEC_OK')
+"""
+    out = _run_code(code.replace("{TESTS_DIR!r}",
+                                 repr(os.path.join(ROOT, "tests"))),
+                    str(tmp_path), extra_path=[
+        os.path.join(REFERENCE, "example", "deep-embedded-clustering"),
+        os.path.join(REFERENCE, "example", "autoencoder")], timeout=3000)
+    assert "DEC_OK" in out, out[-3000:]
+
+
 # ---------------------------------------------------------- memcost
 @pytest.mark.slow
 def test_reference_memcost_unmodified(tmp_path):
