@@ -314,7 +314,21 @@ def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32
     # ref: ordering_op.cc TopK — ret_typ in {value, indices, mask, both}
     from ..base import np_dtype
 
-    axis = axis % data.ndim if axis is not None else data.ndim - 1
+    if axis is None:
+        # reference: axis=None ranks over the FLATTENED array
+        # (ordering_op-inl.h ParseTopKParam; example/dsd/sparse_sgd.py
+        # prunes whole weights with topk(axis=None, ret_typ='mask'))
+        out = _topk(data.reshape(-1), axis=-1, k=k, ret_typ=ret_typ,
+                    is_ascend=is_ascend, dtype=dtype)
+        if ret_typ == "mask":
+            return out.reshape(data.shape)
+        return out
+    axis = axis % data.ndim
+    if k <= 0:
+        # reference rule (ordering_op-inl.h:135): k<=0 selects the
+        # whole axis — sparse_sgd at sparsity=100 relies on the
+        # all-ones mask, not an empty one
+        k = data.shape[axis]
     moved = jnp.moveaxis(data, axis, -1)
     sel = -moved if is_ascend else moved
     vals, idxs = jax.lax.top_k(sel, k)

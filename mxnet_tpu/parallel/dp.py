@@ -23,7 +23,6 @@ Two entry points:
 from __future__ import annotations
 
 from .. import autograd
-from ..base import MXNetError
 from ..ndarray import NDArray
 from .mesh import make_mesh
 
@@ -70,10 +69,19 @@ class DataParallelRunner:
                  label_names=None):
         jax = _jax()
         if num_devices > len(jax.devices()):
-            raise MXNetError(
-                "requested %d devices, runtime has %d"
-                % (num_devices, len(jax.devices()))
-            )
+            # reference cpu(i) contexts are logical views of the same
+            # host pool: scripts like example/dsd/mlp.py bind
+            # [cpu(0), cpu(1)] unconditionally.  Collapse onto the
+            # devices that exist (same math, one shard) instead of
+            # failing; a genuinely multi-chip request on a multi-chip
+            # runtime is unaffected.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "requested %d devices, runtime has %d - collapsing "
+                "(parallelism reduced)",
+                num_devices, len(jax.devices()))
+            num_devices = len(jax.devices())
         self.mesh = make_mesh((num_devices,), ("dp",),
                               jax.devices()[:num_devices])
         self._executor = executor

@@ -278,6 +278,53 @@ print('FCN_OK')
     assert "FCN_OK" in out, out[-2000:]
 
 
+# -------------------------------------------------------------- dsd
+@pytest.mark.slow
+def test_reference_dsd_sparse_training(tmp_path):
+    """example/dsd (Dense-Sparse-Dense training): mlp.py run
+    byte-identical with its SparseSGD optimizer — an mx.optimizer.SGD
+    subclass that prunes via topk(ret_typ='mask') and masks
+    weight/grad/momentum each update — across two pruning epochs on
+    two CPU contexts (the script's hardcoded 60000/batch schedule is
+    honored by seeding a 60000-sample synthetic MNIST, so the
+    sparsity switches land exactly at the epoch boundaries)."""
+    import struct
+
+    d = os.path.join(str(tmp_path), "data")
+    os.makedirs(d)
+    rng = np.random.RandomState(5)
+
+    def write(img_name, lab_name, n):
+        labels = (np.arange(n) % 10).astype(np.uint8)
+        base = rng.randint(0, 30, (10, 28, 28))
+        for c in range(10):
+            base[c, c:c + 10, c:c + 10] += 180
+        noise = rng.randint(0, 20, (n, 28, 28))
+        imgs = np.clip(base[labels] + noise, 0, 255).astype(np.uint8)
+        with open(os.path.join(d, img_name), "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28) + imgs.tobytes())
+        with open(os.path.join(d, lab_name), "wb") as f:
+            f.write(struct.pack(">II", 2049, n) + labels.tobytes())
+
+    write("train-images-idx3-ubyte", "train-labels-idx1-ubyte", 60000)
+    write("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte", 1000)
+
+    script = os.path.join(REFERENCE, "example", "dsd", "mlp.py")
+    code = (
+        "import sys, runpy\n"
+        "sys.argv = ['mlp.py', '--pruning_switch_epoch', '1,2',\n"
+        "            '--weight_sparsity', '30,70',\n"
+        "            '--bias_sparsity', '0,0']\n"
+        "runpy.run_path(%r, run_name='__main__')\n" % script)
+    out = _run_code(code, str(tmp_path), extra_path=[
+        os.path.join(REFERENCE, "example", "dsd")], timeout=2800)
+    accs = [float(m) for m in re.findall(
+        r"Validation-accuracy=([0-9.]+)", out)]
+    assert len(accs) == 2, out[-2000:]
+    # the bright-square classes survive 70% weight pruning easily
+    assert accs[-1] > 0.9, (accs, out[-1500:])
+
+
 # ------------------------------------- deep-embedded-clustering
 @pytest.mark.slow
 def test_reference_dec_clustering(tmp_path):
