@@ -451,6 +451,7 @@ class Executor:
                 self.arg_dict[k][:] = v
         from . import profiler as _profiler
 
+        _profiler.sample_memory()  # HBM high-water pre-sample (profile_memory)
         with _profiler.span("Forward<%s>" % (self._output_names[0]
                                              if self._output_names else "?"),
                             cat="symbolic"):
@@ -460,10 +461,11 @@ class Executor:
                 fn = self._fwd_train if is_train else self._fwd_eval
                 outs, aux_upd = fn(self._arg_vals(), self._aux_vals(),
                                    self._next_key())
-            if _profiler.is_running() and _profiler._sync:
+            if _profiler.sync_enabled():
                 _jax().block_until_ready(outs)  # true span, not dispatch
             if is_train:
                 self._write_aux(aux_upd)
+        _profiler.sample_memory()
         self._cached_grads = None
         self._set_outputs(outs)
         return self.outputs
@@ -581,6 +583,7 @@ class Executor:
             cots += [None] * (n_out - n_given)
         from . import profiler as _profiler
 
+        _profiler.sample_memory()  # HBM high-water pre-sample (profile_memory)
         with _profiler.span("Backward<%s>" % (self._output_names[0]
                                               if self._output_names
                                               else "?"), cat="symbolic"):
@@ -593,8 +596,9 @@ class Executor:
                 outs, grads, aux_upd = self._train_step(
                     self._arg_vals(), self._aux_vals(), self._next_key(),
                     cots, n_given)
-            if _profiler.is_running() and _profiler._sync:
+            if _profiler.sync_enabled():
                 _jax().block_until_ready(outs)
+        _profiler.sample_memory()
         self._write_aux(aux_upd)
         if update_outputs or not self._forward_done:
             self._set_outputs(outs)

@@ -90,8 +90,10 @@ class DataIter:
 
     def next(self) -> DataBatch:
         if self.iter_next():
-            return DataBatch(self.getdata(), self.getlabel(),
-                             pad=self.getpad(), index=self.getindex())
+            return _instrumented_fetch(
+                self, lambda: DataBatch(self.getdata(), self.getlabel(),
+                                        pad=self.getpad(),
+                                        index=self.getindex()))
         raise StopIteration
 
     def __next__(self):
@@ -111,6 +113,33 @@ class DataIter:
 
     def getpad(self):
         return 0
+
+
+def _batch_nbytes(batch) -> int:
+    """Host bytes materialized for one DataBatch (telemetry only)."""
+    from . import profiler as _profiler
+
+    return sum(_profiler.nd_nbytes(arr)
+               for arr in list(batch.data) + list(batch.label))
+
+
+def _instrumented_fetch(it, produce):
+    """Input-pipeline telemetry shared by every iterator's fetch path:
+    run ``produce()`` under one io span (stamped on the REAL calling
+    thread — a prefetch worker gets its own trace lane, not the
+    hardcoded tid=0) plus the cumulative batch-bytes counter."""
+    from . import profiler as _profiler
+
+    if not _profiler.is_running():
+        return produce()
+    start = _profiler._now_us()
+    batch = produce()
+    nbytes = _batch_nbytes(batch)
+    _profiler.record_span(type(it).__name__ + "::next", start,
+                          _profiler._now_us() - start, cat="io",
+                          args={"bytes": nbytes})
+    _profiler.record_bytes("io:batch_bytes", nbytes, cat="io")
+    return batch
 
 
 def _init_data(data, allow_empty, default_name):
@@ -417,6 +446,9 @@ class LibSVMIter(DataIter):
     def next(self) -> DataBatch:
         if self._cursor >= self._n:
             raise StopIteration
+        return _instrumented_fetch(self, self._next_batch)
+
+    def _next_batch(self) -> DataBatch:
         end = self._cursor + self.batch_size
         pad = 0
         if end > self._n:
@@ -539,7 +571,17 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def _fetch(self) -> Optional[DataBatch]:
-        return self._queue.get()
+        from . import profiler as _profiler
+
+        if not _profiler.is_running():
+            return self._queue.get()
+        # consumer-side stall time: how long the train loop blocked on
+        # the prefetch queue (the input-pipeline-bound signal)
+        start = _profiler._now_us()
+        batch = self._queue.get()
+        _profiler.record_span("PrefetchingIter::wait", start,
+                              _profiler._now_us() - start, cat="io")
+        return batch
 
     def next(self) -> DataBatch:
         if self.current_batch is not None:
@@ -653,6 +695,9 @@ class ImageRecordIter(DataIter):
         return view
 
     def next(self) -> DataBatch:
+        return _instrumented_fetch(self, self._next_batch)
+
+    def _next_batch(self) -> DataBatch:
         import ctypes as _ct
 
         data_p = (_ct.POINTER(_ct.c_uint8)() if self._native_u8
@@ -899,6 +944,10 @@ class ImageDetRecordIter(DataIter):
         n = len(self._order)
         if self._cursor >= n:
             raise StopIteration
+        return _instrumented_fetch(self, self._next_batch)
+
+    def _next_batch(self) -> DataBatch:
+        n = len(self._order)
         idxs = []
         for k in range(self.batch_size):
             # round_batch semantics: wrap the tail with epoch-start

@@ -41,6 +41,29 @@ def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]
 
 
+def _payload_nbytes(value) -> int:
+    """Approximate wire bytes of a push/pull payload: NDArrays (dense:
+    whole buffer; row-sparse: touched rows + indices — only those
+    travel, ref: kvstore_dist.h:444 EncodeRowSparseKey) or nested lists
+    of them.  Telemetry only — never raises."""
+    try:
+        from . import profiler as _profiler
+        from .ndarray import sparse as _sp
+
+        if value is None:
+            return 0
+        if isinstance(value, (list, tuple)):
+            return sum(_payload_nbytes(v) for v in value)
+        if isinstance(value, _sp.RowSparseNDArray):
+            return (_profiler.nd_nbytes(value.data) +
+                    _profiler.nd_nbytes(value.indices))
+        if isinstance(value, NDArray):
+            return _profiler.nd_nbytes(value)
+    except Exception:
+        pass
+    return 0
+
+
 class KVStore:
     """ref: python/mxnet/kvstore.py KVStore."""
 
@@ -75,10 +98,64 @@ class KVStore:
         for k, v in zip(keys, values):
             self._store[k] = v.copy()
 
+    # -- instrumented verbs: every backend's push/pull stamps a comms
+    #    span + cumulative byte counters (ref: the reference profiler's
+    #    KVStoreDistDefault events around ZPush/ZPull) -----------------
     def push(self, key, value, priority: int = 0) -> None:
         """Sum all pushed values per key (ref: kvstore_local.h Push →
         Comm::Reduce).  Engine-priority overlap is not needed: XLA's async
         dispatch already overlaps these reductions with other work."""
+        from . import profiler as _profiler
+
+        if not _profiler.is_running():
+            return self._do_push(key, value, priority)
+        nbytes = _payload_nbytes(value)
+        with _profiler.span("KVStore::Push", cat="comms",
+                            args={"bytes": nbytes, "type": self._kind}):
+            self._do_push(key, value, priority)
+        _profiler.record_bytes("kvstore:push_bytes", nbytes)
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True) -> None:
+        from . import profiler as _profiler
+
+        if not _profiler.is_running():
+            return self._do_pull(key, out, priority, ignore_sparse)
+        nbytes = _payload_nbytes(out)
+        with _profiler.span("KVStore::Pull", cat="comms",
+                            args={"bytes": nbytes, "type": self._kind}):
+            self._do_pull(key, out, priority, ignore_sparse)
+        _profiler.record_bytes("kvstore:pull_bytes", nbytes)
+
+    def pushpull(self, key, value, out=None, priority: int = 0) -> None:
+        """The allreduce verb: push + pull in one call (the in-graph
+        ``tpu`` store does the same exchange as a fused psum)."""
+        from . import profiler as _profiler
+
+        if not _profiler.is_running():
+            self._do_push(key, value, priority)
+            self._do_pull(key, out if out is not None else value,
+                          priority, True)
+            return
+        nbytes = _payload_nbytes(value)
+        with _profiler.span("KVStore::AllReduce", cat="comms",
+                            args={"bytes": nbytes, "type": self._kind}):
+            self._do_push(key, value, priority)
+            self._do_pull(key, out if out is not None else value,
+                          priority, True)
+        _profiler.record_bytes("kvstore:allreduce_bytes", nbytes)
+
+    def row_sparse_pull(self, key, out=None, priority=0,
+                        row_ids=None) -> None:
+        from . import profiler as _profiler
+
+        if not _profiler.is_running():
+            return self._do_row_sparse_pull(key, out, priority, row_ids)
+        with _profiler.span("KVStore::PullRowSparse", cat="comms",
+                            args={"type": self._kind}):
+            self._do_row_sparse_pull(key, out, priority, row_ids)
+
+    def _do_push(self, key, value, priority: int = 0) -> None:
         from .ndarray import sparse as _sp
 
         keys, values = _key_value(key, value)
@@ -103,7 +180,8 @@ class KVStore:
             else:
                 self._pending[k] = merged
 
-    def pull(self, key, out=None, priority: int = 0, ignore_sparse: bool = True) -> None:
+    def _do_pull(self, key, out=None, priority: int = 0,
+                 ignore_sparse: bool = True) -> None:
         keys, outs = _key_value(key, out)
         for k, olist in zip(keys, outs):
             if self._updater is not None or k not in self._pending:
@@ -117,11 +195,8 @@ class KVStore:
             for o in _as_list(olist):
                 src.copyto(o)
 
-    def pushpull(self, key, value, out=None, priority: int = 0) -> None:
-        self.push(key, value, priority)
-        self.pull(key, out if out is not None else value, priority)
-
-    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None) -> None:
+    def _do_row_sparse_pull(self, key, out=None, priority=0,
+                            row_ids=None) -> None:
         """Pull only the rows named in ``row_ids`` as a RowSparseNDArray
         (ref: kvstore_dist.h:258 PullRowSparseImpl; kvstore_local.h
         PullRowSparseImpl gathers the requested rows)."""
@@ -241,6 +316,11 @@ class KVStoreDist(KVStore):
             reg["recovery"] = int(os.environ.get("DMLC_WORKER_ID", "0"))
         resp = sched.request(reg)
         self._rank = resp["rank"]
+        # per-rank trace dumps (profile_rank{K}.json, pid=rank) key off
+        # the scheduler-assigned rank, not the launcher env
+        from . import profiler as _profiler
+
+        _profiler.set_rank(self._rank, _ps.env_cluster()[3])
         # barrier catch-up for recovery: skip exactly as many barriers
         # as the cohort has already completed, then participate normally
         # (a blanket skip would deadlock healthy workers at the next
@@ -333,7 +413,7 @@ class KVStoreDist(KVStore):
             acc = acc + v._data
         return NDArray.from_raw(acc, vs[0].context)
 
-    def push(self, key, value, priority: int = 0) -> None:
+    def _do_push(self, key, value, priority: int = 0) -> None:
         from .ndarray import sparse as _sp
 
         keys, values = _key_value(key, value)
@@ -359,8 +439,8 @@ class KVStoreDist(KVStore):
         self._fanout([
             (lambda k=k, v=v: one(k, v)) for k, v in zip(keys, values)])
 
-    def pull(self, key, out=None, priority: int = 0,
-             ignore_sparse: bool = True) -> None:
+    def _do_pull(self, key, out=None, priority: int = 0,
+                 ignore_sparse: bool = True) -> None:
         keys, outs = _key_value(key, out)
 
         def one(k, olist):
@@ -374,8 +454,8 @@ class KVStoreDist(KVStore):
         self._fanout([
             (lambda k=k, o=o: one(k, o)) for k, o in zip(keys, outs)])
 
-    def row_sparse_pull(self, key, out=None, priority=0,
-                        row_ids=None) -> None:
+    def _do_row_sparse_pull(self, key, out=None, priority=0,
+                            row_ids=None) -> None:
         from .ndarray import sparse as _sp
 
         if row_ids is None or out is None:

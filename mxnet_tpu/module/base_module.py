@@ -183,12 +183,17 @@ class BaseModule:
 
         # bulk fit: an explicit engine.set_bulk_size(K) groups K batches
         # into one compiled dispatch when the module supports it (Module
-        # does; a monitor forces per-batch so its taps see every step).
+        # does; a monitor forces per-batch so its taps see every step,
+        # and a RUNNING profiler does too — the telemetry layer needs
+        # per-step Forward/Backward/update/comms spans, which the fused
+        # K-step scan would swallow).
         # ref: the engine's bulk segments, MXNET_EXEC_BULK_EXEC_TRAIN
         # (threaded_engine.h:386-458) — here the segment is K whole steps.
         from .. import engine as _engine
+        from .. import profiler as _profiler
 
-        bulk_k = max(1, _engine.fit_bulk_size()) if monitor is None else 1
+        per_batch = monitor is not None or _profiler.is_running()
+        bulk_k = 1 if per_batch else max(1, _engine.fit_bulk_size())
         can_bulk = bulk_k > 1 and hasattr(self, "_bulk_fit_steps")
 
         for epoch in range(begin_epoch, num_epoch):
@@ -211,9 +216,15 @@ class BaseModule:
                     if not pending or (len(pending) < bulk_k and not end):
                         continue
                     group, pending = pending, []
-                    outs = self._bulk_fit_steps(group) if can_bulk else None
+                    # a profiler started mid-fit (e.g. from a
+                    # batch_end_callback skipping warmup) forces THIS
+                    # group per-batch without permanently disabling bulk
+                    profiling = _profiler.is_running()
+                    outs = self._bulk_fit_steps(group) \
+                        if (can_bulk and not profiling) else None
                     if outs is None:
-                        can_bulk = False  # permanent per-batch fallback
+                        if can_bulk and not profiling:
+                            can_bulk = False  # permanent per-batch fallback
                         for b in group:
                             self.forward_backward(b)
                             self.update()

@@ -311,6 +311,12 @@ class Module(BaseModule):
     def update(self):
         """ref: module.py:629 update → kvstore push/pull or local updater."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
+        from .. import profiler as _profiler
+
+        with _profiler.span("Module::update", cat="optimizer"):
+            self._do_update()
+
+    def _do_update(self):
         if self._kvstore is not None:
             for i, name in enumerate(self._param_names):
                 grad = self._exec.grad_dict.get(name)

@@ -75,6 +75,11 @@ class Monitor:
         for exe in self.exes:
             for array in exe.arg_dict.values():
                 array.wait_to_read()
+            # grad stats are read below too — an async backward still in
+            # flight must land before stat_func sees the buffers
+            for array in exe.grad_dict.values():
+                if array is not None:
+                    array.wait_to_read()
         for exe in self.exes:
             for name, array in exe.arg_dict.items():
                 if self.re_prog.match(name):

@@ -550,7 +550,9 @@ def invoke(
 
     from .. import profiler as _profiler
 
-    _prof = _profiler.is_running()
+    # one consistent snapshot: the run/sync decisions must not straddle
+    # a concurrent set_config/set_state
+    _prof, _prof_sync = _profiler.profiling_state()
     if _prof:
         _prof_start = _profiler._now_us()
 
@@ -575,7 +577,7 @@ def invoke(
         outs = fn(*raw)
 
     if _prof:
-        if _profiler._sync:  # block for true op duration (NaiveEngine-style)
+        if _prof_sync:  # block for true op duration (NaiveEngine-style)
             _jax().block_until_ready(outs)
         _profiler.record_span(op.name, _prof_start,
                               _profiler._now_us() - _prof_start)

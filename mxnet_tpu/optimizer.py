@@ -551,13 +551,21 @@ class Updater:
         self.states_synced: Dict[Any, bool] = {}
 
     def __call__(self, index, grad, weight):
+        from . import profiler as _profiler
+
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(
                 index, weight
             )
             self.states_synced[index] = True
-        self.optimizer.update_multi_precision(index, weight, grad,
-                                              self.states[index])
+        # one optimizer span per parameter update, aggregated per
+        # optimizer class — the trace's "update" lane next to compute
+        # and comms (ref: the reference stamps its fused optimizer_op
+        # kernels as engine ops); record_span no-ops when stopped
+        with _profiler.span(type(self.optimizer).__name__ + "::update",
+                            cat="optimizer"):
+            self.optimizer.update_multi_precision(index, weight, grad,
+                                                  self.states[index])
 
     def get_states(self, dump_optimizer=False) -> bytes:
         states = {

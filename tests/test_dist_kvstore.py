@@ -17,7 +17,7 @@ import launch  # noqa: E402  (tools/launch.py)
 _WORKER = os.path.join(os.path.dirname(__file__), "dist_worker.py")
 
 
-def _run_cluster(kind, num_workers, num_servers):
+def _run_cluster(kind, num_workers, num_servers, extra_env=None):
     repo = os.path.join(os.path.dirname(__file__), "..")
     env = {
         # workers only need CPU; keep jax off the TPU tunnel in children
@@ -26,6 +26,7 @@ def _run_cluster(kind, num_workers, num_servers):
         "PYTHONPATH": os.path.abspath(repo) + os.pathsep +
         os.environ.get("PYTHONPATH", ""),
     }
+    env.update(extra_env or {})
     codes = launch.launch_local(
         num_workers, num_servers,
         [sys.executable, _WORKER, kind], env=env)
@@ -39,6 +40,26 @@ def test_dist_sync(workers, servers):
 
 def test_dist_async():
     _run_cluster("dist_async", 2, 1)
+
+
+def test_dist_profiler_rank_dumps(tmp_path):
+    """MXNET_PROFILER_AUTOSTART=1 makes every worker self-start tracing
+    and dump profile_rank{K}.json (pid=rank) at exit — the inputs
+    tools/merge_traces.py stitches into one timeline."""
+    import json
+
+    _run_cluster("dist_async", 2, 1, extra_env={
+        "MXNET_PROFILER_AUTOSTART": "1",
+        "MXNET_PROFILER_FILENAME": str(tmp_path / "profile.json")})
+    for rank in range(2):
+        path = tmp_path / ("profile_rank%d.json" % rank)
+        assert path.exists(), "rank %d wrote no trace" % rank
+        with open(path) as f:
+            events = json.load(f)["traceEvents"]
+        assert events and all(e["pid"] == rank for e in events)
+        # the worker's push/pull left comms spans in its trace
+        assert any(e.get("cat") == "comms" and e.get("ph") == "X"
+                   for e in events)
 
 
 def test_gradient_compression_unit():

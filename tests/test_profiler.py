@@ -24,7 +24,9 @@ def test_profiler_records_imperative_ops(tmp_path):
     assert out == fname
     with open(fname) as f:
         trace = json.load(f)
-    events = trace["traceEvents"]
+    # metadata rows (process_name/thread_name) ride along like the
+    # reference's traces; op spans are the ph:"X" events
+    events = [e for e in trace["traceEvents"] if e["ph"] != "M"]
     names = [e["name"] for e in events]
     assert "dot" in names
     for e in events:
@@ -205,3 +207,168 @@ def test_plot_network_gated():
         import pytest
         with pytest.raises(ImportError):
             mx.viz.plot_network(fc)
+
+
+def test_monitor_grad_stats_populated():
+    """toc() must wait on grad buffers before reading them — grad stats
+    appear exactly once per tapped parameter after backward."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc1", num_hidden=3)
+    exe = fc.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    exe.arg_dict["data"][:] = np.random.rand(2, 4)
+    exe.arg_dict["fc1_weight"][:] = np.random.rand(3, 4)
+    mon = mx.Monitor(interval=1, pattern=".*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward(is_train=True)
+    exe.backward(out_grads=mx.nd.ones((2, 3)))
+    rows = mon.toc()
+    names = [k for _, k, _ in rows]
+    assert names.count("grad_fc1_weight") == 1
+    assert names.count("grad_fc1_bias") == 1
+    for _, k, v in rows:
+        if k.startswith("grad_"):
+            float(v.strip().split("\t")[0])  # real, settled value
+
+
+def test_dumps_aggregate_math(tmp_path):
+    """dumps()/summary(): count/total/min/max/avg over repeated ops."""
+    profiler = mx.profiler
+    profiler.set_config(filename=str(tmp_path / "agg.json"))
+    profiler.set_state("run")
+    for _ in range(3):
+        nd.dot(nd.ones((16, 16)), nd.ones((16, 16))).wait_to_read()
+    profiler.set_state("stop")
+    s = profiler.summary()["spans"]["operator"]["dot"]
+    assert s["count"] == 3
+    assert s["min_ms"] <= s["avg_ms"] <= s["max_ms"]
+    np.testing.assert_allclose(s["avg_ms"], s["total_ms"] / 3, rtol=1e-6)
+    table = profiler.dumps()
+    assert "Profile Statistics" in table
+    assert "dot" in table
+    # reset=True clears the accumulators
+    profiler.dumps(reset=True)
+    assert profiler.summary()["spans"] == {}
+
+
+def test_counter_marker_event_shapes(tmp_path):
+    fname = str(tmp_path / "cm.json")
+    profiler = mx.profiler
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    domain = profiler.Domain("app")
+    ctr = profiler.Counter(domain, "requests", 10)
+    ctr.increment(5)
+    ctr -= 3
+    assert ctr.value == 12
+    profiler.Marker(domain, "phase_end").mark(scope="process")
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    counters = [e for e in events if e["ph"] == "C"
+                and e["name"] == "requests"]
+    assert [e["args"]["requests"] for e in counters] == [10, 15, 12]
+    assert all(e["cat"] == "app" for e in counters)
+    markers = [e for e in events if e["ph"] == "i"]
+    assert [e["name"] for e in markers] == ["phase_end"]
+    assert markers[0]["s"] == "p"
+    # counters fold into the aggregate stats as values, not times
+    c = profiler.summary()["counters"]["app"]["requests"]
+    assert c["count"] == 3 and c["min"] == 10 and c["max"] == 15
+
+
+def test_profile_memory_counters_on_cpu(tmp_path):
+    """profile_memory=True must produce ph:'C' memory counters even on
+    the CPU backend (live-buffer fallback for memory_stats()=None)."""
+    fname = str(tmp_path / "mem.json")
+    profiler = mx.profiler
+    profiler.set_config(filename=fname, profile_memory=True)
+    profiler.set_state("run")
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc", num_hidden=4)
+    exe = fc.simple_bind(ctx=mx.cpu(), data=(2, 8))
+    exe.forward(is_train=True)
+    exe.backward(out_grads=mx.nd.ones((2, 4)))
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    mem = [e for e in events if e["ph"] == "C" and e["cat"] == "memory"]
+    in_use = [e for e in mem if e["name"] == "memory:bytes_in_use"]
+    peak = [e for e in mem if e["name"] == "memory:peak_bytes_in_use"]
+    assert len(in_use) >= 2 and len(peak) >= 2  # around fwd AND bwd
+    for e in mem:
+        assert e["args"][e["name"]] > 0
+    # peak is monotone and >= every in_use sample
+    peaks = [e["args"]["memory:peak_bytes_in_use"] for e in peak]
+    assert peaks == sorted(peaks)
+    assert max(v["args"]["memory:bytes_in_use"] for v in in_use) <= peaks[-1]
+
+
+def test_rank_suffixed_dump(tmp_path, monkeypatch):
+    """Multi-worker env => dump writes profile_rank{K}.json, pid=rank."""
+    monkeypatch.setenv("DMLC_WORKER_ID", "1")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    fname = str(tmp_path / "profile.json")
+    profiler = mx.profiler
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    (nd.ones((4,)) * 2).wait_to_read()
+    profiler.set_state("stop")
+    out = profiler.dump()
+    expect = str(tmp_path / "profile_rank1.json")
+    assert out == expect and os.path.exists(expect)
+    with open(expect) as f:
+        events = json.load(f)["traceEvents"]
+    assert events and all(e["pid"] == 1 for e in events)
+    pnames = [e for e in events if e.get("ph") == "M"
+              and e["name"] == "process_name"]
+    assert pnames and pnames[0]["args"]["name"] == "rank 1"
+
+
+def test_fit_telemetry_end_to_end(tmp_path):
+    """Acceptance: a Module.fit mini-run yields a non-empty aggregate
+    table, a memory counter event, and a kvstore comms span."""
+    fname = str(tmp_path / "fit.json")
+    profiler = mx.profiler
+    profiler.set_config(filename=fname, profile_all=True,
+                        profile_memory=True)
+    profiler.set_state("run")
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc1", num_hidden=2)
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(out, data_names=["data"],
+                        label_names=["softmax_label"], context=mx.cpu())
+    x = np.random.rand(8, 4).astype(np.float32)
+    y = (x.sum(axis=1) > 2).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=4)
+    mod.fit(it, num_epoch=1, kvstore="local",
+            optimizer_params={"learning_rate": 0.1})
+    profiler.set_state("stop")
+    profiler.dump()
+
+    table = profiler.dumps()
+    assert "Profile Statistics" in table
+    summ = profiler.summary()
+    spans = summ["spans"]
+    # per-op aggregates from the executor + optimizer + comms + io
+    # (fit drives the FUSED fwd+vjp step, stamped as the Backward span)
+    assert any(n.startswith("Backward") for n in spans.get("symbolic", {}))
+    assert spans["symbolic"]["Backward<softmax_output>"]["count"] == 2
+    assert "KVStore::Push" in spans.get("comms", {})
+    assert spans["comms"]["KVStore::Push"]["count"] >= 2
+    assert "SGD::update" in spans.get("optimizer", {})
+    assert any(n.endswith("::next") for n in spans.get("io", {}))
+
+    with open(fname) as f:
+        events = json.load(f)["traceEvents"]
+    assert any(e["ph"] == "C" and e["cat"] == "memory" for e in events)
+    assert any(e["ph"] == "X" and e["cat"] == "comms"
+               and e["name"] == "KVStore::Push" for e in events)
+    push = next(e for e in events if e.get("cat") == "comms"
+                and e["name"] == "KVStore::Push")
+    assert push["args"]["bytes"] > 0
+    # cumulative bytes-on-the-wire counter rode along
+    assert any(e["ph"] == "C" and e["name"] == "kvstore:push_bytes"
+               for e in events)

@@ -1,0 +1,94 @@
+"""tools/merge_traces.py — multi-worker trace merging (fast tier-1).
+
+Two synthetic rank dumps (the exact shape mxnet_tpu.profiler.dump
+writes for ranks in a multi-worker run) must merge into one valid
+chrome trace with events remapped onto per-rank pids.
+"""
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import merge_traces  # noqa: E402
+
+_TOOL = os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "merge_traces.py")
+
+
+def _rank_dump(tmp_path, rank, extra_events=()):
+    payload = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+         "args": {"name": "rank %d" % rank}},
+        {"name": "dot", "cat": "operator", "ph": "X", "ts": 5.0 + rank,
+         "dur": 2.0, "pid": rank, "tid": 0},
+        {"name": "KVStore::Push", "cat": "comms", "ph": "X", "ts": 9.0,
+         "dur": 1.5, "pid": rank, "tid": 0, "args": {"bytes": 256}},
+    ] + list(extra_events), "displayTimeUnit": "ms"}
+    path = str(tmp_path / ("profile_rank%d.json" % rank))
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def test_merge_two_rank_dumps(tmp_path):
+    p0 = _rank_dump(tmp_path, 0)
+    p1 = _rank_dump(tmp_path, 1)
+    out = str(tmp_path / "merged.json")
+    merge_traces.merge_files([p0, p1], out)
+    with open(out) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    # every event landed on its rank's pid
+    assert sorted({e["pid"] for e in events}) == [0, 1]
+    for rank in (0, 1):
+        lane = [e for e in events if e["pid"] == rank]
+        names = [e["name"] for e in lane]
+        assert names.count("dot") == 1
+        assert names.count("KVStore::Push") == 1
+        labels = [e["args"]["name"] for e in lane
+                  if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert labels == ["rank %d" % rank]
+
+
+def test_merge_remaps_stale_pids(tmp_path):
+    """Events dumped with pid=0 by every rank (single-process-style
+    dumps) must still split into distinct lanes by filename rank."""
+    paths = []
+    for rank in (0, 1):
+        payload = {"traceEvents": [
+            {"name": "op", "cat": "operator", "ph": "X", "ts": 1.0,
+             "dur": 1.0, "pid": 0, "tid": 0}]}
+        p = str(tmp_path / ("profile_rank%d.json" % rank))
+        with open(p, "w") as f:
+            json.dump(payload, f)
+        paths.append(p)
+    out = str(tmp_path / "m.json")
+    merge_traces.merge_files(paths, out)
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+    ops = [e for e in events if e["name"] == "op"]
+    assert sorted(e["pid"] for e in ops) == [0, 1]
+
+
+def test_cli_self_test_and_executable():
+    assert os.access(_TOOL, os.X_OK), "merge_traces.py must be executable"
+    res = subprocess.run([sys.executable, _TOOL, "--self-test"],
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    assert "OK" in res.stdout
+
+
+def test_cli_merge(tmp_path):
+    p0 = _rank_dump(tmp_path, 0)
+    p1 = _rank_dump(tmp_path, 1)
+    out = str(tmp_path / "cli_merged.json")
+    res = subprocess.run(
+        [sys.executable, _TOOL, p0, p1, "-o", out],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    with open(out) as f:
+        trace = json.load(f)
+    assert sorted({e["pid"] for e in trace["traceEvents"]}) == [0, 1]

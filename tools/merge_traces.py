@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Merge per-rank chrome traces into one multi-process timeline.
+
+Multi-worker runs dump one ``profile_rank{K}.json`` per rank
+(``mxnet_tpu.profiler`` stamps ``pid = rank``); chrome://tracing and
+Perfetto render each pid as its own process lane, so merging is: load
+every rank file, force each file's events onto its rank's pid, keep one
+``process_name`` metadata row per rank, and concatenate.
+
+Timestamps stay relative to each rank's own profiler start (the ranks'
+clocks are not realigned — within a synchronized job the skew is the
+barrier jitter, which is itself informative).
+
+Usage:
+    tools/merge_traces.py profile_rank0.json profile_rank1.json -o merged.json
+    tools/merge_traces.py --self-test
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_RANK_RE = re.compile(r"rank(\d+)")
+
+
+def rank_of(path: str, payload: dict, fallback: int) -> int:
+    """Rank for one input file: the ``rank{K}`` filename token wins,
+    then the first event's pid, then the file's position."""
+    m = _RANK_RE.search(os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    for ev in payload.get("traceEvents", []):
+        if ev.get("ph") != "M" and "pid" in ev:
+            return int(ev["pid"])
+    return fallback
+
+
+def merge(payloads):
+    """[(path, payload)] -> one chrome-trace dict with per-rank pids."""
+    merged = []
+    seen_ranks = set()
+    for idx, (path, payload) in enumerate(payloads):
+        rank = rank_of(path, payload, idx)
+        if rank in seen_ranks:
+            raise ValueError("duplicate rank %d (file %s)" % (rank, path))
+        seen_ranks.add(rank)
+        lane = [{"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+                 "args": {"name": "rank %d" % rank}}]
+        for ev in payload.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue  # replaced by the single rank label above
+            lane.append(dict(ev, pid=rank))
+        merged.extend(lane)
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+def merge_files(paths, out_path):
+    payloads = []
+    for p in paths:
+        with open(p) as f:
+            payloads.append((p, json.load(f)))
+    result = merge(payloads)
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    return result
+
+
+def self_test() -> int:
+    """Synthesize two rank dumps, merge, assert pid remapping."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        paths = []
+        for rank in (0, 1):
+            payload = {"traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+                 "args": {"name": "stale"}},
+                {"name": "dot", "cat": "operator", "ph": "X", "ts": 1.0,
+                 "dur": 2.0, "pid": 0, "tid": 0},
+                {"name": "kvstore:push_bytes", "cat": "comms", "ph": "C",
+                 "ts": 3.0, "pid": 0, "tid": 0,
+                 "args": {"kvstore:push_bytes": 64}},
+            ], "displayTimeUnit": "ms"}
+            p = os.path.join(d, "profile_rank%d.json" % rank)
+            with open(p, "w") as f:
+                json.dump(payload, f)
+            paths.append(p)
+        out = os.path.join(d, "merged.json")
+        result = merge_files(paths, out)
+        with open(out) as f:
+            on_disk = json.load(f)
+        assert on_disk == result
+        events = result["traceEvents"]
+        assert len(events) == 6, events
+        pids = sorted({e["pid"] for e in events})
+        assert pids == [0, 1], "pid remapping failed: %s" % pids
+        for rank in (0, 1):
+            names = [e["name"] for e in events if e["pid"] == rank]
+            assert names.count("dot") == 1
+            labels = [e["args"]["name"] for e in events
+                      if e["pid"] == rank and e.get("ph") == "M"
+                      and e["name"] == "process_name"]
+            assert labels == ["rank %d" % rank], labels
+    print("merge_traces self-test OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("inputs", nargs="*",
+                    help="per-rank trace JSON files (profile_rank{K}.json)")
+    ap.add_argument("-o", "--output", default="profile_merged.json",
+                    help="merged trace path (default: profile_merged.json)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the built-in synthetic merge check and exit")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if len(args.inputs) < 2:
+        ap.error("need at least two rank traces to merge")
+    result = merge_files(args.inputs, args.output)
+    print("merged %d files, %d events -> %s"
+          % (len(args.inputs), len(result["traceEvents"]), args.output))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
