@@ -7,10 +7,13 @@ Backends:
   * ``local`` / ``device``  — in-process reduce over the values pushed for a
     key (the reference's CommCPU tree-reduce / CommDevice GPU reduce,
     src/kvstore/comm.h:102,484, collapse into one jnp sum: XLA fuses it).
-  * ``tpu``                 — same API; additionally exposes the mesh-based
-    fused allreduce used *inside* jitted train steps (parallel/dp.py) so
-    gradient exchange rides ICI as ``lax.psum`` instead of host loops
-    (SURVEY.md §2.3: "XLA AllReduce over ICI … replacing CommDevice+NCCL").
+  * ``tpu``                 — same API; multi-key dense pushes merge through
+    ONE compiled bucketed-reduction program (KVStoreTPU: reverse-key-order
+    size-capped buckets, parallel/buckets.py — the same partitioner the
+    in-graph FusedTrainStep exchange uses), with per-bucket comms spans +
+    byte counters.  Inside jitted train steps the exchange rides ICI as
+    per-bucket ``lax.psum`` (SURVEY.md §2.3: "XLA AllReduce over ICI …
+    replacing CommDevice+NCCL").
   * ``dist_sync`` / ``dist_async`` / ``dist_device_sync`` — multi-process
     parameter-server semantics over ``jax.distributed`` land with the
     multi-host milestone; single-process creation works now (maps to local
@@ -269,6 +272,99 @@ class KVStore:
             raise MXNetError("set_optimizer before loading states")
         with open(fname, "rb") as f:
             self._opt_updater.set_states(f.read())
+
+
+class KVStoreTPU(KVStore):
+    """The ``kvstore('tpu')`` fast path: multi-key dense pushes merge
+    through ONE compiled bucketed-reduction program.
+
+    The reference reduced each key separately (comm.h tree-reduce /
+    KVStoreNCCL per-key ring); here the whole gradient set pushed in one
+    call is partitioned into reverse-key-order, size-capped buckets
+    (parallel/buckets.py — the same partitioner the in-graph
+    FusedTrainStep path uses), each bucket reduced as one fused op, with
+    per-bucket comms spans + byte counters stamped through the telemetry
+    layer.  Single-key, single-value and sparse pushes keep the base
+    store's semantics unchanged.
+    """
+
+    def __init__(self):
+        super().__init__("tpu")
+        self._fused_cache: Dict = {}
+
+    def _do_push(self, key, value, priority: int = 0) -> None:
+        from .ndarray import sparse as _sp
+
+        keys, values = _key_value(key, value)
+        dense = []
+        for k, vlist in zip(keys, values):
+            vs = _as_list(vlist)
+            if len(vs) > 1 and all(
+                    isinstance(v, NDArray)
+                    and not isinstance(v, _sp.RowSparseNDArray)
+                    for v in vs):
+                dense.append((k, vs))
+        from .parallel import buckets as _buckets
+
+        if (len(dense) < 2 or len(dense) != len(keys)
+                or _buckets.bucket_cap_bytes() == 0
+                or len({len(vs) for _k, vs in dense}) != 1):
+            # nothing to bucket across (or MXNET_KVSTORE_BUCKET_BYTES=0
+            # disabled bucketing, or ragged device-copy counts the flat
+            # concat cannot stack): base per-key reduce
+            return super()._do_push(key, value, priority)
+        merged = self._fused_reduce(dense)
+        for (k, _vs), m in zip(dense, merged):
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("push before init on key %r" % k)
+                self._updater(_int_key(k), m, self._store[k])
+            else:
+                self._pending[k] = m
+
+    def _fused_reduce(self, items) -> List[NDArray]:
+        """Reduce every key's device copies in one compiled program,
+        bucket by bucket (reverse key order), and stamp per-bucket
+        telemetry."""
+        import jax
+        import jax.numpy as jnp
+
+        from .parallel import buckets as _buckets
+
+        plan = _buckets.partition(
+            [(pos, tuple(vs[0].shape), vs[0].dtype)
+             for pos, (_k, vs) in enumerate(items)], None)
+        sig = (tuple((len(vs), tuple(vs[0].shape), str(vs[0].dtype))
+                     for _k, vs in items),
+               tuple((b.keys, b.dtype) for b in plan))
+        fn = self._fused_cache.get(sig)
+        if fn is None:
+            shapes = [tuple(vs[0].shape) for _k, vs in items]
+
+            def reduce_all(stacks):
+                out = [None] * len(stacks)
+                for b in plan:
+                    flat = jnp.concatenate(
+                        [stacks[pos].reshape(stacks[pos].shape[0], -1)
+                         for pos in b.keys], axis=1) \
+                        if len(b.keys) > 1 else \
+                        stacks[b.keys[0]].reshape(
+                            stacks[b.keys[0]].shape[0], -1)
+                    red = flat.sum(axis=0)
+                    off = 0
+                    for pos in b.keys:
+                        sz = int(_np.prod(shapes[pos])) if shapes[pos] else 1
+                        out[pos] = red[off:off + sz].reshape(shapes[pos])
+                        off += sz
+                return out
+
+            fn = jax.jit(reduce_all)
+            self._fused_cache[sig] = fn
+        stacks = [jnp.stack([v._data for v in vs]) for _k, vs in items]
+        reduced = fn(stacks)
+        _buckets.stamp_profiler(plan, store_type="tpu")
+        return [NDArray.from_raw(r, items[i][1][0].context)
+                for i, r in enumerate(reduced)]
 
 
 def _int_key(k):
@@ -616,4 +712,6 @@ def create(name: str = "local") -> KVStore:
         kvstore_server.init()  # blocks forever in scheduler/server roles
         if os.environ.get("DMLC_PS_ROOT_URI"):
             return KVStoreDist(name)
+    if name == "tpu":
+        return KVStoreTPU()
     return KVStore(name)

@@ -95,6 +95,9 @@ class BulkTrainLoop:
         self._reason: Optional[str] = None
         self._checked = False
         self._built = False
+        self._bucketed = False
+        self._bucket_plan = None
+        self._mesh = None
 
     # -- eligibility ----------------------------------------------------
     def _check(self) -> Optional[str]:
@@ -118,6 +121,23 @@ class BulkTrainLoop:
         updater = mod._active_updater()
         if updater is None:
             return "no local updater"
+        dp = getattr(mod, "_dp", None)
+        if dp is not None and int(dp.mesh.devices.size) > 1:
+            # multi-context DP is only inside the bulk contract through
+            # the bucketed shard_map reduce (explicit dp sharding; the
+            # per-batch path re-places cells instead)
+            from ..parallel import buckets as _buckets
+
+            if tuple(dp.mesh.axis_names) != ("dp",):
+                return "multi-context DP mesh is not pure dp"
+            if _buckets.bucket_cap_bytes() == 0:
+                return ("multi-context DP bulk needs the bucketed "
+                        "reduce (MXNET_KVSTORE_BUCKET_BYTES=0 set)")
+            n_dp = int(dp.mesh.devices.size)
+            for d in list(mod._data_shapes) + list(mod._label_shapes or []):
+                if d.shape[0] % n_dp:
+                    return ("batch %d not divisible by dp=%d"
+                            % (d.shape[0], n_dp))
         return None
 
     def available(self) -> bool:
@@ -139,6 +159,21 @@ class BulkTrainLoop:
         ex = mod._exec
         updater = mod._active_updater()
         opt = updater.optimizer
+
+        # bucketed backward-overlapped gradient exchange: a pure-dp
+        # multi-device module (Module(context=[...])) compiles the scan
+        # body through shard_map with per-bucket reductions in reverse
+        # layer order (parallel/buckets.py) instead of the partitioner's
+        # combined all-reduce — Module.fit gets the same overlapped
+        # schedule as the FusedTrainStep bench path.
+        from ..parallel import buckets as _buckets
+
+        dp = getattr(mod, "_dp", None)
+        mesh = getattr(dp, "mesh", None)
+        n_dp = int(mesh.devices.size) if mesh is not None else 1
+        bucketed = (mesh is not None
+                    and tuple(mesh.axis_names) == ("dp",) and n_dp > 1
+                    and _buckets.bucket_cap_bytes() != 0)
 
         symbol = mod._symbol
         eval_fn = build_graph_eval(symbol)
@@ -162,6 +197,18 @@ class BulkTrainLoop:
         templates = self._state_templates
         n_outs = len(symbol.list_outputs())
 
+        if bucketed:
+            # every data/label batch dim must split evenly over dp
+            for nm in io_names:
+                if ex.arg_dict[nm].shape[0] % n_dp:
+                    bucketed = False
+        plan = _buckets.partition(
+            [(name, tuple(ex.arg_dict[name].shape),
+              ex.arg_dict[name].dtype) for _i, name in trainable]) \
+            if bucketed else None
+        self._bucketed = bucketed
+        self._bucket_plan = plan
+
         def one_step(params, aux_vals, state_leaves, data_parts, key_root,
                      ctr, lr):
             args = dict(params)
@@ -169,6 +216,9 @@ class BulkTrainLoop:
                 args[n] = v.astype(arg_dtypes[n]) \
                     if v.dtype != arg_dtypes[n] else v
             key = jax.random.fold_in(key_root, ctr)
+            if bucketed:
+                # decorrelate per-device random ops (dropout masks)
+                key = jax.random.fold_in(key, lax.axis_index("dp"))
             diff = {k: args[k] for k in grad_names}
             rest = {k: v for k, v in args.items() if k not in diff}
 
@@ -183,6 +233,17 @@ class BulkTrainLoop:
             cots = [jnp.ones_like(o) for o in outs]
             zero_rest = jax.tree.map(jnp.zeros_like, res[1:])
             (grads,) = vjp_fn((cots,) + tuple(zero_rest))
+
+            if bucketed:
+                # per-device partial grads -> global grads, one psum per
+                # reverse-layer-order bucket (cotangents are ones, so
+                # the global gradient is the plain cross-device sum;
+                # batch-normalized ops already divided by the GLOBAL
+                # count under the cross-device context)
+                grads = {**dict(grads),
+                         **_buckets.bucketed_reduce(dict(grads), plan,
+                                                    "dp", n=n_dp,
+                                                    mean=False)}
 
             # ---- optimizer via trace adapter ----
             saved = (opt.lr_scheduler, opt.__dict__.get("lr"),
@@ -219,11 +280,36 @@ class BulkTrainLoop:
                     if v.dtype != aux_dtypes[k] else v
             return new_params, new_aux, new_leaves, outs
 
+        if bucketed:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from ..ops import nn as _nn_ops
+
+            def _local_step(params, aux_vals, state_leaves, data_parts,
+                            key_root, ctr, lr):
+                # batch-statistics ops (BatchNorm moments, SoftmaxOutput
+                # batch/valid normalization) reduce over dp during this
+                # trace: per-device program, GLOBAL-batch semantics
+                with _nn_ops.cross_device_batch_stats("dp"):
+                    return one_step(params, aux_vals, state_leaves,
+                                    data_parts, key_root, ctr, lr)
+
+            step_fn = shard_map(
+                _local_step, mesh=mesh,
+                in_specs=(P(), P(), P(), P("dp"), P(), P(), P()),
+                out_specs=(P(), P(), P(), P("dp")),
+                check_rep=False)
+        else:
+            step_fn = one_step
+
+        self._mesh = mesh
+
         def bulk(params, aux_vals, state_leaves, datas, key_root, ctr0,
                  lr):
             def body(carry, xs):
                 params, aux_vals, leaves, ctr = carry
-                new_p, new_a, new_l, outs = one_step(
+                new_p, new_a, new_l, outs = step_fn(
                     params, aux_vals, leaves, xs, key_root, ctr, lr)
                 return (new_p, new_a, new_l, ctr + 1), tuple(outs)
 
@@ -263,6 +349,15 @@ class BulkTrainLoop:
                     arrs.append(src._data if isinstance(src, NDArray)
                                 else jnp.asarray(src))
                 stacked.append(jnp.stack(arrs))
+            if self._bucketed:
+                # batches arrive committed to one device; the shard_map
+                # scan wants them batch-sharded over dp (leading dim is
+                # the scan's K)
+                import jax as _jx
+                from jax.sharding import NamedSharding, PartitionSpec as _P
+
+                ksh = NamedSharding(self._mesh, _P(None, "dp"))
+                stacked = [_jx.device_put(s, ksh) for s in stacked]
             # COMMIT every carried buffer to the device before the first
             # dispatch: jit keys include placement, so uncommitted
             # first-call inputs vs committed (donated-output) later ones
@@ -270,9 +365,18 @@ class BulkTrainLoop:
             import jax as _jax
 
             dev = ex._ctx.jax_device()
+            target = None
+            if self._bucketed:
+                # shard_map needs every carried buffer replicated over
+                # the mesh, not pinned to one device
+                from jax.sharding import NamedSharding, PartitionSpec as _P
+
+                target = NamedSharding(self._mesh, _P())
 
             def _commit(cell):
-                if getattr(cell._data, "committed", True) is not True:
+                if target is not None:
+                    cell._data = _jax.device_put(cell._data, target)
+                elif getattr(cell._data, "committed", True) is not True:
                     cell._data = _jax.device_put(cell._data, dev)
                 return cell._data
 
@@ -320,6 +424,10 @@ class BulkTrainLoop:
                          self._reason)
             return None
 
+        if self._bucketed:
+            from ..parallel import buckets as _buckets
+
+            _buckets.stamp_profiler(self._bucket_plan)
         for name, val in new_params.items():
             cell = ex.arg_dict[name]
             cell._data = val

@@ -341,12 +341,13 @@ class Module(BaseModule):
         consumed by fit; the reference's bulk-exec segments,
         threaded_engine.h:386-458).  Returns per-batch outputs, or None
         to signal the standard per-batch path."""
-        if self._dp is not None:
-            return None  # multi-context DP re-places cells per batch
         if self._bulk_loop is None:
             from .bulk import BulkTrainLoop
 
             self._bulk_loop = BulkTrainLoop(self)
+        # multi-context DP rides the bucketed shard_map scan (bulk.py
+        # eligibility decides; outside its contract -> per-batch path,
+        # which re-places cells per batch)
         return self._bulk_loop.run(batches)
 
     def get_outputs(self, merge_multi_context=True):

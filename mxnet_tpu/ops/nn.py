@@ -19,6 +19,7 @@ Design notes (tpu-first):
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional, Sequence, Tuple
 
@@ -28,6 +29,37 @@ from jax import lax
 
 from ..base import np_dtype
 from .registry import register
+
+# ---------------------------------------------------------------------------
+# cross-device batch semantics (sync-BN / global-batch normalization)
+#
+# When a per-device program (shard_map over a dp mesh axis — the bucketed
+# gradient-exchange path, parallel/buckets.py) traces ops under this
+# context, ops whose semantics involve BATCH statistics or BATCH-size
+# normalization reduce over the named axis so the math stays identical
+# to the SPMD-partitioned global program: BatchNorm moments become
+# global-batch moments (equal per-device batches → pmean of local
+# moments IS the global moment), SoftmaxOutput's normalization='batch'/
+# 'valid' divides by the GLOBAL batch / valid count.  Without this, the
+# shard_map form would silently train local-batch BN — different math,
+# not reduction noise.
+# ---------------------------------------------------------------------------
+_cross_device_axis: list = []
+
+
+@contextlib.contextmanager
+def cross_device_batch_stats(axis_name: str):
+    """Trace-time context: batch-statistics ops reduce over ``axis_name``."""
+    _cross_device_axis.append(str(axis_name))
+    try:
+        yield
+    finally:
+        _cross_device_axis.pop()
+
+
+def _batch_stats_axis() -> Optional[str]:
+    return _cross_device_axis[-1] if _cross_device_axis else None
+
 
 # ---------------------------------------------------------------------------
 # helpers
@@ -301,11 +333,19 @@ def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
                 grad = grad * mask[:, None]
             grad = grad.reshape(prob.shape)
         scale = grad_scale
+        axn = _batch_stats_axis()
         if normalization == "batch":
-            scale = scale / prob.shape[0]
+            batch = prob.shape[0]
+            if axn is not None:
+                # per-device program: normalize by the GLOBAL batch
+                batch = batch * lax.psum(1, axn)
+            scale = scale / batch
         elif normalization == "valid" and use_ignore:
             lab_full = label.reshape(-1).astype(jnp.int32)
-            nvalid = jnp.maximum(jnp.sum(lab_full != int(ignore_label)), 1)
+            nvalid = jnp.sum(lab_full != int(ignore_label))
+            if axn is not None:
+                nvalid = lax.psum(nvalid, axn)
+            nvalid = jnp.maximum(nvalid, 1)
             grad = grad * (1.0 / nvalid.astype(prob.dtype))
         grad = grad * scale
         return grad, jnp.zeros_like(label)
@@ -403,8 +443,15 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         acc_t = jnp.promote_types(data.dtype, jnp.float32)
         xf = data.astype(acc_t)
         mean32 = jnp.mean(xf, axis=reduce_axes)
-        var32 = jnp.maximum(
-            jnp.mean(xf * xf, axis=reduce_axes) - mean32 * mean32, 0.0)
+        ex2 = jnp.mean(xf * xf, axis=reduce_axes)
+        axn = _batch_stats_axis()
+        if axn is not None:
+            # sync BN: equal per-device batches make pmean of the local
+            # moments the exact global-batch moments — same statistics
+            # the SPMD-partitioned program computes
+            mean32 = lax.pmean(mean32, axn)
+            ex2 = lax.pmean(ex2, axn)
+        var32 = jnp.maximum(ex2 - mean32 * mean32, 0.0)
         new_mm = mm * momentum + \
             lax.stop_gradient(mean32).astype(mm.dtype) * (1.0 - momentum)
         new_mv = mv * momentum + \

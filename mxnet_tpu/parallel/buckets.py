@@ -1,0 +1,257 @@
+"""Gradient bucketing for backward-overlapped all-reduce (NCCL-DDP style).
+
+Round 5 measured the data-parallel gradient exchange compiling to ONE
+combined synchronous all-reduce (OVERLAP_MEASURED.json: n_async_pairs=0)
+— a reduction that depends on EVERY gradient cannot start until backward
+finishes, so nothing can hide it and projected eff@256 stalls at ~0.85.
+The fix is the same one NCCL DDP and the reference's engine-priority
+path (python/mxnet/gluon/trainer.py:190, src/kvstore/kvstore_nccl.h:281)
+converged on: partition the gradient pytree into REVERSE-LAYER-ORDER,
+size-capped buckets and reduce each bucket separately.  Bucket 0 holds
+the deepest (last-executed-forward) layers, whose gradients materialize
+FIRST during backward — its all-reduce's operands are ready while most
+of backward is still running, so the dataflow graph itself gives XLA's
+latency-hiding scheduler the freedom to emit ``all-reduce-start``/
+``all-reduce-done`` pairs that ride ICI under the remaining compute.
+
+Mechanics (per bucket):
+  * the bucket's gradient leaves are flattened and concatenated into one
+    contiguous buffer, so every backend emits exactly ONE reduction op
+    per bucket (a variadic ``lax.psum`` lowers to one all-reduce PER
+    OPERAND on this toolchain — measured, not assumed);
+  * the buffer is reduced with ``lax.psum`` over the mesh's dp axis
+    (default), or with a manual ``lax.ppermute`` reduce-scatter/
+    all-gather ring (``MXNET_KVSTORE_BUCKET_IMPL=ring`` — the pattern
+    already proven to schedule async pairs in ring_attention.py);
+  * consecutive buckets are chained through
+    ``lax.optimization_barrier`` (issue order = reverse layer order,
+    the NCCL in-order-stream analogue) so XLA's all-reduce combiner
+    cannot re-merge them into the round-5 monolith.  Compute stays OFF
+    the chain — only reductions serialize against each other.
+
+Buckets never mix dtypes (the concat must be homogeneous) and every
+gradient lands in exactly one bucket.  ``MXNET_KVSTORE_BUCKET_BYTES``
+tunes the cap (default 4 MiB; ``0`` disables bucketing entirely and
+callers fall back to the monolithic path).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Mapping, NamedTuple, Optional, Sequence, Tuple
+
+__all__ = [
+    "DEFAULT_BUCKET_BYTES", "Bucket", "bucket_cap_bytes", "chain_enabled",
+    "impl_name", "partition", "plan_for_arrays", "bucketed_reduce",
+    "ring_allreduce_flat", "accounting", "stamp_profiler",
+]
+
+DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
+
+
+class Bucket(NamedTuple):
+    """One reduction unit: ``keys`` in issue order, homogeneous dtype."""
+    keys: Tuple
+    nbytes: int
+    dtype: str
+
+
+def bucket_cap_bytes(default: int = DEFAULT_BUCKET_BYTES) -> int:
+    """The size cap, env-tunable via MXNET_KVSTORE_BUCKET_BYTES.
+    0 disables bucketing (callers use the monolithic reduction)."""
+    try:
+        return int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES", default))
+    except ValueError:
+        return default
+
+
+def chain_enabled() -> bool:
+    """MXNET_KVSTORE_BUCKET_CHAIN=0 drops the optimization_barrier chain
+    between consecutive bucket reductions (lets the combiner re-merge)."""
+    return os.environ.get("MXNET_KVSTORE_BUCKET_CHAIN", "1") != "0"
+
+
+def impl_name() -> str:
+    """'psum' (default) or 'ring' (manual ppermute reduce-scatter/
+    all-gather — collective-permutes can never be combined into one
+    all-reduce, and are the pattern ring_attention.py already overlaps)."""
+    return os.environ.get("MXNET_KVSTORE_BUCKET_IMPL", "psum")
+
+
+def _nbytes(shape, dtype) -> int:
+    import numpy as np
+
+    n = 1
+    for d in shape:
+        n *= int(d)
+    try:
+        item = np.dtype(dtype).itemsize
+    except TypeError:
+        # extension dtypes numpy has not registered (bare 'bfloat16'
+        # strings when ml_dtypes is absent)
+        item = {"bfloat16": 2, "float16": 2}.get(str(dtype), 4)
+    return n * item
+
+
+def partition(entries: Sequence[Tuple], cap_bytes: Optional[int] = None
+              ) -> List[Bucket]:
+    """Partition ``entries`` — ``(key, shape, dtype)`` in LAYER ORDER
+    (forward execution order) — into reverse-layer-order buckets.
+
+    Deterministic greedy fill over ``reversed(entries)``: a bucket
+    closes when adding the next gradient would exceed ``cap_bytes`` or
+    change dtype; a single gradient larger than the cap gets a bucket
+    of its own.  Every key lands in exactly one bucket.
+    """
+    if cap_bytes is None:
+        cap_bytes = bucket_cap_bytes()
+    cap = max(int(cap_bytes), 1)
+    buckets: List[Bucket] = []
+    cur_keys: List = []
+    cur_bytes = 0
+    cur_dtype: Optional[str] = None
+
+    def flush():
+        nonlocal cur_keys, cur_bytes, cur_dtype
+        if cur_keys:
+            buckets.append(Bucket(tuple(cur_keys), cur_bytes, cur_dtype))
+        cur_keys, cur_bytes, cur_dtype = [], 0, None
+
+    for key, shape, dtype in reversed(list(entries)):
+        nb = _nbytes(shape, dtype)
+        dt = str(dtype)
+        if cur_keys and (cur_dtype != dt or cur_bytes + nb > cap):
+            flush()
+        cur_keys.append(key)
+        cur_bytes += nb
+        cur_dtype = dt
+    flush()
+    return buckets
+
+
+def plan_for_arrays(named: Mapping, cap_bytes: Optional[int] = None
+                    ) -> List[Bucket]:
+    """Partition a ``{key: array}`` mapping (insertion order = layer
+    order)."""
+    return partition([(k, v.shape, v.dtype) for k, v in named.items()],
+                     cap_bytes)
+
+
+def ring_allreduce_flat(flat, axis_name: str, n: int):
+    """Manual ring all-reduce of a flat buffer: unidirectional
+    reduce-scatter then all-gather over ``lax.ppermute`` neighbour hops
+    (2(n-1) steps, the bandwidth-optimal schedule KVStoreNCCL used).
+    Must run inside shard_map over ``axis_name`` with ``n`` devices."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if n == 1:
+        return flat
+    size = flat.shape[0]
+    pad = (-size) % n
+    buf = jnp.pad(flat, (0, pad)).reshape(n, -1)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # reduce-scatter: chunk j's partial starts on device j+1 and rides
+    # the ring accumulating one resident contribution per hop; after
+    # n-1 hops device d holds the FULL sum of chunk d
+    acc = jnp.take(buf, (idx - 1) % n, axis=0)
+    for s in range(1, n):
+        acc = lax.ppermute(acc, axis_name, perm)
+        acc = acc + jnp.take(buf, (idx - 1 - s) % n, axis=0)
+
+    # all-gather: rotate the finished chunks; after hop t device d
+    # holds chunk (d - t) mod n in slot t
+    parts = [acc]
+    cur = acc
+    for _ in range(n - 1):
+        cur = lax.ppermute(cur, axis_name, perm)
+        parts.append(cur)
+    stacked = jnp.stack(parts)  # slot t = chunk (idx - t) % n
+    order = (idx - jnp.arange(n)) % n  # chunk j lives in slot (idx-j)%n
+    full = jnp.take(stacked, order, axis=0).reshape(-1)
+    return full[:size]
+
+
+def bucketed_reduce(grads: Mapping, plan: Sequence[Bucket],
+                    axis_name: str, *, n: int, mean: bool = False,
+                    chain: Optional[bool] = None,
+                    impl: Optional[str] = None) -> Dict:
+    """Reduce ``grads`` (``{key: local array}``) bucket by bucket over
+    ``axis_name`` inside shard_map; returns ``{key: reduced array}``.
+
+    ``mean`` divides by ``n`` (psum-mean — the data-parallel gradient of
+    a global-mean loss); each bucket is one flat concat → one reduction
+    op; consecutive buckets chain via optimization_barrier.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if chain is None:
+        chain = chain_enabled()
+    if impl is None:
+        impl = impl_name()
+    out: Dict = {}
+    anchor = None
+    inv_n = 1.0 / float(n)
+    for bucket in plan:
+        leaves = [grads[k] for k in bucket.keys]
+        flat = leaves[0].ravel() if len(leaves) == 1 else \
+            jnp.concatenate([g.ravel() for g in leaves])
+        if chain and anchor is not None:
+            # reductions issue in reverse-layer order, NCCL-stream
+            # style; the data dependency stops the all-reduce combiner
+            # from re-fusing the buckets back into one op
+            flat, _ = lax.optimization_barrier((flat, anchor))
+        if impl == "ring" and n > 1:
+            red = ring_allreduce_flat(flat, axis_name, n)
+        else:
+            red = lax.psum(flat, axis_name)
+        if mean and n > 1:
+            red = red * jnp.asarray(inv_n, dtype=red.dtype)
+        anchor = lax.slice(red, (0,), (1,))
+        off = 0
+        for key, g in zip(bucket.keys, leaves):
+            sz = g.size
+            out[key] = lax.slice(red, (off,), (off + sz,)).reshape(g.shape)
+            off += sz
+    return out
+
+
+def accounting(plan: Sequence[Bucket]) -> List[Dict]:
+    """Per-bucket collective accounting rows (count/bytes per
+    reduction) — the MULTICHIP/SCALING artifact block."""
+    return [{"bucket": i, "n_grads": len(b.keys), "bytes": int(b.nbytes),
+             "dtype": b.dtype} for i, b in enumerate(plan)]
+
+
+def stamp_profiler(plan: Sequence[Bucket], *, impl: Optional[str] = None,
+                   store_type: str = "tpu") -> None:
+    """Stamp one comms span per bucket + cumulative byte counters
+    through the telemetry layer (profiler.py) at dispatch time, so the
+    bucketed schedule is visible in merged traces — the in-graph
+    reductions themselves execute inside XLA where host spans cannot
+    reach, so these spans record the issue schedule (bucket order,
+    payload bytes), not device occupancy.  No-op unless the profiler is
+    running; never raises."""
+    try:
+        from .. import profiler as _profiler
+
+        if not _profiler.is_running():
+            return
+        if impl is None:
+            impl = impl_name()
+        total = 0
+        for i, b in enumerate(plan):
+            with _profiler.span("KVStore::AllReduceBucket",
+                                cat="comms",
+                                args={"bucket": i, "bytes": int(b.nbytes),
+                                      "n_grads": len(b.keys),
+                                      "impl": impl, "type": store_type,
+                                      "in_graph": True}):
+                pass
+            total += int(b.nbytes)
+        _profiler.record_bytes("kvstore:bucket_allreduce_bytes", total)
+        _profiler.record_bytes("kvstore:bucket_allreduce_count", len(plan))
+    except Exception:
+        pass

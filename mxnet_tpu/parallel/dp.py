@@ -19,6 +19,21 @@ Two entry points:
   * ``FusedTrainStep``    — whole-step compilation for a gluon block:
     forward + loss + backward + fused optimizer in ONE XLA program (the
     kvstore('tpu') fast path; also the bench harness).
+
+Gradient exchange: on a pure-dp multi-device mesh the step compiles
+through ``shard_map`` with the gradients reduced in REVERSE-LAYER-ORDER
+size-capped buckets (parallel/buckets.py, NCCL-DDP style) instead of
+letting the SPMD partitioner fold everything into the single combined
+synchronous all-reduce round 5 measured (OVERLAP_MEASURED.json:
+n_async_pairs=0, overlap 0.0).  Per-bucket reductions become operand-
+ready while backward is still running, so XLA's latency-hiding
+scheduler can emit async start/done pairs that overlap backward compute
+— the TPU equivalent of the reference's engine-priority overlap
+(python/mxnet/gluon/trainer.py:190, src/kvstore/kvstore_nccl.h:281).
+``MXNET_KVSTORE_BUCKET_BYTES=0`` restores the monolithic SPMD path;
+BatchNorm keeps GLOBAL-batch statistics through the sync-BN context
+(ops/nn.py cross_device_batch_stats), so numerics match the monolithic
+program.
 """
 from __future__ import annotations
 
@@ -125,7 +140,7 @@ class FusedTrainStep:
 
     def __init__(self, block, loss_fn, mesh=None, learning_rate=0.05,
                  momentum=0.9, weight_decay=0.0, param_spec_fn=None,
-                 dtype=None):
+                 dtype=None, bucket_bytes=None):
         jax = _jax()
         self.mesh = mesh if mesh is not None else make_mesh((1,), ("dp",),
                                                             jax.devices()[:1])
@@ -136,6 +151,12 @@ class FusedTrainStep:
         self._weight_decay = weight_decay
         self._param_spec_fn = param_spec_fn
         self._dtype = dtype
+        # bucketed backward-overlapped gradient exchange (buckets.py):
+        # None = MXNET_KVSTORE_BUCKET_BYTES (default 4 MiB), 0 = force
+        # the monolithic SPMD reduction
+        self._bucket_bytes = bucket_bytes
+        self._bucketed = False
+        self._bucket_plan = None
         self._built = False
 
     def _build(self, sample_data):
@@ -172,10 +193,13 @@ class FusedTrainStep:
 
         # parameter shardings (tensor parallel hooks)
         self._param_sh = []
+        any_param_spec = False
         for (_, _, p) in self._cached._param_cells:
             spec = None
             if param_spec_fn is not None:
                 spec = param_spec_fn(p.name, p.shape)
+            if spec is not None:
+                any_param_spec = True
             self._param_sh.append(
                 NamedSharding(self.mesh, spec) if spec is not None else rep
             )
@@ -188,11 +212,34 @@ class FusedTrainStep:
         lr, mom_c, wd = learning_rate, momentum, weight_decay
 
         import jax.numpy as _jnp
+        from jax import lax as _lx
 
         compute_dtype = _jnp.dtype(self._dtype) if self._dtype else \
             _jnp.float32
 
-        def step(param_vals, mom_vals, data, label, key_root, ctr):
+        # ---- bucketed backward-overlapped gradient exchange ----------
+        # pure-dp multi-device mesh: compile the step through shard_map
+        # with per-bucket reductions (reverse layer order, buckets.py)
+        # instead of the partitioner's single combined all-reduce.
+        # Tensor-parallel param shardings keep the monolithic SPMD path
+        # (their gradients are not pure dp replicas).
+        from . import buckets as _buckets
+
+        cap = self._bucket_bytes if self._bucket_bytes is not None \
+            else _buckets.bucket_cap_bytes()
+        n_dp = int(self.mesh.devices.size)
+        self._bucketed = bool(
+            cap != 0 and tuple(self.mesh.axis_names) == ("dp",)
+            and n_dp > 1 and not any_param_spec)
+        if self._bucketed:
+            self._bucket_plan = _buckets.partition(
+                [(i, tuple(self._cells[i].data()._data.shape),
+                  self._cells[i].data()._data.dtype)
+                 for i in range(n_params) if i not in aux_idx], cap)
+        plan = self._bucket_plan
+
+        def step_body(param_vals, mom_vals, data, label, key_root, ctr,
+                      sharded: bool):
             # integer batches (uint8 pipelines — 4x less host->device
             # traffic) cast to the compute dtype INSIDE the program,
             # where XLA fuses the cast into the first conv
@@ -201,6 +248,9 @@ class FusedTrainStep:
             # fold the per-step counter inside the fused program: no
             # separate host-side fold_in dispatch per step
             key = jax.random.fold_in(key_root, ctr)
+            if sharded:
+                # decorrelate per-device random ops (dropout masks)
+                key = jax.random.fold_in(key, _lx.axis_index("dp"))
             diff = {i: v for i, v in enumerate(param_vals) if i not in aux_idx}
             aux = {i: v for i, v in enumerate(param_vals) if i in aux_idx}
 
@@ -225,6 +275,15 @@ class FusedTrainStep:
             (loss_val, (new_aux, logits)), grads = jax.value_and_grad(
                 maybe_checkpoint(pure_loss), has_aux=True)(diff)
 
+            if sharded:
+                # pmean of the per-device grads of the per-device mean
+                # loss = the global-batch gradient; issued per bucket in
+                # reverse layer order so later-layer reductions overlap
+                # earlier-layer backward compute
+                grads = _buckets.bucketed_reduce(grads, plan, "dp",
+                                                 n=n_dp, mean=True)
+                loss_val = _lx.pmean(loss_val, "dp")
+
             new_params = []
             new_moms = []
             aux_iter = iter(new_aux)
@@ -238,6 +297,30 @@ class FusedTrainStep:
                     new_params.append(param_vals[i] + m)
                     new_moms.append(m)
             return new_params, new_moms, loss_val, logits
+
+        if self._bucketed:
+            from jax.experimental.shard_map import shard_map
+
+            from ..ops import nn as _nn_ops
+
+            def local_step(param_vals, mom_vals, data, label, key_root,
+                           ctr):
+                # batch-statistics ops (BatchNorm moments, SoftmaxOutput
+                # batch/valid normalization) reduce over dp during this
+                # trace: per-device program, GLOBAL-batch semantics
+                with _nn_ops.cross_device_batch_stats("dp"):
+                    return step_body(param_vals, mom_vals, data, label,
+                                     key_root, ctr, sharded=True)
+
+            step = shard_map(
+                local_step, mesh=self.mesh,
+                in_specs=(P(), P(), P("dp"), P("dp"), P(), P()),
+                out_specs=(P(), P(), P(), P("dp")),
+                check_rep=False)
+        else:
+            def step(param_vals, mom_vals, data, label, key_root, ctr):
+                return step_body(param_vals, mom_vals, data, label,
+                                 key_root, ctr, sharded=False)
 
         donate = (0, 1)  # params + momenta buffers are donated: in-place update
         self._step = jax.jit(
@@ -319,6 +402,29 @@ class FusedTrainStep:
         self._placed = False
         self._built = True
 
+    @property
+    def bucketed(self) -> bool:
+        """True once built on the bucketed shard_map path."""
+        return self._built and self._bucketed
+
+    def bucket_accounting(self):
+        """Per-bucket collective accounting rows ({bucket, n_grads,
+        bytes, dtype}; None on the monolithic path)."""
+        if not (self._built and self._bucketed):
+            return None
+        from . import buckets as _buckets
+
+        return _buckets.accounting(self._bucket_plan)
+
+    def _stamp_bucket_telemetry(self):
+        """Per-bucket comms spans + byte counters (PR-1 telemetry layer)
+        at dispatch time — the reductions execute inside XLA, so these
+        record the issue schedule."""
+        if self._bucketed:
+            from . import buckets as _buckets
+
+            _buckets.stamp_profiler(self._bucket_plan)
+
     def _place_params(self):
         jax = _jax()
         for p, sh in zip(self._cells, self._param_sh):
@@ -385,6 +491,7 @@ class FusedTrainStep:
         self._key_ctr += k
         new_params, self._moms, losses = runner(
             params, self._moms, raw_data, raw_label, self._key_root, ctr0)
+        self._stamp_bucket_telemetry()
         self._param_vals = new_params
         for i, (p, v) in enumerate(zip(self._cells, new_params)):
             cell = p.data()
@@ -462,6 +569,7 @@ class FusedTrainStep:
             params, self._moms, raw_data, raw_label, self._key_root,
             self._key_ctr
         )
+        self._stamp_bucket_telemetry()
         self._param_vals = new_params
         for i, (p, v) in enumerate(zip(self._cells, new_params)):
             cell = p.data()

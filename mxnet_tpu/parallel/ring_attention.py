@@ -40,7 +40,7 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
     B, t, H, D = q.shape
     if sm_scale is None:
         sm_scale = D ** -0.5
-    n = lax.axis_size(axis_name)
+    n = lax.psum(1, axis_name)  # axis size (lax.axis_size needs jax>=0.6)
     my_idx = lax.axis_index(axis_name)
 
     q_pos = my_idx * t + jnp.arange(t)  # global positions of resident Q
@@ -89,8 +89,10 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
     the full output, computed ring-parallel over ``mesh[axis_name]``."""
     from jax.sharding import PartitionSpec as P
 
+    from jax.experimental.shard_map import shard_map
+
     spec = P(None, axis_name, None, None)
     fn = functools.partial(ring_attention, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)

@@ -31,7 +31,7 @@ def ulysses_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None,
     local attention over the full sequence for H/n heads
     all_to_all #2: (B, T, H/n, D) → (B, T/n, H, D)   [restore]
     """
-    n = lax.axis_size(axis_name)
+    n = lax.psum(1, axis_name)  # axis size (lax.axis_size needs jax>=0.6)
     H = q.shape[2]
     assert H % n == 0, "num heads %d must divide sp axis size %d" % (H, n)
     if attn_fn is None:
@@ -59,8 +59,10 @@ def ulysses_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
     """Global-view convenience over full (B, T, H, D) arrays."""
     from jax.sharding import PartitionSpec as P
 
+    from jax.experimental.shard_map import shard_map
+
     spec = P(None, axis_name, None, None)
     fn = functools.partial(ulysses_attention, axis_name=axis_name,
                           causal=causal, sm_scale=sm_scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)

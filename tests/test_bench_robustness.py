@@ -129,6 +129,27 @@ def test_deadline_leaves_emit_margin():
     assert m and float(m.group(1)) >= 120.0
 
 
+def test_round6_budget_and_emission_order():
+    """Round-6 contract: default budget <= 1000 s (self-deadline fires
+    inside a 1200 s external window) and the emission order is one bf16
+    headline row -> fit probe at the cheapest rung -> memory -> fp32."""
+    import re
+
+    src = open(os.path.join(HERE, "bench.py")).read()
+    m = re.search(r'BENCH_BUDGET_S\s*=\s*float\(os\.environ\.get\('
+                  r'"BENCH_BUDGET_S",\s*"(\d+(?:\.\d+)?)"\)\)', src)
+    assert m and float(m.group(1)) <= 1000.0
+    i1 = src.index("phase 1: ONE bf16 headline")
+    i2 = src.index("phase 2: Module.fit probe")
+    i3 = src.index("phase 3: remat memory")
+    i3b = src.index("phase 3b: fp32 headline")
+    assert i1 < i2 < i3 < i3b
+    # the bf16 row is the only phase-1 headline row
+    hm = re.search(r"HEADLINE_CONFIGS = \[\n(.*?)\]", src, re.S)
+    assert hm and "bfloat16" in hm.group(1) and \
+        "float32" not in hm.group(1)
+
+
 def test_budget_default_inside_driver_window():
     """r3 regression: the 4200 s default demonstrably exceeded the
     driver's timeout.  Pin the SOURCE default (not any env override the
