@@ -876,6 +876,22 @@ def _emit_final(reason=None):
         "memory": _STATE["memory"],
         "mfu_attribution": _STATE["mfu_attribution"],
     }
+    # which reduction schedule produced these numbers: the bucketing
+    # config + the last bucket plan the FusedTrainStep runs stamped into
+    # the flight-recorder header (diagnostics.py) — BENCH artifacts are
+    # self-describing about the gradient-exchange schedule
+    try:
+        from mxnet_tpu import diagnostics as _diag
+        from mxnet_tpu.parallel import buckets as _buckets
+
+        out["bucketing"] = {
+            "bucket_bytes_cap": _buckets.bucket_cap_bytes(),
+            "impl": _buckets.impl_name(),
+            "chained": _buckets.chain_enabled(),
+            "plan": _diag.bucket_plan(),
+        }
+    except Exception:
+        pass
     if reason:
         out["truncated"] = reason
     print(json.dumps(out), flush=True)

@@ -39,6 +39,7 @@ from . import io
 from . import recordio
 from . import image
 from . import profiler
+from . import diagnostics
 from . import monitor
 from . import monitor as mon  # ref: python/mxnet/__init__.py:63 alias
 from .monitor import Monitor

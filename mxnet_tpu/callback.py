@@ -68,7 +68,30 @@ class Speedometer:
 
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                from . import diagnostics as _diag
+
+                elapsed = time.time() - self.tic
+                if elapsed > 0:
+                    speed = self.frequent * self.batch_size / elapsed
+                else:
+                    # `frequent` batches completed within clock
+                    # resolution: the interval quotient is a
+                    # ZeroDivisionError (or inf) — report the metrics
+                    # registry's smoothed samples/s instead
+                    speed = _diag.samples_per_second() or 0.0
+                try:
+                    # Speedometer fires are the cheap place to fold the
+                    # slow-moving registry gauges (allocator peak is too
+                    # hot for every step on backends that fall back to
+                    # live-buffer accounting)
+                    _diag.metrics.gauge(
+                        "mxnet_speedometer_samples_per_second",
+                        help="throughput over the last Speedometer "
+                             "interval").set(speed)
+                    _diag.sample_allocator_peak()
+                    _diag.metrics.maybe_flush()
+                except Exception:
+                    pass
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

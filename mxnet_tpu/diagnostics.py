@@ -1,0 +1,1192 @@
+"""mx.diagnostics — runtime health: flight recorder, recompile
+tracking, step-metrics registry.
+
+The profiler (profiler.py) records what happened on one healthy rank;
+this module records enough to explain a hung, desynced or slow FLEET —
+the gap NCCL/PyTorch-style flight recorders and MLPerf structured run
+logs close.  Three cooperating pieces:
+
+  * **Collective flight recorder** — a lock-protected ring buffer
+    (``MXNET_FLIGHT_RECORDER_SIZE``, default 256; 0 disables) holding
+    the last N collectives this process issued: kvstore push/pull/
+    allreduce and every per-bucket reduction dispatched by
+    ``FusedTrainStep``/``KVStoreTPU``.  Each entry carries a
+    monotonically increasing collective seq number, op, bucket id,
+    keys, payload bytes, dtype, rank, enqueue/complete wall-clock
+    timestamps and a completion state.  Dumped to
+    ``flightrecorder_rank{K}.json`` on demand (:func:`dump`), at
+    interpreter exit (via profiler.py's shared shutdown path — always
+    when ``MXNET_FLIGHT_RECORDER_DUMP`` is set, and unconditionally
+    when any entry is still in flight, i.e. the rank died mid-
+    collective), and on SIGTERM/SIGUSR1.  A watchdog
+    (``MXNET_COLLECTIVE_TIMEOUT_S``) marks entries in flight longer
+    than the timeout as ``suspect`` and dumps WITHOUT killing the run.
+    ``tools/merge_traces.py --health`` ingests the per-rank dumps and
+    names the rank + seq/bucket/key a desynced fleet diverged at.
+
+  * **Recompile tracking** — :func:`instrument_jit` wraps the compiled
+    step callables (FusedTrainStep's jits, Module.fit's bulk scan) and
+    counts/times every XLA compilation they trigger (via the jitted
+    function's ``_cache_size`` when the toolchain exposes it, aval-
+    signature tracking otherwise), stamps ``compile`` spans into the
+    trace, and — because a silent recompilation storm (shape/dtype
+    churn) can double step time with no error anywhere — emits one loud
+    warning per step function when it compiles more than
+    ``MXNET_RECOMPILE_WARN_N`` (default 1) times, with the offending
+    avals in the message.  :func:`recompile_stats` is the queryable
+    surface.
+
+  * **Step-metrics registry** — a small gauge/counter/histogram
+    time-series registry (:data:`metrics`) fed by ``fit()`` and
+    ``Speedometer``: step_time, samples/s, loss, allocator peak,
+    recompiles, kvstore/io bytes.  ``dump_json()`` for bench.py,
+    ``to_prom()`` Prometheus text exposition for external scrapers,
+    ``MXNET_METRICS_FILE`` (+ ``MXNET_METRICS_INTERVAL_S``) for a
+    periodically flushed exposition file.
+
+``python -m mxnet_tpu.diagnostics --self-test`` exercises ring-buffer
+wraparound, the signal-handler dump and prom-text rendering (tier-1 CI
+via tests/test_diagnostics.py).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "FlightRecorder", "recorder", "record_collective", "record_start",
+    "record_complete", "set_bucket_plan", "bucket_plan", "dump",
+    "flight_enabled", "instrument_jit", "recompile_stats",
+    "reset_recompile_stats", "Gauge", "Counter", "Histogram",
+    "MetricsRegistry", "metrics", "record_step", "validate_prom_text",
+]
+
+_log = logging.getLogger(__name__)
+
+DEFAULT_RING_SIZE = 256
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: Optional[float]) -> Optional[float]:
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _dump_env() -> Tuple[bool, Optional[str]]:
+    """ONE parse of ``MXNET_FLIGHT_RECORDER_DUMP`` shared by the atexit
+    leg and ``dump_path`` so they can never disagree: returns
+    ``(dump_wanted, path_override)``.  Boolean spellings (any case) are
+    honored both ways — 1/true/yes/on request a dump at the configured
+    path, 0/false/no/off (and unset/empty) disable it; any other value
+    both requests the dump AND carries the output path."""
+    raw = os.environ.get("MXNET_FLIGHT_RECORDER_DUMP")
+    if raw in (None, "") or raw.lower() in ("0", "false", "no", "off"):
+        return False, None
+    if raw.lower() in ("1", "true", "yes", "on"):
+        return True, None
+    return True, raw
+
+
+def _rank_info() -> Tuple[int, int]:
+    """(rank, num_workers) — same precedence as the profiler's trace
+    dumps (explicit set_rank, then launcher env), so the two artifact
+    families always agree on who rank K is."""
+    from . import profiler as _profiler
+
+    return _profiler._dist_info()
+
+
+# ---------------------------------------------------------------------------
+# collective flight recorder
+# ---------------------------------------------------------------------------
+class FlightRecorder:
+    """Ring buffer of the last N collectives issued by this process.
+
+    States: ``in_flight`` (enqueued, not yet returned), ``completed``,
+    ``error`` (the collective raised), ``suspect`` (in flight longer
+    than ``MXNET_COLLECTIVE_TIMEOUT_S`` — stamped by the watchdog).
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = _env_int("MXNET_FLIGHT_RECORDER_SIZE",
+                                DEFAULT_RING_SIZE)
+        self.capacity = max(int(capacity), 0)
+        # reentrant: the SIGTERM/SIGUSR1 handlers dump from the main
+        # thread, which may already hold the lock inside start()
+        self._lock = threading.RLock()
+        self._entries: List[dict] = []   # ring, oldest first
+        self._seq = 0
+        self._dropped = 0                # entries overwritten by the ring
+        self._open: Dict[int, dict] = {}  # seq -> in-flight entry
+        self._bucket_plan: Optional[dict] = None
+        self._bucket_plan_owner: Optional[int] = None
+        self._signals_installed = False
+        self._watchdog: Optional[threading.Thread] = None
+        self._suspect_dumped: set = set()  # seqs already dump-reported
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    # -- recording -----------------------------------------------------
+    def start(self, op: str, keys=None, bucket: Optional[int] = None,
+              nbytes: int = 0, dtype=None, args: Optional[dict] = None
+              ) -> Optional[int]:
+        """Record the enqueue of one collective; returns its seq (None
+        when disabled).  Never raises — a diagnostic must not fail the
+        collective it is recording."""
+        if not self.enabled:
+            return None
+        try:
+            entry = {
+                "seq": -1, "op": str(op),
+                "keys": self._norm_keys(keys),
+                "bucket": None if bucket is None else int(bucket),
+                "bytes": int(nbytes), "dtype": None if dtype is None
+                else str(dtype),
+                "enqueue_ts": time.time(), "complete_ts": None,
+                "state": "in_flight",
+            }
+            if args:
+                entry["args"] = dict(args)
+            with self._lock:
+                entry["seq"] = self._seq
+                self._seq += 1
+                self._entries.append(entry)
+                if len(self._entries) > self.capacity:
+                    evicted = self._entries.pop(0)
+                    self._dropped += 1
+                    self._open.pop(evicted["seq"], None)
+                self._open[entry["seq"]] = entry
+            self._arm()
+            return entry["seq"]
+        except Exception:
+            return None
+
+    def complete(self, seq: Optional[int], state: str = "completed"
+                 ) -> None:
+        if seq is None:
+            return
+        try:
+            with self._lock:
+                entry = self._open.pop(seq, None)
+                if entry is not None:
+                    entry["complete_ts"] = time.time()
+                    entry["state"] = state
+        except Exception:
+            pass
+
+    @staticmethod
+    def _norm_keys(keys) -> Optional[list]:
+        if keys is None:
+            return None
+        if isinstance(keys, (list, tuple)):
+            return [str(k) for k in keys]
+        return [str(keys)]
+
+    # -- state ---------------------------------------------------------
+    def set_bucket_plan(self, plan_meta: Optional[dict],
+                        owner: Optional[int] = None) -> None:
+        """Stamp (or clear) the header's bucket plan.  An owned clear
+        (``plan_meta=None`` with an ``owner`` token) only takes effect
+        when that same owner stamped the current plan: a non-bucketed
+        step building next to a still-live bucketed one must not erase
+        the plan the live step's bucket_reduce entries run under.  An
+        unowned clear is unconditional."""
+        with self._lock:
+            if plan_meta is None and owner is not None and \
+                    self._bucket_plan_owner != owner:
+                return
+            self._bucket_plan = dict(plan_meta) if plan_meta else None
+            self._bucket_plan_owner = owner if plan_meta else None
+
+    def bucket_plan(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._bucket_plan) if self._bucket_plan else None
+
+    def n_recorded(self) -> int:
+        """Total collectives ever recorded (ring evictions included)."""
+        with self._lock:
+            return self._seq
+
+    def last_completed_seq(self) -> int:
+        """Highest seq with state completed (-1 if none)."""
+        with self._lock:
+            done = [e["seq"] for e in self._entries
+                    if e["state"] == "completed"]
+        return max(done) if done else -1
+
+    def in_flight(self) -> List[dict]:
+        with self._lock:
+            return [dict(e) for e in self._entries
+                    if e["state"] in ("in_flight", "suspect")]
+
+    def snapshot(self) -> Tuple[dict, List[dict]]:
+        """(header, entries) under one lock acquisition."""
+        rank, num_workers = _rank_info()
+        with self._lock:
+            header = {
+                "flight_recorder": True,
+                "rank": rank, "num_workers": num_workers,
+                "capacity": self.capacity, "next_seq": self._seq,
+                "dropped": self._dropped,
+                "bucket_plan": dict(self._bucket_plan)
+                if self._bucket_plan else None,
+                "pid": os.getpid(), "dump_ts": time.time(),
+            }
+            entries = [dict(e) for e in self._entries]
+        return header, entries
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._open.clear()
+            self._seq = 0
+            self._dropped = 0
+            self._suspect_dumped.clear()
+
+    # -- dumps ---------------------------------------------------------
+    def dump_path(self, base: Optional[str] = None) -> str:
+        """``flightrecorder_rank{K}.json`` — the rank suffix is always
+        present (rank 0 of 1 included) so ``--health`` can glob one
+        pattern on any fleet size."""
+        if base is None:
+            base = os.environ.get("MXNET_FLIGHT_RECORDER_FILE",
+                                  "flightrecorder.json")
+            _, path_override = _dump_env()
+            if path_override:
+                base = path_override  # the dump flag may carry the path
+        rank, _ = _rank_info()
+        root, ext = os.path.splitext(base)
+        return "%s_rank%d%s" % (root, rank, ext or ".json")
+
+    def dump(self, path: Optional[str] = None, reason: str = "on_demand"
+             ) -> Optional[str]:
+        """Persist the ring to JSON; returns the path (None when
+        disabled).  Safe to call from signal handlers and atexit."""
+        if not self.enabled:
+            return None
+        try:
+            header, entries = self.snapshot()
+            header["reason"] = reason
+            fname = path if path is not None else self.dump_path()
+            with open(fname, "w") as f:
+                json.dump({"header": header, "entries": entries}, f)
+            return fname
+        except Exception:
+            return None
+
+    # -- signal handlers + watchdog -------------------------------------
+    def _arm(self) -> None:
+        """First-record arming: signal handlers (main thread only) and
+        the collective watchdog (when the timeout env is set)."""
+        if not self._signals_installed:
+            self.install_signal_handlers()
+        timeout = _env_float("MXNET_COLLECTIVE_TIMEOUT_S", None)
+        if timeout and self._watchdog is None:
+            self._start_watchdog(timeout)
+
+    def install_signal_handlers(self) -> bool:
+        """SIGUSR1 dumps without disturbing the run, then chains to any
+        handler the app installed (the default action — terminate — is
+        NOT chained); SIGTERM dumps then chains to the previous handler
+        (default: die) so external timeouts still kill the process AND
+        leave the artifact behind."""
+        if threading.current_thread() is not threading.main_thread():
+            # don't burn the one-shot flag: a later main-thread
+            # collective must still get to install the handlers
+            return False
+        self._signals_installed = True  # one attempt per recorder
+        try:
+            prev_usr1 = signal.getsignal(signal.SIGUSR1)
+
+            def _usr1(signum, frame):
+                self.dump(reason="SIGUSR1")
+                # SIG_DFL/SIG_IGN are not callable: only a handler the
+                # app actually installed runs after the dump
+                if callable(prev_usr1):
+                    prev_usr1(signum, frame)
+
+            prev_term = signal.getsignal(signal.SIGTERM)
+
+            def _term(signum, frame):
+                self.dump(reason="SIGTERM")
+                if prev_term is signal.SIG_IGN:
+                    return  # the app deliberately ignores SIGTERM
+                if callable(prev_term):
+                    prev_term(signum, frame)
+                else:
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGUSR1, _usr1)
+            signal.signal(signal.SIGTERM, _term)
+            return True
+        except (ValueError, OSError, AttributeError):
+            # non-main thread / restricted host / platform without the
+            # signals: recording still works, on-signal dumps don't
+            return False
+
+    def _start_watchdog(self, timeout_s: float) -> None:
+        def loop():
+            period = max(min(timeout_s / 4.0, 5.0), 0.05)
+            while True:
+                time.sleep(period)
+                try:
+                    self.check_timeouts(timeout_s)
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=loop, name="mx-collective-watchdog",
+                             daemon=True)
+        self._watchdog = t
+        t.start()
+
+    def check_timeouts(self, timeout_s: float) -> int:
+        """Mark in-flight entries older than ``timeout_s`` as suspect;
+        dump when NEW suspects appeared.  Returns the suspect count.
+        (The watchdog calls this on its period; tests call it
+        directly.)"""
+        now = time.time()
+        n_suspect = 0
+        with self._lock:
+            suspects = set()
+            for e in self._entries:
+                if e["state"] == "in_flight" and \
+                        now - e["enqueue_ts"] > timeout_s:
+                    e["state"] = "suspect"
+                if e["state"] == "suspect":
+                    n_suspect += 1
+                    suspects.add(e["seq"])
+            # per-seq tracking, NOT a high-water count: a later hang
+            # with fewer simultaneous suspects than an earlier,
+            # recovered incident must still dump
+            newly = bool(suspects - self._suspect_dumped)
+            self._suspect_dumped |= suspects
+        if newly:
+            _log.warning(
+                "collective watchdog: %d collective(s) in flight longer "
+                "than %.1fs — dumping flight recorder to %s (the run is "
+                "NOT killed)", n_suspect, timeout_s, self.dump_path())
+            self.dump(reason="watchdog_timeout")
+        return n_suspect
+
+
+#: process-wide recorder (capacity from MXNET_FLIGHT_RECORDER_SIZE)
+recorder = FlightRecorder()
+
+
+def flight_enabled() -> bool:
+    return recorder.enabled
+
+
+def record_start(op: str, **kw) -> Optional[int]:
+    return recorder.start(op, **kw)
+
+
+def record_complete(seq: Optional[int], state: str = "completed") -> None:
+    recorder.complete(seq, state)
+
+
+class record_collective:
+    """Context manager recording one collective: entry at enter,
+    completion (or ``error``) at exit.  No-op when disabled."""
+
+    def __init__(self, op: str, keys=None, bucket: Optional[int] = None,
+                 nbytes: int = 0, dtype=None, args: Optional[dict] = None):
+        self._kw = dict(keys=keys, bucket=bucket, nbytes=nbytes,
+                        dtype=dtype, args=args)
+        self._op = op
+        self.seq: Optional[int] = None
+
+    def __enter__(self):
+        self.seq = recorder.start(self._op, **self._kw)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        recorder.complete(self.seq,
+                          "completed" if exc_type is None else "error")
+        return False
+
+
+def set_bucket_plan(plan_meta: Optional[dict],
+                    owner: Optional[int] = None) -> None:
+    """Stamp the bucket plan (count/bytes/cap — buckets.plan_meta) into
+    the flight-recorder header so every dump is self-describing about
+    which reduction schedule produced it.  Step builders pass their
+    ``id()`` as ``owner`` so a monolithic rebuild only clears its OWN
+    stale plan, never one a different live bucketed step stamped."""
+    recorder.set_bucket_plan(plan_meta, owner=owner)
+
+
+def bucket_plan() -> Optional[dict]:
+    return recorder.bucket_plan()
+
+
+def dump(path: Optional[str] = None) -> Optional[str]:
+    """On-demand flight-recorder dump -> flightrecorder_rank{K}.json."""
+    return recorder.dump(path=path, reason="on_demand")
+
+
+def _atexit_dump() -> None:
+    """The flight-recorder leg of profiler.py's shared shutdown path:
+    dump when explicitly requested (MXNET_FLIGHT_RECORDER_DUMP) or when
+    any collective never completed (the rank died mid-run — exactly the
+    evidence --health needs); always flush the metrics file if one is
+    configured."""
+    try:
+        want, _ = _dump_env()
+        # n_recorded guard: a process that never issued a collective
+        # (the PS scheduler/server, which inherits the launcher env and
+        # may share rank 0's dump name) must not overwrite a worker's
+        # evidence with an empty ring
+        if recorder.enabled and recorder.n_recorded() and \
+                (want or recorder.in_flight()):
+            recorder.dump(reason="atexit")
+    except Exception:
+        pass
+    try:
+        metrics.flush()
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# recompile tracking
+# ---------------------------------------------------------------------------
+_recompile_lock = threading.RLock()
+_recompile: Dict[str, dict] = {}
+_recompile_warned: Dict[str, bool] = {}
+
+
+def _warn_threshold() -> int:
+    return _env_int("MXNET_RECOMPILE_WARN_N", 1)
+
+
+def _avals_of(args) -> tuple:
+    """Hashable (shape, dtype) signature of a call's array arguments —
+    the churn axis recompilation warnings report."""
+    sig = []
+
+    def visit(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype)))
+        elif isinstance(x, (list, tuple)):
+            for y in x:
+                visit(y)
+        elif isinstance(x, dict):
+            for y in x.values():
+                visit(y)
+
+    for a in args:
+        visit(a)
+    return tuple(sig)
+
+
+class _InstrumentedJit:
+    """Transparent wrapper around one jitted callable: detects the calls
+    that compiled (``_cache_size`` growth where available, first-seen
+    aval signature otherwise), times them, stamps ``compile`` trace
+    spans, feeds the recompile registry + metrics, and warns once per
+    name on shape/dtype churn.  Every other attribute (``lower``, …)
+    delegates to the wrapped function."""
+
+    def __init__(self, name: str, fn):
+        self._name = name
+        self._fn = fn
+        self._seen: set = set()
+        with _recompile_lock:
+            _recompile.setdefault(name, {
+                "count": 0, "total_ms": 0.0, "max_ms": 0.0,
+                "avals": [], "last_ms": 0.0})
+
+    def _cache_size(self) -> Optional[int]:
+        try:
+            return int(self._fn._cache_size())
+        except Exception:
+            return None
+
+    def __call__(self, *args, **kwargs):
+        before = self._cache_size()
+        avals = None
+        fresh_sig = False
+        if before is None:
+            # no cache introspection on this jax: first-seen aval
+            # signatures are the detector, so the per-call walk is
+            # unavoidable here — with introspection it is skipped
+            # (FusedTrainStep.step passes hundreds of param arrays
+            # per batch; hashing them every call is pure overhead)
+            avals = _avals_of(args)
+            fresh_sig = avals not in self._seen
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        after = self._cache_size()
+        if after is not None and before is not None:
+            compiled = after > before
+        else:
+            compiled = fresh_sig
+        if avals is not None:
+            self._seen.add(avals)
+        if compiled:
+            if avals is None:
+                avals = _avals_of(args)  # pay the walk on compiles only
+            self._record_compile(avals, dur_ms)
+        return out
+
+    def _record_compile(self, avals, dur_ms: float) -> None:
+        with _recompile_lock:
+            # setdefault, not index: reset_recompile_stats() may have
+            # cleared the row seeded by __init__
+            st = _recompile.setdefault(self._name, {
+                "count": 0, "total_ms": 0.0, "max_ms": 0.0,
+                "avals": [], "last_ms": 0.0})
+            st["count"] += 1
+            st["total_ms"] += dur_ms
+            st["last_ms"] = dur_ms
+            st["max_ms"] = max(st["max_ms"], dur_ms)
+            st["avals"].append([list(s) + [d] for s, d in avals[:8]])
+            st["avals"] = st["avals"][-8:]  # keep the recent churn only
+            count = st["count"]
+            recent = st["avals"]
+            warned = _recompile_warned.get(self._name, False)
+        try:
+            from . import profiler as _profiler
+
+            if _profiler.is_running():
+                now = _profiler._now_us()
+                _profiler.record_span("jit_compile::" + self._name,
+                                      now - dur_ms * 1e3, dur_ms * 1e3,
+                                      cat="compile",
+                                      args={"n_compiles": count})
+        except Exception:
+            pass
+        try:
+            metrics.counter("mxnet_jit_compiles_total",
+                            help="XLA compilations of instrumented step "
+                                 "functions").inc()
+            metrics.gauge("mxnet_jit_compile_ms_last").set(dur_ms)
+        except Exception:
+            pass
+        if count > _warn_threshold() and not warned:
+            with _recompile_lock:
+                _recompile_warned[self._name] = True
+            _log.warning(
+                "RECOMPILATION STORM: step function %r compiled %d times "
+                "— input shape/dtype churn is forcing jax.jit to retrace "
+                "(each compile costs seconds and doubles step time while "
+                "it lasts). Recent call avals (shape+dtype per array "
+                "arg): %s. Pad/bucketize inputs to a fixed set of shapes "
+                "or pin the dtype to stop the churn.",
+                self._name, count, recent)
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def instrument_jit(name: str, fn):
+    """Wrap one jitted callable for recompile tracking (dp.py / bulk.py
+    step builders).  Idempotent on the name: re-wrapping after a
+    rebuild keeps accumulating into the same stats row."""
+    return _InstrumentedJit(name, fn)
+
+
+def recompile_stats() -> Dict[str, dict]:
+    """{name: {count, total_ms, max_ms, last_ms, avals}} for every
+    instrumented step function (plus backend-reported compile time when
+    jax.monitoring delivered it)."""
+    with _recompile_lock:
+        return {k: dict(v) for k, v in _recompile.items()}
+
+
+def reset_recompile_stats() -> None:
+    with _recompile_lock:
+        _recompile.clear()
+        _recompile_warned.clear()
+
+
+def _register_jax_monitoring() -> None:
+    """Fold the backend's own compile-time events (jax.monitoring
+    '/jax/core/compile' family) into the stats where the toolchain
+    exposes a listener hook — best-effort, the wrapper above is the
+    portable instrument."""
+    try:
+        from jax._src import monitoring as _mon
+
+        def _listener(event: str, duration: float, **kw):
+            if "compile" not in event:
+                return
+            with _recompile_lock:
+                st = _recompile.setdefault("jax_backend:" + event, {
+                    "count": 0, "total_ms": 0.0, "max_ms": 0.0,
+                    "avals": [], "last_ms": 0.0})
+                ms = duration * 1e3
+                st["count"] += 1
+                st["total_ms"] += ms
+                st["last_ms"] = ms
+                st["max_ms"] = max(st["max_ms"], ms)
+
+        _mon.register_event_duration_secs_listener(_listener)
+    except Exception:
+        pass
+
+
+_register_jax_monitoring()
+
+
+# ---------------------------------------------------------------------------
+# step-metrics registry (gauge / counter / histogram, prom exposition)
+# ---------------------------------------------------------------------------
+def _prom_name(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        ok = ch.isalnum() or ch in "_:"
+        if ch.isdigit() and i == 0:
+            out.append("_")
+        out.append(ch if ok else "_")
+    return "".join(out)
+
+
+def _prom_labels(labels: Optional[dict]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        '%s="%s"' % (_prom_name(str(k)),
+                     str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in sorted(labels.items()))
+    return "{%s}" % inner
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"  # a diverged loss must still export, not crash
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class Gauge:
+    """Last-write-wins scalar (step_time, loss, allocator peak)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "", labels=None):
+        self.name, self.help, self.labels = name, help, labels
+        self._lock = threading.Lock()
+        self.value: Optional[float] = None
+        self.updated_ts: Optional[float] = None
+
+    def set(self, value) -> None:
+        with self._lock:
+            self.value = float(value)
+            self.updated_ts = time.time()
+
+    def sample_lines(self) -> List[str]:
+        with self._lock:
+            v = self.value
+        if v is None:
+            return []
+        return ["%s%s %s" % (_prom_name(self.name),
+                             _prom_labels(self.labels), _fmt(v))]
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {"type": "gauge", "value": self.value,
+                    "updated_ts": self.updated_ts,
+                    "labels": self.labels or None}
+
+
+class Counter:
+    """Monotonic accumulator (samples seen, kvstore bytes, recompiles)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "", labels=None):
+        self.name, self.help, self.labels = name, help, labels
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def inc(self, delta=1) -> None:
+        if delta < 0:
+            raise ValueError("counters only go up (got %r)" % (delta,))
+        with self._lock:
+            self.value += float(delta)
+
+    def sample_lines(self) -> List[str]:
+        with self._lock:
+            v = self.value
+        return ["%s%s %s" % (_prom_name(self.name),
+                             _prom_labels(self.labels), _fmt(v))]
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {"type": "counter", "value": self.value,
+                    "labels": self.labels or None}
+
+
+# seconds-scale latencies: 1ms .. 60s
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Histogram:
+    """Cumulative-bucket histogram, prom exposition semantics
+    (``_bucket{le=...}`` counts are cumulative; ``+Inf`` == count)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", labels=None,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name, self.help, self.labels = name, help, labels
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +1: +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value) -> None:
+        v = float(value)
+        with self._lock:
+            self.sum += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+
+    def _cumulative(self) -> List[int]:
+        out, acc = [], 0
+        for c in self._counts:
+            acc += c
+            out.append(acc)
+        return out
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Approximate q-quantile from the bucket upper bounds (the
+        straggler analysis' p50/p99)."""
+        with self._lock:
+            if not self.count:
+                return None
+            target = q * self.count
+            cum = self._cumulative()
+        for i, c in enumerate(cum):
+            if c >= target:
+                return self.buckets[i] if i < len(self.buckets) \
+                    else self.buckets[-1]
+        return self.buckets[-1]
+
+    def sample_lines(self) -> List[str]:
+        name = _prom_name(self.name)
+        base = dict(self.labels or {})
+        with self._lock:
+            cum = self._cumulative()
+            s, n = self.sum, self.count
+        lines = []
+        for b, c in zip(self.buckets, cum[:-1]):
+            lines.append("%s_bucket%s %d"
+                         % (name, _prom_labels({**base, "le": _fmt(b)}), c))
+        lines.append("%s_bucket%s %d"
+                     % (name, _prom_labels({**base, "le": "+Inf"}), cum[-1]))
+        lines.append("%s_sum%s %s" % (name, _prom_labels(self.labels),
+                                      _fmt(s)))
+        lines.append("%s_count%s %d" % (name, _prom_labels(self.labels), n))
+        return lines
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {"type": "histogram", "count": self.count,
+                    "sum": self.sum,
+                    "buckets": {_fmt(b): c for b, c in
+                                zip(self.buckets, self._cumulative()[:-1])},
+                    "labels": self.labels or None}
+
+
+class MetricsRegistry:
+    """Named-metric registry with one instance per (name, labels) pair;
+    ``to_prom()`` renders the whole registry as Prometheus text
+    exposition, ``dump_json()`` as a machine-readable dict, ``flush()``
+    writes the MXNET_METRICS_FILE exposition (rate-limited by
+    MXNET_METRICS_INTERVAL_S, default 30s; ``force=True`` bypasses)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, frozenset], Any] = {}
+        self._last_flush = 0.0
+
+    def _get(self, cls, name: str, help: str, labels, **kw):
+        key = (name, frozenset((labels or {}).items()))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, help=help, labels=labels, **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError("metric %r already registered as %s"
+                                % (name, type(m).__name__))
+            return m
+
+    def gauge(self, name: str, help: str = "", labels=None) -> Gauge:
+        return self._get(Gauge, name, help, labels)
+
+    def counter(self, name: str, help: str = "", labels=None) -> Counter:
+        return self._get(Counter, name, help, labels)
+
+    def histogram(self, name: str, help: str = "", labels=None,
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, labels, buckets=buckets)
+
+    def _sorted(self):
+        with self._lock:
+            items = list(self._metrics.values())
+        return sorted(items, key=lambda m: (m.name,
+                                            str(m.labels or "")))
+
+    def to_prom(self) -> str:
+        """Prometheus text exposition (one HELP/TYPE block per metric
+        name, samples after) — the format node_exporter serves."""
+        lines: List[str] = []
+        seen_hdr = set()
+        for m in self._sorted():
+            pname = _prom_name(m.name)
+            if pname not in seen_hdr:
+                seen_hdr.add(pname)
+                if m.help:
+                    lines.append("# HELP %s %s"
+                                 % (pname, m.help.replace("\n", " ")))
+                lines.append("# TYPE %s %s" % (pname, m.kind))
+            lines.extend(m.sample_lines())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def dump_json(self) -> dict:
+        out: Dict[str, Any] = {}
+        for m in self._sorted():
+            d = m.to_dict()
+            key = m.name if not m.labels else \
+                m.name + _prom_labels(m.labels)
+            out[key] = d
+        rank, num_workers = _rank_info()
+        return {"rank": rank, "num_workers": num_workers,
+                "ts": time.time(), "metrics": out}
+
+    def flush(self, path: Optional[str] = None, force: bool = True
+              ) -> Optional[str]:
+        if path is None:
+            path = os.environ.get("MXNET_METRICS_FILE")
+        if not path:
+            return None
+        # no `or` fallback: MXNET_METRICS_INTERVAL_S=0 legitimately
+        # means flush on every step
+        interval = _env_float("MXNET_METRICS_INTERVAL_S", 30.0)
+        now = time.time()
+        with self._lock:
+            if not force and now - self._last_flush < interval:
+                return None
+            self._last_flush = now
+        rank, num_workers = _rank_info()
+        if num_workers > 1:
+            root, ext = os.path.splitext(path)
+            path = "%s_rank%d%s" % (root, rank, ext or ".prom")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(self.to_prom())
+            os.replace(tmp, path)  # scrapers never see a torn file
+            return path
+        except OSError:
+            return None
+
+    def maybe_flush(self) -> Optional[str]:
+        """Rate-limited flush — the per-step feed calls this so a
+        configured MXNET_METRICS_FILE stays fresh without a writer
+        thread."""
+        return self.flush(force=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._last_flush = 0.0
+
+
+#: process-wide registry — fit()/Speedometer/kvstore/io feed it
+metrics = MetricsRegistry()
+
+
+def record_step(step_time_s: float, samples: Optional[int] = None,
+                metric_values=None) -> None:
+    """One training step's worth of registry updates (fed by fit() and
+    FusedTrainStep callers): step-time histogram + gauge, samples/s,
+    cumulative sample count, and the evaluation-metric gauges."""
+    try:
+        metrics.histogram("mxnet_step_time_seconds",
+                          help="wall time of one optimizer step"
+                          ).observe(step_time_s)
+        metrics.gauge("mxnet_step_time_seconds_last").set(step_time_s)
+        if samples:
+            metrics.counter("mxnet_samples_total",
+                            help="training samples consumed").inc(samples)
+            if step_time_s > 0:
+                metrics.gauge("mxnet_samples_per_second",
+                              help="training throughput"
+                              ).set(samples / step_time_s)
+        for name, value in (metric_values or ()):
+            try:
+                metrics.gauge("mxnet_train_metric",
+                              help="per-batch training metric",
+                              labels={"metric": str(name)}).set(value)
+            except (TypeError, ValueError):
+                pass  # non-scalar metric values have no gauge form
+        metrics.maybe_flush()
+    except Exception:
+        pass  # telemetry must never fail the training loop
+
+
+def feed_kvstore_bytes(op: str, nbytes: int) -> None:
+    """Cumulative ``mxnet_kvstore_bytes_total{op=...}`` feed — the ONE
+    place the metric name/help live, shared by kvstore.py's verb fast
+    paths and buckets.stamp_profiler.  Guarded so telemetry can never
+    fail the collective it measures."""
+    try:
+        metrics.counter("mxnet_kvstore_bytes_total",
+                        help="cumulative kvstore payload bytes",
+                        labels={"op": op}).inc(int(nbytes))
+    except Exception:
+        pass
+
+
+def feed_io_bytes(nbytes: int) -> None:
+    """Cumulative ``mxnet_io_bytes_total`` feed for io.py's fetch path —
+    guarded so telemetry can never fail the input pipeline."""
+    try:
+        metrics.counter("mxnet_io_bytes_total",
+                        help="host bytes materialized by the "
+                             "input pipeline").inc(int(nbytes))
+    except Exception:
+        pass
+
+
+def samples_per_second() -> Optional[float]:
+    """The registry's current samples/s gauge (Speedometer's fallback
+    when its own wall-clock interval is below clock resolution)."""
+    g = metrics.gauge("mxnet_samples_per_second")
+    return g.value
+
+
+def sample_allocator_peak() -> None:
+    """Fold the allocator's peak bytes into the registry (fed on
+    Speedometer fires — cheap enough there, too hot for every step on
+    backends that fall back to live-buffer accounting)."""
+    try:
+        from . import profiler as _profiler
+
+        m = _profiler._memory_bytes()
+        if m is None:
+            return
+        in_use, peak = m
+        metrics.gauge("mxnet_memory_bytes_in_use",
+                      help="device allocator bytes in use").set(in_use)
+        metrics.gauge("mxnet_memory_peak_bytes",
+                      help="device allocator peak bytes").set(peak)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# prom-text validation (used by the self-test and tests)
+# ---------------------------------------------------------------------------
+def validate_prom_text(text: str) -> List[str]:
+    """Validate Prometheus text-exposition syntax + histogram
+    invariants; returns a list of problems (empty == valid)."""
+    import re
+
+    problems: List[str] = []
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+        r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+        r" (NaN|[+-]?Inf|[+-]?[0-9.eE+-]+)$")
+    label_re = re.compile(r"([a-zA-Z_][a-zA-Z0-9_]*)=\"([^\"]*)\"")
+
+    def label_key(labels: str, drop: str = "le") -> frozenset:
+        return frozenset((k, v) for k, v in label_re.findall(labels or "")
+                         if k != drop)
+
+    typed: Dict[str, str] = {}
+    hist_counts: Dict[Tuple[str, frozenset], float] = {}
+    hist_inf: Dict[Tuple[str, frozenset], float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line:
+            problems.append("line %d: empty line" % lineno)
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                    "gauge", "counter", "histogram", "summary", "untyped"):
+                problems.append("line %d: bad TYPE line" % lineno)
+            else:
+                typed[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if not m:
+            problems.append("line %d: unparsable sample %r" % (lineno, line))
+            continue
+        name, labels = m.group(1), m.group(2) or ""
+        value = float(m.group(3).replace("Inf", "inf"))
+        if name.endswith("_count") and typed.get(name[:-6]) == "histogram":
+            hist_counts[(name[:-6], label_key(labels))] = value
+        if name.endswith("_bucket") and 'le="+Inf"' in labels:
+            hist_inf[(name[:-7], label_key(labels))] = value
+    for key, count in hist_counts.items():
+        # exposition contract: the +Inf bucket equals _count
+        inf = hist_inf.get(key)
+        if inf is None:
+            problems.append("histogram %s: no +Inf bucket" % (key,))
+        elif inf != count:
+            problems.append("histogram %s: +Inf bucket %s != count %s"
+                            % (key, inf, count))
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m mxnet_tpu.diagnostics --self-test
+# (mirrors python -m mxnet_tpu.parallel.overlap --self-test)
+# ---------------------------------------------------------------------------
+def _self_test() -> Tuple[bool, Dict[str, bool]]:
+    import tempfile
+
+    checks: Dict[str, bool] = {}
+
+    # 1) ring-buffer wraparound: 20 entries through capacity 8 keeps the
+    # LAST 8, drops 12, seqs stay monotonic
+    fr = FlightRecorder(capacity=8)
+    for i in range(20):
+        with_seq = fr.start("push", keys=["k%d" % i], nbytes=64,
+                            dtype="float32")
+        fr.complete(with_seq)
+    header, entries = fr.snapshot()
+    seqs = [e["seq"] for e in entries]
+    checks["ring_len==capacity"] = len(entries) == 8
+    checks["ring_dropped==12"] = header["dropped"] == 12
+    checks["ring_keeps_latest"] = seqs == list(range(12, 20))
+    checks["ring_all_completed"] = all(e["state"] == "completed"
+                                       for e in entries)
+
+    # 2) suspect marking: an entry left in flight past the timeout
+    fr2 = FlightRecorder(capacity=8)
+    fr2.start("allreduce", bucket=7, keys=["w3"], nbytes=1 << 20,
+              dtype="float32")
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "wd.json")
+        orig_dump_path = fr2.dump_path
+        fr2.dump_path = lambda base=None: path
+        try:
+            time.sleep(0.02)
+            n = fr2.check_timeouts(0.01)
+        finally:
+            fr2.dump_path = orig_dump_path
+        checks["watchdog_suspect"] = n == 1
+        try:
+            with open(path) as f:
+                wd = json.load(f)
+            checks["watchdog_dumped"] = (
+                wd["header"]["reason"] == "watchdog_timeout"
+                and wd["entries"][0]["state"] == "suspect"
+                and wd["entries"][0]["bucket"] == 7)
+        except OSError:
+            checks["watchdog_dumped"] = False
+
+    # 3) signal-handler dump: SIGUSR1 to self persists the ring and the
+    # process lives on
+    ok_sig = False
+    if hasattr(signal, "SIGUSR1"):
+        with tempfile.TemporaryDirectory() as d:
+            fr3 = FlightRecorder(capacity=4)
+            s = fr3.start("push", keys=["sig"], nbytes=8, dtype="float32")
+            fr3.complete(s)
+            path = os.path.join(d, "sig.json")
+            fr3.dump_path = lambda base=None: path
+            if fr3.install_signal_handlers():
+                os.kill(os.getpid(), signal.SIGUSR1)
+                deadline = time.time() + 2.0
+                while time.time() < deadline and not os.path.exists(path):
+                    time.sleep(0.01)
+                try:
+                    with open(path) as f:
+                        sig_payload = json.load(f)
+                    ok_sig = (sig_payload["header"]["reason"] == "SIGUSR1"
+                              and len(sig_payload["entries"]) == 1)
+                except (OSError, ValueError):
+                    ok_sig = False
+    checks["signal_dump"] = ok_sig
+
+    # 4) prom-text rendering validates
+    reg = MetricsRegistry()
+    reg.gauge("selftest_loss", help="loss").set(1.5)
+    reg.counter("selftest_samples_total", help="samples").inc(256)
+    h = reg.histogram("selftest_step_seconds", help="step time")
+    for v in (0.004, 0.009, 0.02, 0.02, 3.0):
+        h.observe(v)
+    text = reg.to_prom()
+    problems = validate_prom_text(text)
+    checks["prom_valid"] = not problems
+    checks["prom_histogram_count"] = (
+        "selftest_step_seconds_count 5" in text)
+    js = reg.dump_json()
+    checks["json_dump"] = (
+        js["metrics"]["selftest_loss"]["value"] == 1.5
+        and js["metrics"]["selftest_samples_total"]["value"] == 256.0)
+
+    return all(checks.values()), checks
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.diagnostics",
+        description="flight recorder / runtime health self-test + dump")
+    ap.add_argument("--self-test", action="store_true",
+                    help="exercise ring wraparound, watchdog + signal "
+                         "dumps, prom rendering")
+    ap.add_argument("--dump", action="store_true",
+                    help="dump this process's flight recorder now")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        ok, checks = _self_test()
+        print(json.dumps({"self_test_ok": ok, "checks": checks}))
+        return 0 if ok else 1
+    if args.dump:
+        print(dump() or "")
+        return 0
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

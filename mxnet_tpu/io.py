@@ -123,15 +123,32 @@ def _batch_nbytes(batch) -> int:
                for arr in list(batch.data) + list(batch.label))
 
 
+def _feed_io_bytes(nbytes: int) -> None:
+    """Cumulative io byte counter for the step-metrics registry (metric
+    name/help/guard live in diagnostics.feed_io_bytes); the import
+    guard keeps telemetry from ever failing the input pipeline."""
+    try:
+        from . import diagnostics as _diag
+
+        _diag.feed_io_bytes(nbytes)
+    except Exception:
+        pass
+
+
 def _instrumented_fetch(it, produce):
     """Input-pipeline telemetry shared by every iterator's fetch path:
     run ``produce()`` under one io span (stamped on the REAL calling
     thread — a prefetch worker gets its own trace lane, not the
-    hardcoded tid=0) plus the cumulative batch-bytes counter."""
+    hardcoded tid=0) plus the cumulative batch-bytes counter.  The
+    step-metrics registry's io byte counter (diagnostics.py — one of
+    the rates ``to_prom()`` exposes to scrapers) is fed whenever the
+    registry is live, profiler running or not."""
     from . import profiler as _profiler
 
     if not _profiler.is_running():
-        return produce()
+        batch = produce()
+        _feed_io_bytes(_batch_nbytes(batch))
+        return batch
     start = _profiler._now_us()
     batch = produce()
     nbytes = _batch_nbytes(batch)
@@ -139,6 +156,7 @@ def _instrumented_fetch(it, produce):
                           _profiler._now_us() - start, cat="io",
                           args={"bytes": nbytes})
     _profiler.record_bytes("io:batch_bytes", nbytes, cat="io")
+    _feed_io_bytes(nbytes)
     return batch
 
 

@@ -28,6 +28,7 @@ Semantics preserved from the reference:
 """
 from __future__ import annotations
 
+import contextlib
 import pickle
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -42,6 +43,44 @@ __all__ = ["KVStore", "create"]
 
 def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _payload_dtype(value) -> Optional[str]:
+    """dtype of the first array in a (possibly nested) payload —
+    flight-recorder metadata only, never raises."""
+    try:
+        v = value
+        while isinstance(v, (list, tuple)):
+            if not v:
+                return None
+            v = v[0]
+        dt = getattr(v, "dtype", None)
+        return None if dt is None else str(dt)
+    except Exception:
+        return None
+
+
+def _comms_span(prof: bool, name: str, args: dict):
+    """The profiler span for one instrumented verb, or a no-op context
+    when no profiling session is running — keeps each verb's _do_* call
+    at exactly one site."""
+    if not prof:
+        return contextlib.nullcontext()
+    from . import profiler as _profiler
+
+    return _profiler.span(name, cat="comms", args=args)
+
+
+def _feed_bytes_metric(op: str, nbytes: int) -> None:
+    """Cumulative kvstore byte counter (metric name/help/guard live in
+    diagnostics.feed_kvstore_bytes); the import guard keeps telemetry
+    from ever failing the collective it measures."""
+    try:
+        from . import diagnostics as _diag
+
+        _diag.feed_kvstore_bytes(op, nbytes)
+    except Exception:
+        pass
 
 
 def _payload_nbytes(value) -> int:
@@ -103,59 +142,94 @@ class KVStore:
 
     # -- instrumented verbs: every backend's push/pull stamps a comms
     #    span + cumulative byte counters (ref: the reference profiler's
-    #    KVStoreDistDefault events around ZPush/ZPull) -----------------
+    #    KVStoreDistDefault events around ZPush/ZPull), and records one
+    #    collective flight-recorder entry (diagnostics.py — seq/keys/
+    #    bytes/state, the post-mortem ``--health`` reads) --------------
     def push(self, key, value, priority: int = 0) -> None:
         """Sum all pushed values per key (ref: kvstore_local.h Push →
         Comm::Reduce).  Engine-priority overlap is not needed: XLA's async
         dispatch already overlaps these reductions with other work."""
+        from . import diagnostics as _diag
         from . import profiler as _profiler
 
-        if not _profiler.is_running():
-            return self._do_push(key, value, priority)
-        nbytes = _payload_nbytes(value)
-        with _profiler.span("KVStore::Push", cat="comms",
-                            args={"bytes": nbytes, "type": self._kind}):
+        prof = _profiler.is_running()
+        if not prof and not _diag.flight_enabled():
+            # the byte counter is independent of profiler/flight state:
+            # a scraped MXNET_METRICS_FILE must still see comms traffic
             self._do_push(key, value, priority)
-        _profiler.record_bytes("kvstore:push_bytes", nbytes)
+            _feed_bytes_metric("push", _payload_nbytes(value))
+            return
+        nbytes = _payload_nbytes(value)
+        with _diag.record_collective("push", keys=key, nbytes=nbytes,
+                                     dtype=_payload_dtype(value),
+                                     args={"type": self._kind}), \
+                _comms_span(prof, "KVStore::Push",
+                            {"bytes": nbytes, "type": self._kind}):
+            self._do_push(key, value, priority)
+        if prof:
+            _profiler.record_bytes("kvstore:push_bytes", nbytes)
+        _feed_bytes_metric("push", nbytes)
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True) -> None:
+        from . import diagnostics as _diag
         from . import profiler as _profiler
 
-        if not _profiler.is_running():
-            return self._do_pull(key, out, priority, ignore_sparse)
-        nbytes = _payload_nbytes(out)
-        with _profiler.span("KVStore::Pull", cat="comms",
-                            args={"bytes": nbytes, "type": self._kind}):
+        prof = _profiler.is_running()
+        if not prof and not _diag.flight_enabled():
             self._do_pull(key, out, priority, ignore_sparse)
-        _profiler.record_bytes("kvstore:pull_bytes", nbytes)
+            _feed_bytes_metric("pull", _payload_nbytes(out))
+            return
+        nbytes = _payload_nbytes(out)
+        with _diag.record_collective("pull", keys=key, nbytes=nbytes,
+                                     dtype=_payload_dtype(out),
+                                     args={"type": self._kind}), \
+                _comms_span(prof, "KVStore::Pull",
+                            {"bytes": nbytes, "type": self._kind}):
+            self._do_pull(key, out, priority, ignore_sparse)
+        if prof:
+            _profiler.record_bytes("kvstore:pull_bytes", nbytes)
+        _feed_bytes_metric("pull", nbytes)
 
     def pushpull(self, key, value, out=None, priority: int = 0) -> None:
         """The allreduce verb: push + pull in one call (the in-graph
         ``tpu`` store does the same exchange as a fused psum)."""
+        from . import diagnostics as _diag
         from . import profiler as _profiler
 
-        if not _profiler.is_running():
+        prof = _profiler.is_running()
+        if not prof and not _diag.flight_enabled():
             self._do_push(key, value, priority)
             self._do_pull(key, out if out is not None else value,
                           priority, True)
+            _feed_bytes_metric("allreduce", _payload_nbytes(value))
             return
         nbytes = _payload_nbytes(value)
-        with _profiler.span("KVStore::AllReduce", cat="comms",
-                            args={"bytes": nbytes, "type": self._kind}):
+        with _diag.record_collective("allreduce", keys=key, nbytes=nbytes,
+                                     dtype=_payload_dtype(value),
+                                     args={"type": self._kind}), \
+                _comms_span(prof, "KVStore::AllReduce",
+                            {"bytes": nbytes, "type": self._kind}):
             self._do_push(key, value, priority)
             self._do_pull(key, out if out is not None else value,
                           priority, True)
-        _profiler.record_bytes("kvstore:allreduce_bytes", nbytes)
+        if prof:
+            _profiler.record_bytes("kvstore:allreduce_bytes", nbytes)
+        _feed_bytes_metric("allreduce", nbytes)
 
     def row_sparse_pull(self, key, out=None, priority=0,
                         row_ids=None) -> None:
+        from . import diagnostics as _diag
         from . import profiler as _profiler
 
-        if not _profiler.is_running():
+        prof = _profiler.is_running()
+        if not prof and not _diag.flight_enabled():
             return self._do_row_sparse_pull(key, out, priority, row_ids)
-        with _profiler.span("KVStore::PullRowSparse", cat="comms",
-                            args={"type": self._kind}):
+        with _diag.record_collective("row_sparse_pull", keys=key,
+                                     dtype=_payload_dtype(out),
+                                     args={"type": self._kind}), \
+                _comms_span(prof, "KVStore::PullRowSparse",
+                            {"type": self._kind}):
             self._do_row_sparse_pull(key, out, priority, row_ids)
 
     def _do_push(self, key, value, priority: int = 0) -> None:

@@ -189,12 +189,19 @@ class BaseModule:
         # K-step scan would swallow).
         # ref: the engine's bulk segments, MXNET_EXEC_BULK_EXEC_TRAIN
         # (threaded_engine.h:386-458) — here the segment is K whole steps.
+        from .. import diagnostics as _diag
         from .. import engine as _engine
         from .. import profiler as _profiler
 
         per_batch = monitor is not None or _profiler.is_running()
         bulk_k = 1 if per_batch else max(1, _engine.fit_bulk_size())
         can_bulk = bulk_k > 1 and hasattr(self, "_bulk_fit_steps")
+
+        def _batch_samples(b):
+            try:
+                return int(b.data[0].shape[0])
+            except Exception:
+                return None
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -220,21 +227,43 @@ class BaseModule:
                     # batch_end_callback skipping warmup) forces THIS
                     # group per-batch without permanently disabling bulk
                     profiling = _profiler.is_running()
+                    group_tic = time.time()
                     outs = self._bulk_fit_steps(group) \
                         if (can_bulk and not profiling) else None
                     if outs is None:
                         if can_bulk and not profiling:
                             can_bulk = False  # permanent per-batch fallback
                         for b in group:
+                            step_tic = time.time()
                             self.forward_backward(b)
                             self.update()
                             self.update_metric(eval_metric, b.label)
+                            _diag.record_step(
+                                time.time() - step_tic,
+                                samples=_batch_samples(b),
+                                metric_values=eval_metric.get_name_value())
                             nbatch = self._fit_batch_end(
                                 epoch, nbatch, eval_metric,
                                 batch_end_callback)
                         continue
+                    # the K steps ran as ONE dispatch: amortize its wall
+                    # time uniformly over the group's batches.  The
+                    # dispatch is async (jax arrays come back
+                    # un-materialized) — block on the outputs first so
+                    # per_step is device wall time, not enqueue time
+                    try:
+                        import jax as _jax
+
+                        _jax.block_until_ready(
+                            [o._data for outs_b in outs for o in outs_b])
+                    except Exception:
+                        pass
+                    per_step = (time.time() - group_tic) / len(group)
                     for b, outs_b in zip(group, outs):
                         eval_metric.update(b.label, outs_b)
+                        _diag.record_step(
+                            per_step, samples=_batch_samples(b),
+                            metric_values=eval_metric.get_name_value())
                         nbatch = self._fit_batch_end(
                             epoch, nbatch, eval_metric, batch_end_callback)
             else:
@@ -244,6 +273,7 @@ class BaseModule:
                     data_batch = next_data_batch
                     if monitor is not None:
                         monitor.tic()
+                    step_tic = time.time()
                     self.forward_backward(data_batch)
                     self.update()
                     try:
@@ -252,6 +282,10 @@ class BaseModule:
                     except StopIteration:
                         end_of_batch = True
                     self.update_metric(eval_metric, data_batch.label)
+                    _diag.record_step(
+                        time.time() - step_tic,
+                        samples=_batch_samples(data_batch),
+                        metric_values=eval_metric.get_name_value())
                     if monitor is not None:
                         monitor.toc_print()
                     if batch_end_callback is not None:

@@ -317,7 +317,21 @@ class BulkTrainLoop:
                 body, (params, aux_vals, state_leaves, ctr0), datas)
             return fp, fa, fl, stacked
 
-        self._bulk_fn = jax.jit(bulk, donate_argnums=(0, 1, 2))
+        # recompile tracking + flight-recorder plan header
+        # (diagnostics.py): the bulk scan is THE compiled path of
+        # Module.fit, so churn here is the recompilation storm that
+        # silently doubles epoch time
+        from .. import diagnostics as _diag
+
+        if bucketed:
+            _diag.set_bucket_plan(_buckets.plan_meta(plan),
+                                  owner=id(self))
+        else:
+            # owned clear: drop only a stale plan THIS loop stamped,
+            # not one a different live bucketed step runs under
+            _diag.set_bucket_plan(None, owner=id(self))
+        self._bulk_fn = _diag.instrument_jit(
+            "Module.bulk_fit", jax.jit(bulk, donate_argnums=(0, 1, 2)))
         self._n_outs = n_outs
         self._built = True
 

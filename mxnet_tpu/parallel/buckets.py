@@ -42,7 +42,7 @@ from typing import Dict, List, Mapping, NamedTuple, Optional, Sequence, Tuple
 __all__ = [
     "DEFAULT_BUCKET_BYTES", "Bucket", "bucket_cap_bytes", "chain_enabled",
     "impl_name", "partition", "plan_for_arrays", "bucketed_reduce",
-    "ring_allreduce_flat", "accounting", "stamp_profiler",
+    "ring_allreduce_flat", "accounting", "plan_meta", "stamp_profiler",
 ]
 
 DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
@@ -225,33 +225,72 @@ def accounting(plan: Sequence[Bucket]) -> List[Dict]:
              "dtype": b.dtype} for i, b in enumerate(plan)]
 
 
+def plan_meta(plan: Optional[Sequence[Bucket]],
+              cap_bytes: Optional[int] = None) -> Dict:
+    """Self-describing summary of one reduction schedule — stamped into
+    the flight-recorder header (diagnostics.py) and the BENCH_*/
+    SCALING_* perf artifacts so every dump records which bucket plan
+    produced it."""
+    plan = list(plan or ())
+    return {
+        "n_buckets": len(plan),
+        "total_bytes": sum(int(b.nbytes) for b in plan),
+        "cap_bytes": bucket_cap_bytes() if cap_bytes is None
+        else int(cap_bytes),
+        "impl": impl_name(),
+        "chained": chain_enabled(),
+        "buckets": accounting(plan),
+    }
+
+
 def stamp_profiler(plan: Sequence[Bucket], *, impl: Optional[str] = None,
                    store_type: str = "tpu") -> None:
     """Stamp one comms span per bucket + cumulative byte counters
-    through the telemetry layer (profiler.py) at dispatch time, so the
-    bucketed schedule is visible in merged traces — the in-graph
+    through the telemetry layer (profiler.py) at dispatch time, AND one
+    flight-recorder entry per bucket reduction (diagnostics.py), so the
+    bucketed schedule is visible in merged traces and the collective
+    seq stream covers every reduction a rank issued — the in-graph
     reductions themselves execute inside XLA where host spans cannot
-    reach, so these spans record the issue schedule (bucket order,
-    payload bytes), not device occupancy.  No-op unless the profiler is
-    running; never raises."""
+    reach, so both record the issue schedule (bucket order, payload
+    bytes), not device occupancy.  Spans need a running profiler; the
+    flight entries don't.  Never raises."""
     try:
+        from .. import diagnostics as _diag
         from .. import profiler as _profiler
 
-        if not _profiler.is_running():
-            return
         if impl is None:
             impl = impl_name()
+        # the byte counter is independent of profiler/flight state
+        # (same contract as the kvstore verb fast paths): scrapers see
+        # bucket_reduce traffic whenever the registry is live
+        _diag.feed_kvstore_bytes("bucket_reduce",
+                                 sum(int(b.nbytes) for b in plan))
+        prof = _profiler.is_running()
+        flight = _diag.flight_enabled()
+        if not prof and not flight:
+            return
         total = 0
         for i, b in enumerate(plan):
-            with _profiler.span("KVStore::AllReduceBucket",
-                                cat="comms",
-                                args={"bucket": i, "bytes": int(b.nbytes),
-                                      "n_grads": len(b.keys),
-                                      "impl": impl, "type": store_type,
-                                      "in_graph": True}):
-                pass
+            if flight:
+                with _diag.record_collective(
+                        "bucket_reduce", keys=b.keys, bucket=i,
+                        nbytes=int(b.nbytes), dtype=b.dtype,
+                        args={"impl": impl, "type": store_type,
+                              "in_graph": True}):
+                    pass
+            if prof:
+                with _profiler.span("KVStore::AllReduceBucket",
+                                    cat="comms",
+                                    args={"bucket": i,
+                                          "bytes": int(b.nbytes),
+                                          "n_grads": len(b.keys),
+                                          "impl": impl, "type": store_type,
+                                          "in_graph": True}):
+                    pass
             total += int(b.nbytes)
-        _profiler.record_bytes("kvstore:bucket_allreduce_bytes", total)
-        _profiler.record_bytes("kvstore:bucket_allreduce_count", len(plan))
+        if prof:
+            _profiler.record_bytes("kvstore:bucket_allreduce_bytes", total)
+            _profiler.record_bytes("kvstore:bucket_allreduce_count",
+                                   len(plan))
     except Exception:
         pass

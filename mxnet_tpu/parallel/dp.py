@@ -237,6 +237,19 @@ class FusedTrainStep:
                   self._cells[i].data()._data.dtype)
                  for i in range(n_params) if i not in aux_idx], cap)
         plan = self._bucket_plan
+        # flight-recorder header: which reduction schedule this process
+        # is issuing (diagnostics.py; --health cross-checks it per rank)
+        from .. import diagnostics as _diag
+
+        if self._bucketed:
+            _diag.set_bucket_plan(_buckets.plan_meta(plan, cap),
+                                  owner=id(self))
+        else:
+            # clear a stale plan THIS step stamped on an earlier
+            # bucketed build (it reduces monolithically now and its
+            # dumps must say so); a plan another live step is
+            # executing under is left alone
+            _diag.set_bucket_plan(None, owner=id(self))
 
         def step_body(param_vals, mom_vals, data, label, key_root, ctr,
                       sharded: bool):
@@ -323,13 +336,20 @@ class FusedTrainStep:
                                  key_root, ctr, sharded=False)
 
         donate = (0, 1)  # params + momenta buffers are donated: in-place update
-        self._step = jax.jit(
-            step,
-            in_shardings=(self._param_sh, self._param_sh, data_sh, data_sh,
-                          rep, rep),
-            out_shardings=(self._param_sh, self._param_sh, rep, data_sh),
-            donate_argnums=donate,
-        )
+        # recompile tracking (diagnostics.py): count/time every XLA
+        # compilation these step programs trigger and warn on
+        # shape/dtype churn — a silent recompilation storm doubles step
+        # time with no error anywhere
+        self._step = _diag.instrument_jit(
+            "FusedTrainStep.step",
+            jax.jit(
+                step,
+                in_shardings=(self._param_sh, self._param_sh, data_sh,
+                              data_sh, rep, rep),
+                out_shardings=(self._param_sh, self._param_sh, rep,
+                               data_sh),
+                donate_argnums=donate,
+            ))
 
         # K steps inside ONE program via lax.scan — the TPU analogue of
         # the reference engine's bulk execution (engine.set_bulk_size):
@@ -353,13 +373,15 @@ class FusedTrainStep:
         from jax.sharding import PartitionSpec as _P
 
         kdata_sh = NamedSharding(self.mesh, _P(None, "dp"))
-        self._multi_step = jax.jit(
-            multi_step,
-            in_shardings=(self._param_sh, self._param_sh, kdata_sh,
-                          kdata_sh, rep, rep),
-            out_shardings=(self._param_sh, self._param_sh, rep),
-            donate_argnums=donate,
-        )
+        self._multi_step = _diag.instrument_jit(
+            "FusedTrainStep.multi_step",
+            jax.jit(
+                multi_step,
+                in_shardings=(self._param_sh, self._param_sh, kdata_sh,
+                              kdata_sh, rep, rep),
+                out_shardings=(self._param_sh, self._param_sh, rep),
+                donate_argnums=donate,
+            ))
 
         # same-batch variant: the batch is closed over once instead of
         # materializing K copies in HBM (bench/burn-in path)
@@ -375,13 +397,18 @@ class FusedTrainStep:
                     body, (param_vals, mom_vals, ctr0), None, length=k)
                 return fparams, fmoms, losses
 
-            return jax.jit(
-                fn,
-                in_shardings=(self._param_sh, self._param_sh, data_sh,
-                              data_sh, rep, rep),
-                out_shardings=(self._param_sh, self._param_sh, rep),
-                donate_argnums=donate,
-            )
+            # k in the name: each K-variant is its own jit whose first
+            # compile is expected, not shape churn — one shared row
+            # would fire a false RECOMPILATION STORM on the second k
+            return _diag.instrument_jit(
+                "FusedTrainStep.multi_step_same[k=%d]" % k,
+                jax.jit(
+                    fn,
+                    in_shardings=(self._param_sh, self._param_sh, data_sh,
+                                  data_sh, rep, rep),
+                    out_shardings=(self._param_sh, self._param_sh, rep),
+                    donate_argnums=donate,
+                ))
 
         self._multi_step_same = {}
         self._multi_step_same_fn = multi_step_same

@@ -39,6 +39,7 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -548,9 +549,40 @@ def dumps(reset: bool = False) -> str:
 
 
 # ---------------------------------------------------------------------------
+# shared shutdown path: ONE atexit hook persists every telemetry
+# artifact a dying rank owes the post-mortem — the chrome trace (when a
+# profiling session is still running) AND the collective flight
+# recorder + metrics exposition (diagnostics.py).  Registered at import
+# unconditionally: before this, only the AUTOSTART path registered a
+# dump and only the trace was covered, so a rank that died mid-run left
+# no flight-recorder evidence for merge_traces --health.
+# ---------------------------------------------------------------------------
+def _shutdown():
+    try:
+        if _state == "run":
+            set_state("stop")  # run->stop transition persists the trace
+    except Exception:
+        pass  # e.g. the configured dump dir is already gone at exit
+    finally:
+        # flight-recorder + metrics leg — only if diagnostics was ever
+        # imported (nothing to dump otherwise); its own gating decides
+        # whether a file is actually written
+        diag = sys.modules.get(__package__ + ".diagnostics")
+        if diag is not None:
+            try:
+                diag._atexit_dump()
+            except Exception:
+                pass
+
+
+atexit.register(_shutdown)
+
+
+# ---------------------------------------------------------------------------
 # MXNET_PROFILER_AUTOSTART env parity (ref: the 1.x env of the same
 # name): worker subprocesses (tests/dist_worker.py et al.) self-start
-# tracing at import and persist their rank trace at interpreter exit.
+# tracing at import and persist their rank trace at interpreter exit
+# (via the shared _shutdown hook above).
 # ---------------------------------------------------------------------------
 def _autostart():
     if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") \
@@ -560,7 +592,6 @@ def _autostart():
                filename=os.environ.get("MXNET_PROFILER_FILENAME",
                                        "profile.json"))
     set_state("run")
-    atexit.register(lambda: set_state("stop") if _state == "run" else None)
 
 
 _autostart()

@@ -174,8 +174,31 @@ def test_barrier(kv):
     kv.barrier()
 
 
+def run_flight_desync():
+    """Collective-desync scenario for the flight recorder
+    (diagnostics.py): both workers issue the same push stream, but rank
+    1 INTENTIONALLY skips its last push.  Each worker's flight recorder
+    is dumped at exit (MXNET_FLIGHT_RECORDER_DUMP env, set by the
+    test); tools/merge_traces.py --health must then name rank 1 and the
+    exact seq it never completed.  dist_async so the healthy worker
+    isn't blocked on the missing contribution."""
+    kv = mx.kv.create("dist_async")
+    assert kv.num_workers == 2
+    kv.init("a", nd.zeros((4,)))
+    n_pushes = 4
+    for i in range(n_pushes):
+        if kv.rank == 1 and i == n_pushes - 1:
+            break  # the desync under test
+        kv.push("a", nd.ones((4,)))
+    kv.barrier()
+    kv.close()
+    print("worker %d OK" % kv.rank)
+
+
 def main():
     kind = sys.argv[1] if len(sys.argv) > 1 else "dist_sync"
+    if kind == "flight":
+        return run_flight_desync()
     kv = mx.kv.create(kind)
     assert kv.num_workers >= 1
     if kind == "dist_sync":

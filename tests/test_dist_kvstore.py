@@ -62,6 +62,47 @@ def test_dist_profiler_rank_dumps(tmp_path):
                    for e in events)
 
 
+def test_flight_recorder_desync(tmp_path):
+    """One worker of two intentionally skips its last push; both dump
+    flight recorders at exit and `merge_traces.py --health` must name
+    the lagging rank and the exact collective seq it never completed
+    (the observability contract for a hung/desynced fleet)."""
+    import json
+    import subprocess
+
+    base = tmp_path / "flightrecorder.json"
+    _run_cluster("flight", 2, 1, extra_env={
+        "MXNET_FLIGHT_RECORDER_DUMP": "1",
+        "MXNET_FLIGHT_RECORDER_FILE": str(base)})
+    dumps = []
+    for rank in range(2):
+        path = tmp_path / ("flightrecorder_rank%d.json" % rank)
+        assert path.exists(), "rank %d wrote no flight recorder" % rank
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["header"]["rank"] == rank
+        dumps.append(str(path))
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "merge_traces.py")
+    out = tmp_path / "health.json"
+    res = subprocess.run(
+        [sys.executable, tool, "--health", "-o", str(out)] + dumps,
+        capture_output=True, text=True)
+    # exit code 2 == desync detected
+    assert res.returncode == 2, (res.returncode, res.stdout, res.stderr)
+    # rank 0 pushed 4 times (seqs 0..3), rank 1 skipped the last: the
+    # report names rank 1, stalled at seq 3, and the key it carried
+    assert "rank 1 never completed seq 3" in res.stdout, res.stdout
+    assert "keys a" in res.stdout, res.stdout
+    with open(out) as f:
+        report = json.load(f)
+    (lag,) = report["desync"]["laggards"]
+    assert lag["rank"] == 1 and lag["stalled_at_seq"] == 3
+    assert report["desync"]["max_completed_seq"] == 3
+    assert report["desync"]["ranks"]["0"]["last_seq_completed"] == 3
+    assert report["desync"]["ranks"]["1"]["last_seq_completed"] == 2
+
+
 def test_gradient_compression_unit():
     from mxnet_tpu.gradient_compression import GradientCompression
 

@@ -1,5 +1,7 @@
 #!/usr/bin/env python
-"""Merge per-rank chrome traces into one multi-process timeline.
+"""Merge per-rank chrome traces into one multi-process timeline, and
+(``--health``) diagnose a hung/desynced/slow fleet from per-rank
+flight-recorder + trace dumps.
 
 Multi-worker runs dump one ``profile_rank{K}.json`` per rank
 (``mxnet_tpu.profiler`` stamps ``pid = rank``); chrome://tracing and
@@ -11,8 +13,18 @@ Timestamps stay relative to each rank's own profiler start (the ranks'
 clocks are not realigned — within a synchronized job the skew is the
 barrier jitter, which is itself informative).
 
+``--health`` ingests ``flightrecorder_rank{K}.json`` dumps
+(``mxnet_tpu.diagnostics``, emitted on exit/SIGTERM/SIGUSR1/watchdog)
+together with the rank traces and reports: the last collective seq each
+rank completed, which ranks diverge and at exactly which seq/bucket/key
+("rank 1 never entered seq 12"), collectives still in flight or marked
+suspect by the watchdog, bucket-plan mismatches between ranks, and
+per-rank step-time distributions with slowest-rank / p50-vs-p99
+straggler flags.  Exit code 2 when a desync was detected.
+
 Usage:
     tools/merge_traces.py profile_rank0.json profile_rank1.json -o merged.json
+    tools/merge_traces.py --health flightrecorder_rank*.json profile_rank*.json
     tools/merge_traces.py --self-test
 """
 from __future__ import annotations
@@ -68,6 +80,258 @@ def merge_files(paths, out_path):
     return result
 
 
+# ---------------------------------------------------------------------
+# --health: collective desync + straggler analysis over per-rank
+# flight-recorder and trace dumps
+# ---------------------------------------------------------------------
+def is_flight_payload(payload: dict) -> bool:
+    return bool(isinstance(payload, dict)
+                and payload.get("header", {}).get("flight_recorder"))
+
+
+def load_health_inputs(paths):
+    """Split input files into ({rank: flight_payload},
+    {rank: trace_payload}) — the two dump families are distinguished by
+    content, so one glob can feed both."""
+    flight, traces = {}, {}
+    for idx, p in enumerate(paths):
+        with open(p) as f:
+            payload = json.load(f)
+        if is_flight_payload(payload):
+            rank = int(payload["header"].get(
+                "rank", rank_of(p, {}, idx)))
+            if rank in flight:
+                raise ValueError("duplicate flight-recorder rank %d (%s)"
+                                 % (rank, p))
+            flight[rank] = payload
+        else:
+            rank = rank_of(p, payload, idx)
+            if rank in traces:
+                raise ValueError("duplicate trace rank %d (%s)" % (rank, p))
+            traces[rank] = payload
+    return flight, traces
+
+
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _entry_brief(e):
+    return {"seq": e.get("seq"), "op": e.get("op"),
+            "bucket": e.get("bucket"), "keys": e.get("keys"),
+            "bytes": e.get("bytes"), "dtype": e.get("dtype"),
+            "state": e.get("state")}
+
+
+def analyze_desync(flight):
+    """Per-rank completion state + divergence: which rank stopped at
+    which collective seq, described (op/bucket/keys) from a rank that
+    DID complete it."""
+    ranks = {}
+    for rank, payload in sorted(flight.items()):
+        entries = payload.get("entries", [])
+        done = [e["seq"] for e in entries if e.get("state") == "completed"]
+        stuck = [e for e in entries
+                 if e.get("state") in ("in_flight", "suspect")]
+        ranks[rank] = {
+            "last_seq_completed": max(done) if done else -1,
+            "next_seq": payload["header"].get("next_seq"),
+            "n_entries": len(entries),
+            "dropped": payload["header"].get("dropped", 0),
+            "in_flight": [_entry_brief(e) for e in stuck],
+            "suspect": [_entry_brief(e) for e in stuck
+                        if e.get("state") == "suspect"],
+        }
+    if not ranks:
+        return {"ranks": {}, "detected": False, "laggards": []}
+    max_done = max(r["last_seq_completed"] for r in ranks.values())
+    laggards = []
+    for rank, info in sorted(ranks.items()):
+        if info["last_seq_completed"] >= max_done:
+            continue
+        # the collective this rank never completed: what it was stuck
+        # INSIDE if anything is in flight, else the one after its last
+        # completion
+        stalled = info["in_flight"][0] if info["in_flight"] else None
+        stalled_seq = stalled["seq"] if stalled else \
+            info["last_seq_completed"] + 1
+        # describe the missing collective from a rank that completed it
+        desc = stalled
+        if desc is None:
+            for other, payload in sorted(flight.items()):
+                if other == rank:
+                    continue
+                match = [e for e in payload.get("entries", [])
+                         if e.get("seq") == stalled_seq]
+                if match:
+                    desc = _entry_brief(match[0])
+                    break
+        laggards.append({
+            "rank": rank, "stalled_at_seq": stalled_seq,
+            "last_seq_completed": info["last_seq_completed"],
+            "behind_by": max_done - info["last_seq_completed"],
+            "collective": desc,
+        })
+    return {"ranks": ranks, "detected": bool(laggards),
+            "max_completed_seq": max_done, "laggards": laggards}
+
+
+def analyze_bucket_plans(flight):
+    """Bucket-plan fingerprints per rank + mismatch detection — two
+    ranks reducing under DIFFERENT plans desync by construction."""
+    plans = {rank: payload["header"].get("bucket_plan")
+             for rank, payload in sorted(flight.items())}
+    fp = {rank: None if p is None else
+          (p.get("n_buckets"), p.get("total_bytes"), p.get("cap_bytes"))
+          for rank, p in plans.items()}
+    stamped = {k: v for k, v in fp.items() if v is not None}
+    return {"per_rank": plans,
+            "mismatch": len(set(stamped.values())) > 1 if stamped else False}
+
+
+def analyze_stragglers(traces, slow_factor: float = 1.25,
+                       jitter_factor: float = 3.0):
+    """Per-rank step-time distributions from the trace dumps.
+
+    The step proxy is the complete-event ('ph':'X') span name present
+    on EVERY rank with the largest total duration on rank 0 — on
+    healthy dumps that is the per-step span family (Executor forward/
+    backward, Module::update, KVStore::*).  Flags: a rank whose p50
+    exceeds ``slow_factor`` x the fleet-median p50 is a straggler; a
+    rank whose p99 exceeds ``jitter_factor`` x its own p50 has
+    intermittent stalls.
+    """
+    if not traces:
+        return None
+    durs = {}
+    for rank, payload in traces.items():
+        by_name = {}
+        for ev in payload.get("traceEvents", []):
+            if ev.get("ph") == "X" and "dur" in ev:
+                by_name.setdefault(ev["name"], []).append(float(ev["dur"]))
+        durs[rank] = by_name
+    common = set.intersection(*(set(d) for d in durs.values())) \
+        if durs else set()
+    if not common:
+        return {"step_span": None, "note": "no span name common to all "
+                "ranks", "per_rank": {}}
+    rank0 = min(durs)
+    proxy = max(common, key=lambda n: sum(durs[rank0][n]))
+    per_rank = {}
+    for rank, by_name in sorted(durs.items()):
+        vals = sorted(by_name[proxy])
+        per_rank[rank] = {
+            "count": len(vals),
+            "mean_ms": sum(vals) / len(vals) / 1e3,
+            "p50_ms": _pct(vals, 0.50) / 1e3,
+            "p99_ms": _pct(vals, 0.99) / 1e3,
+            "max_ms": vals[-1] / 1e3,
+        }
+    p50s = sorted(r["p50_ms"] for r in per_rank.values())
+    fleet_p50 = _pct(p50s, 0.5)
+    slowest = max(per_rank, key=lambda r: per_rank[r]["p50_ms"])
+    flagged = []
+    for rank, st in per_rank.items():
+        slow = fleet_p50 and st["p50_ms"] > slow_factor * fleet_p50
+        jitter = st["p50_ms"] > 0 and \
+            st["p99_ms"] > jitter_factor * st["p50_ms"]
+        st["straggler"] = bool(slow)
+        st["intermittent_stalls"] = bool(jitter)
+        if slow or jitter:
+            flagged.append(rank)
+    return {"step_span": proxy, "fleet_p50_ms": fleet_p50,
+            "slowest_rank": slowest, "flagged_ranks": sorted(flagged),
+            "per_rank": per_rank}
+
+
+def health_report(flight, traces):
+    report = {"n_flight_dumps": len(flight), "n_trace_dumps": len(traces),
+              "desync": analyze_desync(flight)}
+    if flight:
+        report["bucket_plans"] = analyze_bucket_plans(flight)
+    stragglers = analyze_stragglers(traces)
+    if stragglers is not None:
+        report["stragglers"] = stragglers
+    return report
+
+
+def format_health(report):
+    """Human-readable lines — the "rank 1 never entered seq 12" view."""
+    lines = []
+    desync = report["desync"]
+    for rank, info in sorted(desync.get("ranks", {}).items()):
+        lines.append(
+            "rank %d: last completed collective seq %d (%d recorded, "
+            "%d dropped, %d in flight)"
+            % (rank, info["last_seq_completed"], info["n_entries"],
+               info["dropped"], len(info["in_flight"])))
+        for e in info["suspect"]:
+            lines.append(
+                "  rank %d SUSPECT (watchdog timeout): seq %s %s bucket=%s "
+                "keys=%s" % (rank, e["seq"], e["op"], e["bucket"],
+                             e["keys"]))
+    if desync.get("detected"):
+        for lag in desync["laggards"]:
+            c = lag.get("collective") or {}
+            where = c.get("op") or "collective"
+            detail = []
+            if c.get("bucket") is not None:
+                detail.append("bucket %s" % c["bucket"])
+            if c.get("keys"):
+                detail.append("keys %s" % ",".join(map(str, c["keys"])))
+            lines.append(
+                "DESYNC: rank %d never completed seq %d (%s%s) — "
+                "fleet reached seq %d, rank is %d behind"
+                % (lag["rank"], lag["stalled_at_seq"], where,
+                   (", " + ", ".join(detail)) if detail else "",
+                   desync["max_completed_seq"], lag["behind_by"]))
+    elif desync.get("ranks"):
+        lines.append("no desync: all ranks completed seq %d"
+                     % desync["max_completed_seq"])
+    if report.get("bucket_plans", {}).get("mismatch"):
+        lines.append("BUCKET PLAN MISMATCH: ranks are reducing under "
+                     "different bucket plans (see report.bucket_plans)")
+    st = report.get("stragglers")
+    if st and st.get("per_rank"):
+        lines.append("step-time proxy span: %r (fleet p50 %.3f ms)"
+                     % (st["step_span"], st["fleet_p50_ms"]))
+        for rank, r in sorted(st["per_rank"].items()):
+            flags = []
+            if r.get("straggler"):
+                flags.append("STRAGGLER")
+            if r.get("intermittent_stalls"):
+                flags.append("INTERMITTENT-STALLS")
+            lines.append(
+                "  rank %d: n=%d mean %.3f ms p50 %.3f ms p99 %.3f ms "
+                "max %.3f ms%s"
+                % (rank, r["count"], r["mean_ms"], r["p50_ms"],
+                   r["p99_ms"], r["max_ms"],
+                   (" [" + ",".join(flags) + "]") if flags else ""))
+        lines.append("slowest rank: %d" % st["slowest_rank"])
+    return lines
+
+
+def run_health(paths, out_path=None) -> int:
+    flight, traces = load_health_inputs(paths)
+    report = health_report(flight, traces)
+    for line in format_health(report):
+        print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+        print("health report -> %s" % out_path)
+    # bucket-plan mismatch is a desync by construction — same exit
+    # contract as a seq divergence so script consumers catch both
+    unhealthy = report["desync"].get("detected") or \
+        report.get("bucket_plans", {}).get("mismatch")
+    return 2 if unhealthy else 0
+
+
 def self_test() -> int:
     """Synthesize two rank dumps, merge, assert pid remapping."""
     import tempfile
@@ -104,6 +368,55 @@ def self_test() -> int:
                       if e["pid"] == rank and e.get("ph") == "M"
                       and e["name"] == "process_name"]
             assert labels == ["rank %d" % rank], labels
+
+        # --health: rank 1's flight recorder stops one collective short
+        # (and has one in flight) — the analysis must name rank 1, the
+        # stalled seq and its bucket/keys
+        def flight_dump(rank, n_done, in_flight=None):
+            entries = [{"seq": s, "op": "bucket_reduce", "bucket": s % 3,
+                        "keys": ["w%d" % s], "bytes": 1024,
+                        "dtype": "float32", "enqueue_ts": 100.0 + s,
+                        "complete_ts": 100.5 + s, "state": "completed"}
+                       for s in range(n_done)]
+            if in_flight is not None:
+                entries.append({"seq": in_flight, "op": "bucket_reduce",
+                                "bucket": in_flight % 3,
+                                "keys": ["w%d" % in_flight], "bytes": 1024,
+                                "dtype": "float32",
+                                "enqueue_ts": 100.0 + in_flight,
+                                "complete_ts": None, "state": "suspect"})
+            payload = {"header": {"flight_recorder": True, "rank": rank,
+                                  "num_workers": 2, "capacity": 256,
+                                  "next_seq": len(entries), "dropped": 0,
+                                  "bucket_plan": {"n_buckets": 3,
+                                                  "total_bytes": 3072,
+                                                  "cap_bytes": 4 << 20}},
+                       "entries": entries}
+            p = os.path.join(d, "flightrecorder_rank%d.json" % rank)
+            with open(p, "w") as f:
+                json.dump(payload, f)
+            return p
+
+        f0 = flight_dump(0, 13)
+        f1 = flight_dump(1, 12, in_flight=12)
+        flight, traces = load_health_inputs([f0, f1] + paths)
+        assert set(flight) == {0, 1} and set(traces) == {0, 1}
+        report = health_report(flight, traces)
+        desync = report["desync"]
+        assert desync["detected"], report
+        assert desync["max_completed_seq"] == 12
+        (lag,) = desync["laggards"]
+        assert lag["rank"] == 1 and lag["stalled_at_seq"] == 12, lag
+        assert lag["collective"]["bucket"] == 0
+        assert lag["collective"]["keys"] == ["w12"]
+        assert not report["bucket_plans"]["mismatch"]
+        text = "\n".join(format_health(report))
+        assert "rank 1 never completed seq 12" in text, text
+        assert "bucket 0" in text and "w12" in text, text
+        # straggler flags over the synthetic traces: identical spans on
+        # both ranks -> nobody flagged
+        st = report["stragglers"]
+        assert st["step_span"] == "dot" and st["flagged_ranks"] == [], st
     print("merge_traces self-test OK")
     return 0
 
@@ -111,16 +424,30 @@ def self_test() -> int:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("inputs", nargs="*",
-                    help="per-rank trace JSON files (profile_rank{K}.json)")
-    ap.add_argument("-o", "--output", default="profile_merged.json",
-                    help="merged trace path (default: profile_merged.json)")
+                    help="per-rank trace JSON files (profile_rank{K}.json) "
+                         "and/or flight-recorder dumps "
+                         "(flightrecorder_rank{K}.json, --health mode)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="merged trace path (default: profile_merged.json)"
+                         " / health-report JSON path (--health)")
+    ap.add_argument("--health", action="store_true",
+                    help="desync + straggler analysis over per-rank "
+                         "flight-recorder and trace dumps; exit code 2 "
+                         "when a desync is detected")
     ap.add_argument("--self-test", action="store_true",
-                    help="run the built-in synthetic merge check and exit")
+                    help="run the built-in synthetic merge+health check "
+                         "and exit")
     args = ap.parse_args(argv)
     if args.self_test:
         return self_test()
+    if args.health:
+        if not args.inputs:
+            ap.error("--health needs at least one rank dump")
+        return run_health(args.inputs, args.output)
     if len(args.inputs) < 2:
         ap.error("need at least two rank traces to merge")
+    if args.output is None:
+        args.output = "profile_merged.json"
     result = merge_files(args.inputs, args.output)
     print("merged %d files, %d events -> %s"
           % (len(args.inputs), len(result["traceEvents"]), args.output))
