@@ -19,6 +19,7 @@ Import as a drop-in for the scripts in the reference's example/ tree:
 __version__ = "0.1.0"
 
 from . import base
+from . import env
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 from . import ndarray
@@ -40,6 +41,7 @@ from . import recordio
 from . import image
 from . import profiler
 from . import diagnostics
+from . import analysis
 from . import monitor
 from . import monitor as mon  # ref: python/mxnet/__init__.py:63 alias
 from .monitor import Monitor

@@ -46,7 +46,9 @@ _TAG_LEN = hashlib.sha256().digest_size
 
 
 def _secret() -> Optional[bytes]:
-    s = os.environ.get("MXNET_PS_SECRET")
+    from . import env as _env
+
+    s = _env.get_str("MXNET_PS_SECRET")
     return s.encode() if s else None
 
 
@@ -54,11 +56,15 @@ def request_timeout() -> float:
     # default exceeds the server's sync-pull grace window (600s,
     # MXNET_KVSTORE_SYNC_TIMEOUT) so a straggler the server tolerates is
     # not aborted client-side first
-    return float(os.environ.get("MXNET_PS_REQUEST_TIMEOUT", "900"))
+    from . import env as _env
+
+    return _env.get_float("MXNET_PS_REQUEST_TIMEOUT")
 
 
 def heartbeat_interval() -> float:
-    return float(os.environ.get("MXNET_PS_HEARTBEAT_INTERVAL", "5"))
+    from . import env as _env
+
+    return _env.get_float("MXNET_PS_HEARTBEAT_INTERVAL")
 
 
 def bind_host() -> str:

@@ -1,0 +1,23 @@
+"""mx.analysis — static analysis of compiled step programs.
+
+Two cooperating halves prevent, at trace time, the failure classes the
+runtime layer (diagnostics.py flight recorder, recompile tracker) can
+only diagnose after they cost a run:
+
+  * :mod:`mxnet_tpu.analysis.auditor` — jaxpr checks over any compiled
+    step (collective-uniformity, donation, dtype, host-sync);
+  * ``tools/mxlint.py`` — repo-wide AST lint (recompile hazards,
+    unregistered ``MXNET_*`` env reads against :mod:`mxnet_tpu.env`,
+    host syncs in hot loops, bare excepts around collectives).
+
+``python -m mxnet_tpu.analysis --self-test`` verifies the auditor
+flags every seeded fixture violation; ``--audit`` audits the compiled
+paths recorded in the current process.
+"""
+from .auditor import (            # noqa: F401
+    AuditReport, Finding, apply_baseline, audit_recorded_steps,
+    audit_step, check_bucket_plan, check_collective_uniformity,
+    check_donation, check_dtype, check_host_sync, collective_signature,
+    iter_eqns, load_baseline, DEFAULT_BASELINE,
+)
+from . import fixtures            # noqa: F401

@@ -1,0 +1,488 @@
+"""Jaxpr auditor: static checks over compiled step programs.
+
+PR 5's flight recorder explains a desynced fleet AFTER it hangs;
+ROADMAP item 5 names undonated buffers (prefusion_bytes_over_hbm_peak
+= 1.55) as the binding MFU constraint.  Both failure classes — and two
+more (silent f32 upcasts in bf16 paths, host round-trips inside the
+compiled region) — are visible *statically* in the jaxpr of the step
+before a single TPU-hour is spent.  The original MXNet enforced these
+invariants dynamically through the dependency engine's var tracking
+(SURVEY.md engine layer); a jit-compiled rebuild enforces them at
+trace time instead.  Four checks:
+
+  * **collective-uniformity** — the sequence of collective eqns
+    (psum / ppermute / all_gather / ...) a step traces to must be
+    deterministic: two independent traces of the same step must
+    produce the identical collective schedule, and on bucketed builds
+    the schedule must embed the declared bucket plan
+    (``diagnostics.bucket_plan``) in issue order.  A rank whose trace
+    ordered collectives differently (dict-ordering or env drift) is
+    the desync ``merge_traces.py --health`` can only name post-mortem.
+
+  * **donation** — every large buffer reachable as a jit input but
+    absent from ``donate_argnums`` is HBM the program holds twice
+    (input + new output).  Reported as wasted bytes per site from the
+    lowered program's ``args_info``.
+
+  * **dtype** — MXU eqns (dot_general / conv_general_dilated) running
+    in f32/f64 inside a declared-bf16 step: the silent upcast that
+    halves MXU throughput without an error anywhere.  Uses the same
+    dtype expectations as the fp64/lr0 numerics-control methodology.
+
+  * **host-sync** — callback/infeed/outfeed eqns inside the compiled
+    region: each is a device->host round-trip per step.
+
+Checks run over any compiled path the recompile tracker has seen
+(``diagnostics.recorded_steps()``: FusedTrainStep.step / multi_step /
+multi_step_same, Module.bulk_fit) — or over any (fn, specs) pair the
+caller hands in.  Findings are machine-readable dicts; a committed
+baseline file suppresses accepted findings by stable fingerprint so
+CI fails only on NEW regressions.
+
+``python -m mxnet_tpu.analysis --self-test`` proves each check flags
+its seeded fixture violation (analysis/fixtures.py) and passes a clean
+donated step.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import (Any, Dict, Iterable, Iterator, List, Mapping,
+                    NamedTuple, Optional, Sequence, Tuple)
+
+__all__ = [
+    "Finding", "AuditReport", "iter_eqns", "collective_signature",
+    "check_collective_uniformity", "check_bucket_plan", "check_donation",
+    "check_dtype", "check_host_sync", "audit_step",
+    "audit_recorded_steps", "load_baseline", "apply_baseline",
+    "DEFAULT_BASELINE",
+]
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+# collective primitives this toolchain lowers cross-device exchange to
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "psum_scatter", "reduce_scatter", "collective_permute",
+})
+# primitives that force a host round-trip from inside the program
+HOST_SYNC_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "host_callback_call", "infeed", "outfeed",
+})
+# the MXU heavyweights whose dtype decides throughput
+MXU_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+
+WIDE_DTYPES = ("float32", "float64")
+
+
+class Finding(NamedTuple):
+    """One defect the auditor claims about one site."""
+    check: str      # collective-uniformity | donation | dtype | host-sync
+    severity: str   # 'error' (wrong results/hang) | 'perf' (wasted HW)
+    site: str       # step name, e.g. 'FusedTrainStep.step'
+    message: str
+    details: Dict[str, Any]
+
+    def fingerprint(self) -> str:
+        """Stable suppression key: check + site + the details the
+        baseline author pinned (never line numbers or live shapes)."""
+        key = self.details.get("fingerprint_key", "")
+        return "%s:%s:%s" % (self.check, self.site, key)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dict(self._asdict())
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+def _inner_jaxprs(value) -> Iterator:
+    """Yield any Jaxpr reachable from one eqn-param value (handles
+    ClosedJaxpr, raw Jaxpr, and lists/tuples of either — the generic
+    recursion that covers pjit/scan/while/cond/shard_map/remat)."""
+    vals = value if isinstance(value, (list, tuple)) else (value,)
+    for item in vals:
+        if hasattr(item, "eqns"):            # raw Jaxpr
+            yield item
+        elif hasattr(item, "jaxpr") and hasattr(
+                getattr(item, "jaxpr"), "eqns"):  # ClosedJaxpr
+            yield item.jaxpr
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Depth-first eqn iterator over a (Closed)Jaxpr including every
+    nested sub-jaxpr, in deterministic program order."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _inner_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def _aval(x):
+    return getattr(x, "aval", x)
+
+
+def _nbytes(aval) -> int:
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    dtype = getattr(aval, "dtype", None)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    try:
+        item = int(dtype.itemsize)
+    except Exception:
+        item = {"bfloat16": 2, "float16": 2}.get(str(dtype), 4)
+    return n * item
+
+
+# ---------------------------------------------------------------------------
+# check 1: collective uniformity
+# ---------------------------------------------------------------------------
+def collective_signature(jaxpr) -> List[Dict[str, Any]]:
+    """The ordered collective schedule of a program: one row per
+    collective eqn — primitive, reduction axes, operand shape/dtype/
+    bytes.  Two ranks (or two traces) issuing different schedules WILL
+    desync; identical signatures cannot."""
+    rows = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMS:
+            continue
+        axes = eqn.params.get("axes", eqn.params.get("axis_name"))
+        if isinstance(axes, (list, tuple, frozenset, set)):
+            axes = tuple(sorted(str(a) for a in axes))
+        else:
+            axes = (str(axes),)
+        av = _aval(eqn.invars[0]) if eqn.invars else None
+        rows.append({
+            "prim": name,
+            "axes": axes,
+            "shape": tuple(getattr(av, "shape", ()) or ()),
+            "dtype": str(getattr(av, "dtype", "?")),
+            "nbytes": _nbytes(av) if av is not None else 0,
+        })
+    return rows
+
+
+def check_collective_uniformity(traces: Mapping[str, Any], site: str
+                                ) -> List[Finding]:
+    """``traces``: {trace_label: jaxpr} — independent traces of the
+    SAME logical step (re-traces in one process, or per-rank traces).
+    All must produce the identical collective schedule."""
+    sigs = {label: collective_signature(jx) for label, jx in
+            traces.items()}
+    labels = sorted(sigs)
+    if len(labels) < 2:
+        return []
+    ref_label = labels[0]
+    ref = sigs[ref_label]
+    findings: List[Finding] = []
+    for label in labels[1:]:
+        got = sigs[label]
+        if got == ref:
+            continue
+        # name the first divergence point, --health style
+        div = next((i for i, (a, b) in enumerate(zip(ref, got))
+                    if a != b), min(len(ref), len(got)))
+        findings.append(Finding(
+            "collective-uniformity", "error", site,
+            "collective schedule differs between traces %r (%d colls) "
+            "and %r (%d colls), first divergence at collective #%d — "
+            "ranks compiling these programs WILL desync"
+            % (ref_label, len(ref), label, len(got), div),
+            {"fingerprint_key": "trace-divergence",
+             "divergence_index": div,
+             "ref": ref[div] if div < len(ref) else None,
+             "got": got[div] if div < len(got) else None}))
+    return findings
+
+
+def check_bucket_plan(jaxpr, plan_meta: Optional[Mapping], site: str
+                      ) -> List[Finding]:
+    """On a bucketed build, the declared plan (flight-recorder header)
+    must appear in the traced collective schedule as a subsequence of
+    reduction payload byte-sizes IN ORDER — the static complement of
+    ``merge_traces.py --health``'s runtime plan cross-check."""
+    if not plan_meta or not plan_meta.get("buckets"):
+        return []
+    if plan_meta.get("impl") not in (None, "psum"):
+        return []  # ring chunks don't carry whole-bucket payloads
+    want = [int(b["bytes"]) for b in plan_meta["buckets"]]
+    got = [r["nbytes"] for r in collective_signature(jaxpr)
+           if len(r["shape"]) <= 2]  # flat (or ring-chunked) buffers
+    it = iter(got)
+    missing = [w for w in want if not any(g == w for g in it)]
+    if not missing:
+        return []
+    return [Finding(
+        "collective-uniformity", "error", site,
+        "declared bucket plan (%d buckets) is not embedded in the "
+        "traced collective schedule in issue order: %d bucket "
+        "reduction(s) missing or reordered (first missing payload: %d "
+        "bytes) — the program does not execute the schedule the flight "
+        "recorder will claim it does"
+        % (len(want), len(missing), missing[0]),
+        {"fingerprint_key": "bucket-plan-mismatch",
+         "plan_bytes": want, "traced_collective_bytes": got,
+         "missing": missing})]
+
+
+# ---------------------------------------------------------------------------
+# check 2: donation
+# ---------------------------------------------------------------------------
+DONATION_MIN_BYTES = 1 << 20  # ignore keys/counters/scalars
+
+
+def check_donation(lowered, site: str,
+                   min_bytes: int = DONATION_MIN_BYTES
+                   ) -> Tuple[List[Finding], Dict[str, int]]:
+    """Audit a ``jax.stages.Lowered``'s args_info: large undonated
+    input buffers are HBM the program holds twice while it runs.
+    Returns (findings, {donated_bytes, undonated_bytes,
+    undonated_large_bytes}).  One finding per SITE (not per leaf) so a
+    500-param model reports once, with the top offenders inlined."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(lowered.args_info,
+                                       is_leaf=lambda x: hasattr(
+                                           x, "donated"))
+    donated = 0
+    undonated = 0
+    offenders: List[Tuple[int, str, str]] = []
+    for info in leaves:
+        if not hasattr(info, "donated"):
+            continue
+        nb = _nbytes(info)  # ArgInfo exposes .shape/.dtype directly
+        if info.donated:
+            donated += nb
+        else:
+            undonated += nb
+            if nb >= min_bytes:
+                offenders.append(
+                    (nb, str(tuple(getattr(info, "shape", ()))),
+                     str(getattr(info, "dtype", "?"))))
+    summary = {"donated_bytes": donated, "undonated_bytes": undonated,
+               "undonated_large_bytes": sum(o[0] for o in offenders),
+               "n_undonated_large": len(offenders)}
+    if not offenders:
+        return [], summary
+    offenders.sort(reverse=True)
+    wasted = summary["undonated_large_bytes"]
+    return [Finding(
+        "donation", "perf", site,
+        "%d input buffer(s) totalling %.1f MiB are jit inputs but not "
+        "donated — the step holds them in HBM alongside their updated "
+        "copies (ROADMAP item 5's binding constraint); top offenders: "
+        "%s" % (len(offenders), wasted / 2**20,
+                ", ".join("%s %s (%.1f MiB)" % (s, d, nb / 2**20)
+                          for nb, s, d in offenders[:4])),
+        {"fingerprint_key": "undonated-large-args",
+         "wasted_bytes": wasted,
+         "offenders": [{"nbytes": nb, "shape": s, "dtype": d}
+                       for nb, s, d in offenders[:16]]})], summary
+
+
+# ---------------------------------------------------------------------------
+# check 3: dtype (silent upcasts in declared-bf16 paths)
+# ---------------------------------------------------------------------------
+def check_dtype(jaxpr, site: str, compute_dtype: str = "bfloat16"
+                ) -> List[Finding]:
+    """In a step declared to compute in ``compute_dtype`` (bf16), MXU
+    eqns with f32/f64 operands are silent upcasts: numerically quiet,
+    throughput-halving.  The fp64/lr0 control methodology already pins
+    what the EXPECTED dtypes are; this asserts the program matches."""
+    if compute_dtype is None or str(compute_dtype).startswith("float3") \
+            or str(compute_dtype).startswith("float6"):
+        return []  # f32/f64 paths upcast nothing by definition
+    wide: List[Dict[str, Any]] = []
+    n_mxu = 0
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in MXU_PRIMS:
+            continue
+        n_mxu += 1
+        dts = [str(getattr(_aval(v), "dtype", "?")) for v in eqn.invars]
+        if any(d in WIDE_DTYPES for d in dts):
+            wide.append({"prim": name, "dtypes": dts,
+                         "shapes": [tuple(getattr(_aval(v), "shape", ()))
+                                    for v in eqn.invars]})
+    if not wide:
+        return []
+    return [Finding(
+        "dtype", "perf", site,
+        "%d of %d MXU eqn(s) (dot_general/conv) compute in f32/f64 "
+        "inside a declared-%s step — a silent upcast is halving MXU "
+        "throughput (first: %s over %s)"
+        % (len(wide), n_mxu, compute_dtype, wide[0]["prim"],
+           wide[0]["dtypes"]),
+        {"fingerprint_key": "wide-mxu-eqns",
+         "n_wide": len(wide), "n_mxu": n_mxu, "examples": wide[:8]})]
+
+
+# ---------------------------------------------------------------------------
+# check 4: host sync inside the compiled region
+# ---------------------------------------------------------------------------
+def check_host_sync(jaxpr, site: str) -> List[Finding]:
+    findings = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in HOST_SYNC_PRIMS:
+            findings.append(Finding(
+                "host-sync", "error", site,
+                "%r eqn inside the compiled step: a device->host round "
+                "trip per execution, serializing the TPU against the "
+                "host (and per STEP when under a scan)" % name,
+                {"fingerprint_key": "host-sync:%s" % name,
+                 "prim": name}))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# audit drivers
+# ---------------------------------------------------------------------------
+class AuditReport:
+    """Findings + per-site meta for one audit run; JSON-serializable."""
+
+    def __init__(self):
+        self.findings: List[Finding] = []
+        self.suppressed: List[Finding] = []
+        self.sites: Dict[str, Dict[str, Any]] = {}
+
+    @property
+    def n_findings(self) -> int:
+        return len(self.findings)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "n_findings": len(self.findings),
+            "n_suppressed": len(self.suppressed),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.fingerprint() for f in self.suppressed],
+            "sites": self.sites,
+        }
+
+    def write_json(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, default=str)
+        return path
+
+    def summary(self) -> str:
+        lines = ["%d finding(s), %d suppressed by baseline, %d site(s)"
+                 % (len(self.findings), len(self.suppressed),
+                    len(self.sites))]
+        for f in self.findings:
+            lines.append("  [%s] %s @ %s: %s"
+                         % (f.severity, f.check, f.site, f.message))
+        return "\n".join(lines)
+
+
+def load_baseline(path: Optional[str] = None) -> set:
+    """Committed suppression fingerprints (accepted findings)."""
+    path = path or DEFAULT_BASELINE
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return set(data.get("fingerprints", []))
+    except (OSError, ValueError):
+        return set()
+
+
+def apply_baseline(findings: Iterable[Finding], baseline: set
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    new, suppressed = [], []
+    for f in findings:
+        (suppressed if f.fingerprint() in baseline else new).append(f)
+    return new, suppressed
+
+
+def audit_step(fn, specs: Sequence, *, site: str,
+               plan_meta: Optional[Mapping] = None,
+               compute_dtype: Optional[str] = None,
+               n_traces: int = 2,
+               donation_min_bytes: int = DONATION_MIN_BYTES
+               ) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Run all four checks on one compiled step.
+
+    ``fn`` is the jitted callable (or diagnostics' instrumented
+    wrapper), ``specs`` the abstract call args (ShapeDtypeStructs —
+    what ``diagnostics.recorded_steps()`` captured).  ``n_traces``
+    independent re-traces feed the uniformity check: a trace whose
+    collective order depends on ambient state (dict ordering, env,
+    time) cannot produce identical schedules twice.
+    """
+    import jax
+
+    # unwrap diagnostics' recompile-tracking wrapper: auditing must not
+    # count as step compiles or fire storm warnings
+    fn = getattr(fn, "_fn", fn)
+    findings: List[Finding] = []
+    traces = {"trace%d" % i: jax.make_jaxpr(fn)(*specs)
+              for i in range(max(2, n_traces))}
+    jaxpr = next(iter(traces.values()))
+
+    findings += check_collective_uniformity(traces, site)
+    findings += check_bucket_plan(jaxpr, plan_meta, site)
+    findings += check_host_sync(jaxpr, site)
+    if compute_dtype is not None:
+        findings += check_dtype(jaxpr, site, compute_dtype)
+
+    meta: Dict[str, Any] = {
+        "n_eqns": sum(1 for _ in iter_eqns(jaxpr)),
+        "n_collectives": len(collective_signature(jaxpr)),
+    }
+    try:
+        lowered = fn.lower(*specs)
+    except Exception as exc:  # abstract lowering can need a backend
+        meta["lower_error"] = repr(exc)
+    else:
+        don_findings, don_summary = check_donation(
+            lowered, site, min_bytes=donation_min_bytes)
+        findings += don_findings
+        meta["donation"] = don_summary
+    return findings, meta
+
+
+def audit_recorded_steps(names: Optional[Sequence[str]] = None,
+                         baseline: Optional[set] = None,
+                         compute_dtype: Optional[str] = None,
+                         donation_min_bytes: int = DONATION_MIN_BYTES
+                         ) -> AuditReport:
+    """Audit every compiled path the recompile tracker has seen this
+    process (``diagnostics.recorded_steps()``) — the 'any compiled
+    step' entry point: run your step once, then audit it."""
+    from .. import diagnostics as _diag
+
+    if baseline is None:
+        baseline = load_baseline()
+    report = AuditReport()
+    recorded = _diag.recorded_steps()
+    for name in sorted(recorded):
+        if names is not None and name not in names:
+            continue
+        fn, specs, step_meta = recorded[name]
+        step_meta = step_meta or {}
+        dtype = step_meta.get("compute_dtype", compute_dtype)
+        try:
+            findings, meta = audit_step(
+                fn, specs, site=name,
+                # the plan THIS step was built against (never the
+                # process-global header — that may belong to another
+                # live step)
+                plan_meta=step_meta.get("bucket_plan"),
+                compute_dtype=dtype,
+                donation_min_bytes=donation_min_bytes)
+        except Exception as exc:
+            report.sites[name] = {"audit_error": repr(exc)}
+            continue
+        new, supp = apply_baseline(findings, baseline)
+        report.findings += new
+        report.suppressed += supp
+        report.sites[name] = meta
+    return report

@@ -1,0 +1,155 @@
+"""Seeded-violation fixtures proving the auditor catches each defect
+class.  Every fixture is a tiny traced program carrying EXACTLY one
+planted bug; the self-test (and tests/test_static_analysis.py) asserts
+the matching check flags it and the clean fixture passes everything.
+
+All fixtures trace on whatever devices exist (a 1-device CPU mesh is
+enough — collective eqns appear in the jaxpr regardless of mesh size),
+and the donation fixture never allocates: 100 MB exists only as a
+ShapeDtypeStruct.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = [
+    "rank_dependent_traces", "undonated_lowered", "donated_lowered",
+    "upcast_jaxpr", "host_sync_jaxpr", "clean_step", "UNDONATED_BYTES",
+]
+
+UNDONATED_BYTES = 100 * 1024 * 1024  # the planted 100MB param
+
+
+def _mesh():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:1]), ("dp",))
+
+
+def _shard_map(fn, mesh, n_in):
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    return shard_map(fn, mesh=mesh, in_specs=(P(),) * n_in,
+                     out_specs=P(), check_rep=False)
+
+
+def rank_dependent_traces() -> Dict[str, object]:
+    """Two traces of 'the same' step whose gradient dict arrived in a
+    different insertion order on each rank — the classic way a bucket
+    plan emits a rank-dependent collective order.  Returns
+    {label: jaxpr} for check_collective_uniformity, which must flag
+    the schedule divergence."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    mesh = _mesh()
+
+    def step_for(key_order):
+        def local(a, b):
+            grads = dict()
+            grads["w_small"] = a
+            grads["w_big"] = b
+            out = 0.0
+            for k in key_order:   # per-rank iteration order
+                out = out + jnp.sum(lax.psum(grads[k], "dp"))
+            return out
+
+        return _shard_map(local, mesh, 2)
+
+    a = jnp.ones((4,), jnp.float32)
+    b = jnp.ones((128,), jnp.float32)
+    return {
+        "rank0": jax.make_jaxpr(step_for(("w_small", "w_big")))(a, b),
+        "rank1": jax.make_jaxpr(step_for(("w_big", "w_small")))(a, b),
+    }
+
+
+def undonated_lowered():
+    """A param-update step whose 100MB parameter buffer is a jit input
+    but NOT donated: the program holds old + new params in HBM at
+    once.  Lowered from abstract specs — nothing is allocated."""
+    import jax
+    import numpy as np
+
+    def sgd(params, grads):
+        return params - 0.05 * grads
+
+    spec = jax.ShapeDtypeStruct((UNDONATED_BYTES // 4,), np.float32)
+    return jax.jit(sgd).lower(spec, spec)  # no donate_argnums: the bug
+
+
+def donated_lowered():
+    """The fixed twin of :func:`undonated_lowered`: params donated for
+    the in-place update, the consumed grads buffer donated as scratch."""
+    import jax
+    import numpy as np
+
+    def sgd(params, grads):
+        return params - 0.05 * grads
+
+    spec = jax.ShapeDtypeStruct((UNDONATED_BYTES // 4,), np.float32)
+    return jax.jit(sgd, donate_argnums=(0, 1)).lower(spec, spec)
+
+
+def upcast_jaxpr():
+    """A declared-bf16 matmul whose operands were silently cast to f32
+    first — the MXU-throughput-halving upcast the dtype check hunts."""
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(x):
+        y = x.astype(jnp.float32)   # the silent upcast
+        return (y @ y.T).astype(jnp.bfloat16)
+
+    x = jax.ShapeDtypeStruct((8, 8), jnp.bfloat16)
+    return jax.make_jaxpr(fwd)(x)
+
+
+def host_sync_jaxpr():
+    """A step with a host callback buried under a scan: one host
+    round-trip PER STEP of the scan."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    def body(c, x):
+        r = jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct((), np.float32),
+            x)
+        return c + r, x
+
+    def steps(xs):
+        out, _ = lax.scan(body, jnp.float32(0), xs)
+        return out
+
+    return jax.make_jaxpr(steps)(jax.ShapeDtypeStruct((4,), np.float32))
+
+
+def clean_step():
+    """A well-formed bucketed train step: bf16 matmul, deterministic
+    psum schedule, donated params.  Returns (fn, specs) suitable for
+    ``audit_step`` — every check must pass."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    mesh = _mesh()
+
+    def local(params, data):
+        h = data.astype(jnp.bfloat16) @ params
+        loss = jnp.sum(h.astype(jnp.float32))
+        grads = jax.grad(
+            lambda p: jnp.sum((data.astype(jnp.bfloat16) @ p)
+                              .astype(jnp.float32)))(params)
+        grads = lax.psum(grads, "dp")
+        return params - grads.astype(params.dtype) * 0.05, loss
+
+    fn = jax.jit(_shard_map(local, mesh, 2), donate_argnums=(0,))
+    specs = (jax.ShapeDtypeStruct((16, 16), jnp.bfloat16),
+             jax.ShapeDtypeStruct((8, 16), jnp.bfloat16))
+    return fn, specs

@@ -63,30 +63,14 @@ __all__ = [
     "FlightRecorder", "recorder", "record_collective", "record_start",
     "record_complete", "set_bucket_plan", "bucket_plan", "dump",
     "flight_enabled", "instrument_jit", "recompile_stats",
-    "reset_recompile_stats", "Gauge", "Counter", "Histogram",
-    "MetricsRegistry", "metrics", "record_step", "validate_prom_text",
+    "reset_recompile_stats", "recorded_steps", "Gauge", "Counter",
+    "Histogram", "MetricsRegistry", "metrics", "record_step",
+    "validate_prom_text",
 ]
 
 _log = logging.getLogger(__name__)
 
 DEFAULT_RING_SIZE = 256
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: Optional[float]) -> Optional[float]:
-    raw = os.environ.get(name)
-    if raw in (None, ""):
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
 
 
 def _dump_env() -> Tuple[bool, Optional[str]]:
@@ -96,7 +80,9 @@ def _dump_env() -> Tuple[bool, Optional[str]]:
     honored both ways — 1/true/yes/on request a dump at the configured
     path, 0/false/no/off (and unset/empty) disable it; any other value
     both requests the dump AND carries the output path."""
-    raw = os.environ.get("MXNET_FLIGHT_RECORDER_DUMP")
+    from . import env as _envmod
+
+    raw = _envmod.get_raw("MXNET_FLIGHT_RECORDER_DUMP")
     if raw in (None, "") or raw.lower() in ("0", "false", "no", "off"):
         return False, None
     if raw.lower() in ("1", "true", "yes", "on"):
@@ -126,8 +112,10 @@ class FlightRecorder:
 
     def __init__(self, capacity: Optional[int] = None):
         if capacity is None:
-            capacity = _env_int("MXNET_FLIGHT_RECORDER_SIZE",
-                                DEFAULT_RING_SIZE)
+            from . import env as _envmod
+
+            capacity = _envmod.get_int("MXNET_FLIGHT_RECORDER_SIZE",
+                                       DEFAULT_RING_SIZE)
         self.capacity = max(int(capacity), 0)
         # reentrant: the SIGTERM/SIGUSR1 handlers dump from the main
         # thread, which may already hold the lock inside start()
@@ -269,8 +257,9 @@ class FlightRecorder:
         present (rank 0 of 1 included) so ``--health`` can glob one
         pattern on any fleet size."""
         if base is None:
-            base = os.environ.get("MXNET_FLIGHT_RECORDER_FILE",
-                                  "flightrecorder.json")
+            from . import env as _envmod
+
+            base = _envmod.get_str("MXNET_FLIGHT_RECORDER_FILE")
             _, path_override = _dump_env()
             if path_override:
                 base = path_override  # the dump flag may carry the path
@@ -300,7 +289,9 @@ class FlightRecorder:
         the collective watchdog (when the timeout env is set)."""
         if not self._signals_installed:
             self.install_signal_handlers()
-        timeout = _env_float("MXNET_COLLECTIVE_TIMEOUT_S", None)
+        from . import env as _envmod
+
+        timeout = _envmod.get_float("MXNET_COLLECTIVE_TIMEOUT_S", None)
         if timeout and self._watchdog is None:
             self._start_watchdog(timeout)
 
@@ -475,10 +466,42 @@ def _atexit_dump() -> None:
 _recompile_lock = threading.RLock()
 _recompile: Dict[str, dict] = {}
 _recompile_warned: Dict[str, bool] = {}
+# name -> (wrapped jitted fn, last-compiled call's abstract arg specs,
+# step meta like compute_dtype): the static-analysis auditor
+# (mxnet_tpu/analysis) re-lowers each recorded step from these specs to
+# audit its jaxpr offline — captured only when a call actually
+# compiled, so the hot path pays nothing
+_recorded_steps: Dict[str, Tuple[Any, tuple, dict]] = {}
+
+
+def _arg_specs(args) -> tuple:
+    """Args with every array leaf replaced by its ShapeDtypeStruct —
+    enough to re-``lower`` the jitted function without holding (or
+    donating) live buffers."""
+    import jax
+
+    def spec(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        return x
+
+    return jax.tree_util.tree_map(spec, args)
+
+
+def recorded_steps() -> Dict[str, Tuple[Any, tuple, dict]]:
+    """{name: (jitted fn, arg specs, meta)} for every instrumented
+    compiled path that has compiled at least once in this process —
+    the auditor's work list."""
+    with _recompile_lock:
+        return dict(_recorded_steps)
 
 
 def _warn_threshold() -> int:
-    return _env_int("MXNET_RECOMPILE_WARN_N", 1)
+    from . import env as _envmod
+
+    return _envmod.get_int("MXNET_RECOMPILE_WARN_N", 1)
 
 
 def _avals_of(args) -> tuple:
@@ -511,9 +534,10 @@ class _InstrumentedJit:
     name on shape/dtype churn.  Every other attribute (``lower``, …)
     delegates to the wrapped function."""
 
-    def __init__(self, name: str, fn):
+    def __init__(self, name: str, fn, meta: Optional[dict] = None):
         self._name = name
         self._fn = fn
+        self._meta = dict(meta) if meta else {}
         self._seen: set = set()
         with _recompile_lock:
             _recompile.setdefault(name, {
@@ -552,6 +576,13 @@ class _InstrumentedJit:
             if avals is None:
                 avals = _avals_of(args)  # pay the walk on compiles only
             self._record_compile(avals, dur_ms)
+            try:
+                specs = _arg_specs(args)
+                with _recompile_lock:
+                    _recorded_steps[self._name] = (self, specs,
+                                                   self._meta)
+            except Exception:
+                pass  # audit hook is best-effort, never fails a step
         return out
 
     def _record_compile(self, avals, dur_ms: float) -> None:
@@ -604,11 +635,13 @@ class _InstrumentedJit:
         return getattr(self._fn, item)
 
 
-def instrument_jit(name: str, fn):
+def instrument_jit(name: str, fn, meta: Optional[dict] = None):
     """Wrap one jitted callable for recompile tracking (dp.py / bulk.py
     step builders).  Idempotent on the name: re-wrapping after a
-    rebuild keeps accumulating into the same stats row."""
-    return _InstrumentedJit(name, fn)
+    rebuild keeps accumulating into the same stats row.  ``meta``
+    (e.g. {'compute_dtype': 'bfloat16'}) rides along into
+    ``recorded_steps()`` for the static auditor."""
+    return _InstrumentedJit(name, fn, meta)
 
 
 def recompile_stats() -> Dict[str, dict]:
@@ -620,9 +653,13 @@ def recompile_stats() -> Dict[str, dict]:
 
 
 def reset_recompile_stats() -> None:
+    """Also drops the recorded-step tuples: each pins the LAST wrapper
+    (and its compiled executables) per step name for the auditor, so a
+    long-lived process that rebuilds steps can release them here."""
     with _recompile_lock:
         _recompile.clear()
         _recompile_warned.clear()
+        _recorded_steps.clear()
 
 
 def _register_jax_monitoring() -> None:
@@ -895,13 +932,15 @@ class MetricsRegistry:
 
     def flush(self, path: Optional[str] = None, force: bool = True
               ) -> Optional[str]:
+        from . import env as _envmod
+
         if path is None:
-            path = os.environ.get("MXNET_METRICS_FILE")
+            path = _envmod.get_str("MXNET_METRICS_FILE")
         if not path:
             return None
         # no `or` fallback: MXNET_METRICS_INTERVAL_S=0 legitimately
         # means flush on every step
-        interval = _env_float("MXNET_METRICS_INTERVAL_S", 30.0)
+        interval = _envmod.get_float("MXNET_METRICS_INTERVAL_S", 30.0)
         now = time.time()
         with self._lock:
             if not force and now - self._last_flush < interval:

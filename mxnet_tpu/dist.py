@@ -34,12 +34,16 @@ _initialized = False
 def env_spec():
     """The (coordinator, num_processes, process_id) triple from env, or
     None when no multi-host launch is configured."""
-    addr = os.environ.get("MXNET_COORDINATOR_ADDRESS")
+    from . import env as _env
+
+    addr = _env.get_str("MXNET_COORDINATOR_ADDRESS")
     if not addr:
         return None
+    # launch-critical: a malformed value must raise here, not silently
+    # fall back to a 1-process default that desyncs the pod
     return (addr,
-            int(os.environ.get("MXNET_NUM_PROCESSES", "1")),
-            int(os.environ.get("MXNET_PROCESS_ID", "0")))
+            int(_env.get_str("MXNET_NUM_PROCESSES")),
+            int(_env.get_str("MXNET_PROCESS_ID")))
 
 
 def initialize(coordinator_address: Optional[str] = None,

@@ -14,8 +14,9 @@ bulk-capable runners.
 """
 from __future__ import annotations
 
-import os
 from contextlib import contextmanager
+
+from . import env as _env
 
 __all__ = ["set_bulk_size", "bulk"]
 
@@ -24,10 +25,22 @@ _bulk_size = 15  # the reference default
 # (set_bulk_size call or MXNET_MODULE_BULK_SIZE env): it quantizes
 # lr-scheduler updates to K batches and skips grad_dict materialization,
 # which existing per-batch scripts must not inherit silently.
-_bulk_explicit = False
-if os.environ.get("MXNET_MODULE_BULK_SIZE"):
-    _bulk_size = int(os.environ["MXNET_MODULE_BULK_SIZE"])
-    _bulk_explicit = True
+# None = env not consulted yet: the read is LAZY (first bulk-size
+# query), not at import — launchers that set the env after this module
+# imports (per-worker env injection, tests) are honored.
+_bulk_explicit: bool | None = None
+
+
+def _consult_env() -> None:
+    global _bulk_size, _bulk_explicit
+    if _bulk_explicit is not None:
+        return
+    k = _env.get_int("MXNET_MODULE_BULK_SIZE")
+    if k:
+        _bulk_size = int(k)
+        _bulk_explicit = True
+    else:
+        _bulk_explicit = False
 
 
 def set_bulk_size(size: int) -> int:
@@ -36,6 +49,7 @@ def set_bulk_size(size: int) -> int:
     consumed at STEP granularity by Module.fit (K steps per dispatch,
     module/bulk.py) once this has been called."""
     global _bulk_size, _bulk_explicit
+    _consult_env()
     prev = _bulk_size
     _bulk_size = int(size)
     _bulk_explicit = True
@@ -45,6 +59,7 @@ def set_bulk_size(size: int) -> int:
 def fit_bulk_size() -> int:
     """K for Module.fit's bulk path: 1 (per-batch) unless the user
     explicitly opted in via set_bulk_size / MXNET_MODULE_BULK_SIZE."""
+    _consult_env()
     return _bulk_size if _bulk_explicit else 1
 
 
@@ -61,4 +76,5 @@ def bulk(size: int):
 def current_bulk_size() -> int:
     """The configured bulk segment size (consumed by bulk-capable
     runners like FusedTrainStep.run_steps)."""
+    _consult_env()
     return _bulk_size

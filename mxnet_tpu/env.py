@@ -1,0 +1,225 @@
+"""mx.env — central registry of ``MXNET_*`` environment knobs.
+
+The reference configured ~40 runtime knobs through scattered
+``dmlc::GetEnv`` calls (SURVEY.md §5); this rebuild had grown the same
+sprawl (buckets.py, diagnostics.py, profiler.py, remat.py, engine.py,
+_ps.py, ...), each site re-implementing parsing, defaults and
+truthiness.  This module is the ONE declaration site: every knob is
+registered here with its name, type, default and one-line doc, and
+every read goes through the typed accessors below.
+
+Why it matters beyond tidiness:
+
+  * ``tools/mxlint.py`` statically rejects reads of UNREGISTERED
+    ``MXNET_*`` names anywhere in ``mxnet_tpu/`` (a typo'd knob
+    silently falling back to its default is a config bug that costs a
+    cluster run to notice);
+  * registrations marked ``import_time=True`` document the few knobs
+    that are legitimately consumed while the package imports
+    (profiler autostart); everything else must be read lazily so
+    ``os.environ`` changes after import (tests, launchers that set env
+    per worker) keep working — mxlint flags module-level reads;
+  * :func:`describe` renders the registry as the canonical knob table
+    for docs and ``--help`` surfaces.
+
+Truthiness contract for ``bool`` knobs (shared with the flight
+recorder's dump flag): ``0/false/no/off`` (any case) are False,
+anything else set is True; unset/empty falls back to the registered
+default — consistent with every other accessor.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, NamedTuple, Optional
+
+__all__ = [
+    "EnvVar", "register", "registered", "is_registered", "var",
+    "get_raw", "get_str", "get_int", "get_float", "get_bool",
+    "describe",
+]
+
+_FALSE_SPELLINGS = ("0", "false", "no", "off")
+
+
+class EnvVar(NamedTuple):
+    """One registered knob: declaration == documentation."""
+    name: str
+    kind: str          # 'int' | 'float' | 'bool' | 'str'
+    default: Any
+    doc: str
+    import_time: bool = False  # consumed at package import by design
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def register(name: str, kind: str, default: Any, doc: str,
+             import_time: bool = False) -> EnvVar:
+    if kind not in ("int", "float", "bool", "str"):
+        raise ValueError("unknown env kind %r for %s" % (kind, name))
+    v = EnvVar(name, kind, default, doc, import_time)
+    _REGISTRY[name] = v
+    return v
+
+
+def registered() -> Dict[str, EnvVar]:
+    return dict(_REGISTRY)
+
+
+def is_registered(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def var(name: str) -> EnvVar:
+    v = _REGISTRY.get(name)
+    if v is None:
+        raise KeyError(
+            "environment variable %r is not registered in mxnet_tpu.env "
+            "— declare it there (one line: name, type, default, doc) "
+            "before reading it" % name)
+    return v
+
+
+_UNSET = object()
+
+
+def get_raw(name: str) -> Optional[str]:
+    """The raw environment string for a REGISTERED name (None if
+    unset).  Callers needing custom parsing (the flight recorder's
+    bool-or-path dump flag) start here."""
+    var(name)
+    return os.environ.get(name)
+
+
+def get_str(name: str, default: Any = _UNSET) -> Optional[str]:
+    v = var(name)
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return v.default if default is _UNSET else default
+    return raw
+
+
+def get_int(name: str, default: Any = _UNSET) -> Optional[int]:
+    v = var(name)
+    fallback = v.default if default is _UNSET else default
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return fallback
+    try:
+        return int(raw)
+    except ValueError:
+        return fallback
+
+
+def get_float(name: str, default: Any = _UNSET) -> Optional[float]:
+    v = var(name)
+    fallback = v.default if default is _UNSET else default
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return fallback
+    try:
+        return float(raw)
+    except ValueError:
+        return fallback
+
+
+def get_bool(name: str, default: Any = _UNSET) -> bool:
+    v = var(name)
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        # empty == unset -> registered default, like every other
+        # accessor (an empty export must not flip a default-True knob)
+        return bool(v.default) if default is _UNSET else bool(default)
+    return raw.lower() not in _FALSE_SPELLINGS
+
+
+def describe() -> str:
+    """Human-readable knob table (README / --help surface)."""
+    rows = []
+    for name in sorted(_REGISTRY):
+        v = _REGISTRY[name]
+        rows.append("%-32s %-5s default=%-12r %s%s"
+                    % (v.name, v.kind, v.default, v.doc,
+                       "  [import-time]" if v.import_time else ""))
+    return "\n".join(rows)
+
+
+# ---------------------------------------------------------------------------
+# The registry.  Grouped by owning module; the owning module still holds
+# the semantics, this is the declaration + documentation site.
+# ---------------------------------------------------------------------------
+
+# engine.py — step-level bulk execution
+register("MXNET_MODULE_BULK_SIZE", "int", None,
+         "Opt Module.fit into K-step bulk dispatch (module/bulk.py); "
+         "presence alone opts in, value is K.")
+
+# parallel/buckets.py — bucketed gradient all-reduce
+register("MXNET_KVSTORE_BUCKET_BYTES", "int", 4 * 1024 * 1024,
+         "Gradient all-reduce bucket size cap; 0 forces the monolithic "
+         "SPMD reduction.")
+register("MXNET_KVSTORE_BUCKET_CHAIN", "bool", True,
+         "Chain consecutive bucket reductions through "
+         "optimization_barrier (stops the all-reduce combiner).")
+register("MXNET_KVSTORE_BUCKET_IMPL", "str", "psum",
+         "Bucket reduction implementation: 'psum' or 'ring' "
+         "(manual ppermute reduce-scatter/all-gather).")
+
+# kvstore_server.py — parameter-server sync mode
+register("MXNET_KVSTORE_SYNC_TIMEOUT", "float", 600.0,
+         "Sync-pull progress deadline (seconds, resets on every applied "
+         "round) before a stalled round aborts.")
+
+# remat.py — mirror pass / rematerialization
+register("MXNET_BACKWARD_DO_MIRROR", "bool", False,
+         "Keep only conv/matmul residuals and rematerialize cheap "
+         "activations in backward (jax.checkpoint mirror policy).")
+
+# profiler.py — trace autostart (worker subprocess contract)
+register("MXNET_PROFILER_AUTOSTART", "bool", False,
+         "Start tracing at import and dump at exit (worker "
+         "subprocesses).", import_time=True)
+register("MXNET_PROFILER_FILENAME", "str", "profile.json",
+         "Trace dump filename for the autostart path.",
+         import_time=True)
+
+# dist.py / profiler rank contract — jax pod launch
+register("MXNET_COORDINATOR_ADDRESS", "str", None,
+         "host:port of process 0's coordination service; presence "
+         "enables multi-process initialization.")
+register("MXNET_NUM_PROCESSES", "int", 1,
+         "Number of processes in the pod launch contract.")
+register("MXNET_PROCESS_ID", "int", 0,
+         "This process's rank in the pod launch contract.")
+
+# _ps.py — parameter-server transport
+register("MXNET_PS_SECRET", "str", None,
+         "Shared HMAC secret authenticating PS messages.")
+register("MXNET_PS_REQUEST_TIMEOUT", "float", 900.0,
+         "Client-side PS request timeout (s); exceeds the server sync "
+         "window so tolerated stragglers are not aborted client-side.")
+register("MXNET_PS_HEARTBEAT_INTERVAL", "float", 5.0,
+         "Worker->scheduler heartbeat period (s).")
+
+# diagnostics.py — flight recorder / recompile tracking / metrics
+register("MXNET_FLIGHT_RECORDER_SIZE", "int", 256,
+         "Collective flight-recorder ring capacity; 0 disables.")
+register("MXNET_FLIGHT_RECORDER_FILE", "str", "flightrecorder.json",
+         "Basename for flightrecorder_rank{K}.json dumps.")
+register("MXNET_FLIGHT_RECORDER_DUMP", "str", None,
+         "Dump the ring at exit: bool spellings honored, any other "
+         "value is also the output path.")
+register("MXNET_COLLECTIVE_TIMEOUT_S", "float", None,
+         "Watchdog: collectives in flight longer than this are marked "
+         "suspect and the ring dumps (run keeps going).")
+register("MXNET_RECOMPILE_WARN_N", "int", 1,
+         "Warn RECOMPILATION STORM when one step function compiles "
+         "more than N times.")
+register("MXNET_METRICS_FILE", "str", None,
+         "Path for periodic Prometheus-text metric flushes.")
+register("MXNET_METRICS_INTERVAL_S", "float", 30.0,
+         "Period of the metrics file flush (s).")
+
+# image/image.py — decode pool
+register("MXNET_CPU_WORKER_NTHREADS", "int", 1,
+         "Decode worker threads for ImageIter augmentation.")

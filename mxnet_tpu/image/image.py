@@ -570,7 +570,9 @@ class ImageIter(io.DataIter):
         assert path_imgrec or path_imglist or (isinstance(imglist, list))
         assert dtype in ("int32", "float32", "int64", "float64"), \
             dtype + " label not supported"
-        num_threads = os.environ.get("MXNET_CPU_WORKER_NTHREADS", "1")
+        from .. import env as _env
+
+        num_threads = _env.get_int("MXNET_CPU_WORKER_NTHREADS")
         logging.info("Using %s threads for decoding...", num_threads)
         self.seq = None
         self.imgrec = None

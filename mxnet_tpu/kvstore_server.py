@@ -264,8 +264,9 @@ class KVStoreServer:
                 # overall deadline that RESETS whenever a round applies:
                 # a peer's slow first-step XLA compile between pushes is
                 # progress-adjacent, not a failure
-                window = float(os.environ.get(
-                    "MXNET_KVSTORE_SYNC_TIMEOUT", "600"))
+                from . import env as _env
+
+                window = _env.get_float("MXNET_KVSTORE_SYNC_TIMEOUT")
                 last_applied = st.applied
                 import time as _time
                 deadline = _time.monotonic() + window

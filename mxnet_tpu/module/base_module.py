@@ -254,7 +254,7 @@ class BaseModule:
                     try:
                         import jax as _jax
 
-                        _jax.block_until_ready(
+                        _jax.block_until_ready(  # mxlint: disable=MXL004
                             [o._data for outs_b in outs for o in outs_b])
                     except Exception:
                         pass

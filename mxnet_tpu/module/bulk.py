@@ -323,15 +323,22 @@ class BulkTrainLoop:
         # silently doubles epoch time
         from .. import diagnostics as _diag
 
+        plan_meta_v = _buckets.plan_meta(plan) if bucketed else None
         if bucketed:
-            _diag.set_bucket_plan(_buckets.plan_meta(plan),
-                                  owner=id(self))
+            _diag.set_bucket_plan(plan_meta_v, owner=id(self))
         else:
             # owned clear: drop only a stale plan THIS loop stamped,
             # not one a different live bucketed step runs under
             _diag.set_bucket_plan(None, owner=id(self))
+        # donate params/aux/optimizer-state (in-place update) AND the
+        # K-batch stack (argnum 3): run() builds it fresh every
+        # dispatch (jnp.stack), nothing else holds it, so the program
+        # reuses K batches of HBM as scratch instead of holding them
+        # alongside its intermediates
         self._bulk_fn = _diag.instrument_jit(
-            "Module.bulk_fit", jax.jit(bulk, donate_argnums=(0, 1, 2)))
+            "Module.bulk_fit",
+            jax.jit(bulk, donate_argnums=(0, 1, 2, 3)),
+            meta={"bucket_plan": plan_meta_v})
         self._n_outs = n_outs
         self._built = True
 

@@ -173,7 +173,8 @@ class BaseSparseNDArray(NDArray):
         parts = self._sp_parts
         if parts is not None:
             for v in parts.values():
-                v.block_until_ready()
+                # blocking IS this API's contract
+                v.block_until_ready()  # mxlint: disable=MXL004
         elif self._dense_cache is not None:
             self._dense_cache.block_until_ready()
 

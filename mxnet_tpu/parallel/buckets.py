@@ -36,8 +36,9 @@ callers fall back to the monolithic path).
 """
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Mapping, NamedTuple, Optional, Sequence, Tuple
+
+from .. import env as _env
 
 __all__ = [
     "DEFAULT_BUCKET_BYTES", "Bucket", "bucket_cap_bytes", "chain_enabled",
@@ -58,23 +59,20 @@ class Bucket(NamedTuple):
 def bucket_cap_bytes(default: int = DEFAULT_BUCKET_BYTES) -> int:
     """The size cap, env-tunable via MXNET_KVSTORE_BUCKET_BYTES.
     0 disables bucketing (callers use the monolithic reduction)."""
-    try:
-        return int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES", default))
-    except ValueError:
-        return default
+    return _env.get_int("MXNET_KVSTORE_BUCKET_BYTES", default)
 
 
 def chain_enabled() -> bool:
     """MXNET_KVSTORE_BUCKET_CHAIN=0 drops the optimization_barrier chain
     between consecutive bucket reductions (lets the combiner re-merge)."""
-    return os.environ.get("MXNET_KVSTORE_BUCKET_CHAIN", "1") != "0"
+    return _env.get_bool("MXNET_KVSTORE_BUCKET_CHAIN")
 
 
 def impl_name() -> str:
     """'psum' (default) or 'ring' (manual ppermute reduce-scatter/
     all-gather — collective-permutes can never be combined into one
     all-reduce, and are the pattern ring_attention.py already overlaps)."""
-    return os.environ.get("MXNET_KVSTORE_BUCKET_IMPL", "psum")
+    return _env.get_str("MXNET_KVSTORE_BUCKET_IMPL")
 
 
 def _nbytes(shape, dtype) -> int:

@@ -50,6 +50,30 @@ def _jax():
     return jax
 
 
+def _donate_safe_put(jax, arr, sharding):
+    """``device_put`` for a buffer the compiled step will DONATE.
+    ``device_put`` aliases its input when the placement already matches
+    — same object, or (single-device target) a NEW Array wrapping the
+    SAME buffer.  Donating an alias would consume a buffer the CALLER
+    still owns (their NDArray would die mid-training), so copy in the
+    aliased cases.  A genuine reshard onto multiple devices always
+    materializes fresh per-shard buffers and passes through free."""
+    placed = jax.device_put(arr, sharding)
+    if placed is not arr:
+        try:
+            # both single-shard: alias iff the device buffer is shared
+            if placed.unsafe_buffer_pointer() != \
+                    arr.unsafe_buffer_pointer():
+                return placed
+        except Exception:
+            # either side multi-shard: the reshard made fresh buffers
+            # (the matching-sharding case returns `arr` itself above)
+            return placed
+    import jax.numpy as jnp
+
+    return jax.device_put(jnp.copy(arr), sharding)
+
+
 def _shardings(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -241,9 +265,10 @@ class FusedTrainStep:
         # is issuing (diagnostics.py; --health cross-checks it per rank)
         from .. import diagnostics as _diag
 
+        plan_meta_v = _buckets.plan_meta(plan, cap) if self._bucketed \
+            else None
         if self._bucketed:
-            _diag.set_bucket_plan(_buckets.plan_meta(plan, cap),
-                                  owner=id(self))
+            _diag.set_bucket_plan(plan_meta_v, owner=id(self))
         else:
             # clear a stale plan THIS step stamped on an earlier
             # bucketed build (it reduces monolithically now and its
@@ -336,6 +361,19 @@ class FusedTrainStep:
                                  key_root, ctr, sharded=False)
 
         donate = (0, 1)  # params + momenta buffers are donated: in-place update
+        # the K-step variants additionally donate the batch buffers
+        # (argnums 2, 3): run_steps re-places them per dispatch through
+        # _donate_safe_put, so the program may reuse K batches of HBM
+        # as scratch (ROADMAP item 5).  The single-step path keeps
+        # data/label UNdonated: bench and user loops legitimately feed
+        # the same committed batch every call (the auditor's committed
+        # baseline records this as accepted).
+        donate_k = (0, 1, 2, 3)
+        # per-site audit metadata: the auditor cross-checks THIS
+        # step's traced collective schedule against THIS plan (the
+        # global flight-recorder header may belong to another step)
+        step_meta = {"compute_dtype": str(_jnp.dtype(compute_dtype)),
+                     "bucket_plan": plan_meta_v}
         # recompile tracking (diagnostics.py): count/time every XLA
         # compilation these step programs trigger and warn on
         # shape/dtype churn — a silent recompilation storm doubles step
@@ -349,7 +387,7 @@ class FusedTrainStep:
                 out_shardings=(self._param_sh, self._param_sh, rep,
                                data_sh),
                 donate_argnums=donate,
-            ))
+            ), meta=step_meta)
 
         # K steps inside ONE program via lax.scan — the TPU analogue of
         # the reference engine's bulk execution (engine.set_bulk_size):
@@ -380,8 +418,8 @@ class FusedTrainStep:
                 in_shardings=(self._param_sh, self._param_sh, kdata_sh,
                               kdata_sh, rep, rep),
                 out_shardings=(self._param_sh, self._param_sh, rep),
-                donate_argnums=donate,
-            ))
+                donate_argnums=donate_k,
+            ), meta=step_meta)
 
         # same-batch variant: the batch is closed over once instead of
         # materializing K copies in HBM (bench/burn-in path)
@@ -407,8 +445,8 @@ class FusedTrainStep:
                     in_shardings=(self._param_sh, self._param_sh, data_sh,
                                   data_sh, rep, rep),
                     out_shardings=(self._param_sh, self._param_sh, rep),
-                    donate_argnums=donate,
-                ))
+                    donate_argnums=donate_k,
+                ), meta=step_meta)
 
         self._multi_step_same = {}
         self._multi_step_same_fn = multi_step_same
@@ -489,10 +527,11 @@ class FusedTrainStep:
 
         if steps is not None:
             # same batch every step: close over ONE on-device copy
-            # instead of materializing K in HBM
+            # instead of materializing K in HBM (donated to the program
+            # — _donate_safe_put never aliases the caller's buffer)
             k = int(steps)
-            raw_data = jax.device_put(raw_data, self._data_sh)
-            raw_label = jax.device_put(raw_label, self._data_sh)
+            raw_data = _donate_safe_put(jax, raw_data, self._data_sh)
+            raw_label = _donate_safe_put(jax, raw_label, self._data_sh)
             runner = self._multi_step_same.get(k)
             if runner is None:
                 runner = self._multi_step_same_fn(k)
@@ -500,8 +539,8 @@ class FusedTrainStep:
         else:
             k = raw_data.shape[0]
             kdata_sh = NamedSharding(self.mesh, P(None, "dp"))
-            raw_data = jax.device_put(raw_data, kdata_sh)
-            raw_label = jax.device_put(raw_label, kdata_sh)
+            raw_data = _donate_safe_put(jax, raw_data, kdata_sh)
+            raw_label = _donate_safe_put(jax, raw_label, kdata_sh)
             runner = self._multi_step
         params = self._param_vals
         for i, p in enumerate(self._cells):

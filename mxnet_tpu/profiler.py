@@ -205,11 +205,14 @@ def _dist_info() -> Tuple[int, int]:
     dist.py's jax pod contract sets MXNET_PROCESS_ID)."""
     if _rank_override is not None:
         return _rank_override
-    env = os.environ
-    for rank_key, num_key in (("DMLC_WORKER_ID", "DMLC_NUM_WORKER"),
-                              ("MXNET_PROCESS_ID", "MXNET_NUM_PROCESSES")):
-        if env.get(rank_key) is not None:
-            return int(env[rank_key]), int(env.get(num_key, "1"))
+    if os.environ.get("DMLC_WORKER_ID") is not None:
+        return (int(os.environ["DMLC_WORKER_ID"]),
+                int(os.environ.get("DMLC_NUM_WORKER", "1")))
+    from . import env as _env
+
+    pid = _env.get_str("MXNET_PROCESS_ID", None)
+    if pid is not None:
+        return int(pid), _env.get_int("MXNET_NUM_PROCESSES")
     return 0, 1
 
 
@@ -585,12 +588,14 @@ atexit.register(_shutdown)
 # (via the shared _shutdown hook above).
 # ---------------------------------------------------------------------------
 def _autostart():
-    if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") \
-            not in ("1", "true", "True"):
+    # registered import_time=True in env.py: the autostart contract IS
+    # an import-time read (worker subprocesses self-start tracing)
+    from . import env as _env
+
+    if not _env.get_bool("MXNET_PROFILER_AUTOSTART"):
         return
     set_config(profile_all=True,
-               filename=os.environ.get("MXNET_PROFILER_FILENAME",
-                                       "profile.json"))
+               filename=_env.get_str("MXNET_PROFILER_FILENAME"))
     set_state("run")
 
 

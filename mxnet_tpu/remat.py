@@ -26,7 +26,7 @@ The knob keeps the reference's env name and truthiness; it is read at
 program *build* time (bind / first step), matching the reference, which
 consults it during graph init.
 """
-import os
+from . import env as _env
 
 __all__ = ["mirror_enabled", "mirror_policy", "maybe_checkpoint"]
 
@@ -39,9 +39,9 @@ _SAVEABLE_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
 
 
 def mirror_enabled() -> bool:
-    """Reference env contract: any value but 0/empty/false enables."""
-    v = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0")
-    return v not in ("", "0", "false", "False", "FALSE")
+    """Any value but the shared falsy spellings (0/false/no/off, any
+    case; unset/empty keeps the default, False) enables."""
+    return _env.get_bool("MXNET_BACKWARD_DO_MIRROR")
 
 
 def mirror_policy():

@@ -73,6 +73,8 @@ def _public(names):
 
 
 def test_every_reference_creator_resolves():
+    if not any(os.path.isdir(d) for d in REF_OP_DIRS):
+        pytest.skip("reference source tree not present on this box")
     pytest.importorskip("jax")
     import mxnet_tpu  # noqa: F401  (triggers every registration)
     from mxnet_tpu.ops import registry

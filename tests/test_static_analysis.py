@@ -1,0 +1,285 @@
+"""Static-analysis subsystem: jaxpr auditor (mxnet_tpu/analysis) +
+mxlint (tools/mxlint.py) + the central env registry (mxnet_tpu/env.py).
+
+Covers the ISSUE-6 acceptance contract: every seeded fixture violation
+(rank-dependent collective order, undonated 100MB buffer, bf16->f32
+upcast, host callback) is flagged; the REAL compiled paths
+(FusedTrainStep.step / multi_step on the CPU mesh, Module.bulk_fit)
+pass clean against the committed baseline; mxlint reports zero
+unregistered MXNET_* env reads.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, diagnostics, env, gluon
+from mxnet_tpu.analysis import auditor, fixtures
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# seeded fixture violations -> the auditor must flag each
+# ---------------------------------------------------------------------------
+def test_fixture_rank_dependent_collective_order():
+    traces = fixtures.rank_dependent_traces()
+    found = auditor.check_collective_uniformity(traces, "fx")
+    assert found and found[0].check == "collective-uniformity"
+    assert found[0].severity == "error"
+    # the divergence point is named, --health style
+    assert "divergence" in found[0].message
+
+
+def test_fixture_undonated_100mb_buffer():
+    found, summary = auditor.check_donation(
+        fixtures.undonated_lowered(), "fx")
+    assert found and found[0].check == "donation"
+    assert found[0].details["wasted_bytes"] >= fixtures.UNDONATED_BYTES
+    assert summary["donated_bytes"] == 0
+    # the donated twin is clean
+    clean, summary2 = auditor.check_donation(
+        fixtures.donated_lowered(), "fx")
+    assert not clean
+    assert summary2["donated_bytes"] >= fixtures.UNDONATED_BYTES
+
+
+def test_fixture_bf16_upcast():
+    found = auditor.check_dtype(fixtures.upcast_jaxpr(), "fx",
+                                "bfloat16")
+    assert found and found[0].check == "dtype"
+    assert found[0].details["n_wide"] >= 1
+    # an f32-declared path upcasts nothing by definition
+    assert auditor.check_dtype(fixtures.upcast_jaxpr(), "fx",
+                               "float32") == []
+
+
+def test_fixture_host_callback_under_scan():
+    found = auditor.check_host_sync(fixtures.host_sync_jaxpr(), "fx")
+    assert found and found[0].check == "host-sync"
+    assert found[0].details["prim"] == "pure_callback"
+
+
+def test_clean_fixture_passes_all_checks():
+    fn, specs = fixtures.clean_step()
+    findings, meta = auditor.audit_step(fn, specs, site="fx.clean",
+                                        compute_dtype="bfloat16")
+    assert findings == []
+    assert meta["n_collectives"] >= 1
+    assert meta["donation"]["donated_bytes"] > 0
+
+
+def test_baseline_suppression_roundtrip():
+    found, _ = auditor.check_donation(fixtures.undonated_lowered(),
+                                      "fx")
+    fp = found[0].fingerprint()
+    new, suppressed = auditor.apply_baseline(found, {fp})
+    assert new == [] and suppressed == found
+    new2, suppressed2 = auditor.apply_baseline(found, set())
+    assert new2 == found and suppressed2 == []
+
+
+# ---------------------------------------------------------------------------
+# real compiled paths on the CPU mesh
+# ---------------------------------------------------------------------------
+def _fused_step(dtype=None, n_dev=2):
+    import jax
+
+    from mxnet_tpu.parallel.dp import FusedTrainStep
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((n_dev,), ("dp",), jax.devices()[:n_dev])
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, dtype=dtype)
+    X = mx.nd.array(np.random.uniform(size=(8, 16)).astype("float32"))
+    y = mx.nd.array(np.random.randint(0, 10, 8).astype("float32"))
+    return step, X, y
+
+
+def test_fused_train_step_audits_clean():
+    step, X, y = _fused_step()
+    step(X, y)                      # compiles + records .step
+    step.run_steps(X, y, steps=2)   # compiles + records multi_step_same
+    assert step.bucketed
+    names = ["FusedTrainStep.step", "FusedTrainStep.multi_step_same[k=2]"]
+    report = auditor.audit_recorded_steps(names=names)
+    assert report.n_findings == 0, report.summary()
+    assert set(names) <= set(report.sites)
+    for name in names:
+        meta = report.sites[name]
+        assert "audit_error" not in meta, meta
+        # bucketed build: the gradient psum(s) + the loss pmean
+        assert meta["n_collectives"] >= 2
+        assert meta["donation"]["donated_bytes"] > 0
+
+
+def test_fused_train_step_bf16_dtype_clean():
+    step, X, y = _fused_step(dtype="bfloat16")
+    step(X, y)
+    report = auditor.audit_recorded_steps(names=["FusedTrainStep.step"])
+    assert report.n_findings == 0, report.summary()
+
+
+def test_bucket_plan_embedded_in_traced_schedule():
+    step, X, y = _fused_step()
+    step(X, y)
+    plan = diagnostics.bucket_plan()
+    assert plan and plan["n_buckets"] >= 1
+    fn, specs, _meta = diagnostics.recorded_steps()["FusedTrainStep.step"]
+    import jax
+
+    jaxpr = jax.make_jaxpr(getattr(fn, "_fn", fn))(*specs)
+    assert auditor.check_bucket_plan(jaxpr, plan,
+                                     "FusedTrainStep.step") == []
+    # a plan the program does NOT implement is flagged
+    fake = dict(plan)
+    fake["buckets"] = [{"bucket": 0, "n_grads": 1,
+                        "bytes": 123456789, "dtype": "float32"}]
+    bad = auditor.check_bucket_plan(jaxpr, fake, "FusedTrainStep.step")
+    assert bad and bad[0].check == "collective-uniformity"
+
+
+def test_bulk_fit_audits_clean():
+    from mxnet_tpu import engine
+
+    x = mx.sym.Variable("data")
+    x = mx.sym.FullyConnected(x, num_hidden=16, name="fc1")
+    out = mx.sym.SoftmaxOutput(x, name="softmax")
+    X = np.random.rand(32, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 4.0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(out)
+    prev = engine.set_bulk_size(4)
+    try:
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.1),),
+                initializer=mx.init.Xavier())
+    finally:
+        engine.set_bulk_size(prev)
+    assert "Module.bulk_fit" in diagnostics.recorded_steps(), \
+        "bulk path did not record (fell back per-batch?)"
+    report = auditor.audit_recorded_steps(names=["Module.bulk_fit"])
+    assert report.n_findings == 0, report.summary()
+    meta = report.sites["Module.bulk_fit"]
+    assert "audit_error" not in meta, meta
+    # params + optimizer state + the K-batch stack are all donated
+    assert meta["donation"]["donated_bytes"] > 0
+
+
+def test_run_steps_donation_never_consumes_caller_batch():
+    step, X, y = _fused_step()
+    step.run_steps(X, y, steps=2)
+    step.run_steps(X, y, steps=2)   # same NDArrays again
+    Xk = mx.nd.array(np.random.uniform(size=(2, 8, 16))
+                     .astype("float32"))
+    yk = mx.nd.array(np.random.randint(0, 10, (2, 8))
+                     .astype("float32"))
+    step.run_steps(Xk, yk)
+    step.run_steps(Xk, yk)
+    # the caller's buffers survived every donated dispatch
+    assert X.asnumpy().shape == (8, 16)
+    assert Xk.asnumpy().shape == (2, 8, 16)
+
+
+# ---------------------------------------------------------------------------
+# CLI gates (the tier-1 wiring)
+# ---------------------------------------------------------------------------
+def _run(args, **env_over):
+    env_full = dict(os.environ, JAX_PLATFORMS="cpu", **env_over)
+    return subprocess.run([sys.executable] + args, cwd=REPO,
+                          capture_output=True, text=True, timeout=300,
+                          env=env_full)
+
+
+def test_analysis_self_test_cli():
+    r = _run(["-m", "mxnet_tpu.analysis", "--self-test"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "self-test OK" in r.stdout
+
+
+def test_mxlint_self_test_cli():
+    r = _run(["-m", "tools.mxlint", "--self-test"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_mxlint_repo_clean():
+    """Zero NEW findings over mxnet_tpu/ — in particular zero
+    unregistered MXNET_* env reads (the registry acceptance
+    criterion)."""
+    out_json = os.path.join(REPO, ".mxlint_ci.json")
+    try:
+        r = _run(["-m", "tools.mxlint", "--json", out_json])
+        assert r.returncode == 0, r.stdout + r.stderr
+        data = json.load(open(out_json))
+        assert data["n_findings"] == 0
+        assert not [f for f in data["findings"]
+                    if f["code"] in ("MXL001", "MXL005")]
+    finally:
+        if os.path.exists(out_json):
+            os.remove(out_json)
+
+
+# ---------------------------------------------------------------------------
+# env registry
+# ---------------------------------------------------------------------------
+def test_env_registry_typed_accessors(monkeypatch):
+    assert env.is_registered("MXNET_KVSTORE_BUCKET_BYTES")
+    monkeypatch.delenv("MXNET_KVSTORE_BUCKET_BYTES", raising=False)
+    assert env.get_int("MXNET_KVSTORE_BUCKET_BYTES") == 4 * 1024 * 1024
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "1024")
+    assert env.get_int("MXNET_KVSTORE_BUCKET_BYTES") == 1024
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "junk")
+    assert env.get_int("MXNET_KVSTORE_BUCKET_BYTES") == 4 * 1024 * 1024
+    for spelling, want in (("0", False), ("off", False), ("No", False),
+                           ("1", True), ("yes", True), ("ON", True)):
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", spelling)
+        assert env.get_bool("MXNET_BACKWARD_DO_MIRROR") is want, spelling
+
+
+def test_env_registry_rejects_unregistered():
+    with pytest.raises(KeyError):
+        env.get_str("MXNET_NOT_A_REAL_KNOB")
+    with pytest.raises(KeyError):
+        env.get_int("MXNET_ALSO_NOT_REAL")
+
+
+def test_env_registry_describe_lists_every_knob():
+    desc = env.describe()
+    for name in ("MXNET_KVSTORE_BUCKET_BYTES", "MXNET_METRICS_FILE",
+                 "MXNET_PROFILER_AUTOSTART"):
+        assert name in desc
+
+
+def test_registered_call_sites_honor_env(monkeypatch):
+    from mxnet_tpu import remat
+    from mxnet_tpu.parallel import buckets
+
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    assert remat.mirror_enabled()
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "no")
+    assert not remat.mirror_enabled()
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "0")
+    assert buckets.bucket_cap_bytes() == 0
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_CHAIN", "false")
+    assert not buckets.chain_enabled()
+
+
+def test_engine_bulk_env_read_is_lazy():
+    """MXNET_MODULE_BULK_SIZE set AFTER import must still be honored —
+    the import-time read mxlint flags (MXL005) was a real bug for
+    launchers that inject env per worker post-import."""
+    code = ("import mxnet_tpu.engine as e; import os; "
+            "os.environ['MXNET_MODULE_BULK_SIZE'] = '7'; "
+            "assert e.fit_bulk_size() == 7, e.fit_bulk_size(); "
+            "print('lazy-ok')")
+    r = _run(["-c", code])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "lazy-ok" in r.stdout
