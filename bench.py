@@ -970,6 +970,11 @@ def _setup_compile_cache():
                                       "/tmp/bench_xla_cache")
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    # telemetry dumps (flightrecorder_rank*.json, profile_rank*.json)
+    # from the bench and its probe children go to an artifact dir, not
+    # the repo root (diagnostics._dump_dir_path honors this; an
+    # explicit MXNET_DUMP_DIR from the caller wins via setdefault)
+    os.environ.setdefault("MXNET_DUMP_DIR", "/tmp/bench_artifacts")
     try:
         os.makedirs(cache_dir, exist_ok=True)
         import jax

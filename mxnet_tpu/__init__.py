@@ -41,6 +41,8 @@ from . import recordio
 from . import image
 from . import profiler
 from . import diagnostics
+from . import checkpoint
+from . import chaos
 from . import analysis
 from . import monitor
 from . import monitor as mon  # ref: python/mxnet/__init__.py:63 alias

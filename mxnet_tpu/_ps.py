@@ -67,6 +67,30 @@ def heartbeat_interval() -> float:
     return _env.get_float("MXNET_PS_HEARTBEAT_INTERVAL")
 
 
+def retry_max() -> int:
+    from . import env as _env
+
+    return max(_env.get_int("MXNET_PS_RETRY_MAX"), 0)
+
+
+def retry_backoff_s() -> float:
+    from . import env as _env
+
+    return max(_env.get_float("MXNET_PS_RETRY_BACKOFF_S"), 0.0)
+
+
+def backoff_delays(attempts: int) -> List[float]:
+    """Exponential backoff with +-50% jitter: base * 2^i, jittered, one
+    delay per retry attempt.  Jitter keeps a fleet of workers that all
+    saw the same server blip from resending in lockstep (the
+    thundering-herd ps-lite avoids with its own resend timers)."""
+    import random as _random
+
+    base = retry_backoff_s()
+    return [base * (2 ** i) * (0.5 + _random.random())
+            for i in range(attempts)]
+
+
 def bind_host() -> str:
     """The interface servers/scheduler listen on: loopback for loopback
     clusters, all interfaces only when the cluster spans hosts.
@@ -300,6 +324,29 @@ class Client:
         self.broken = False
         self.lock = threading.Lock()
 
+    def _chaos_fault(self, msg: Any) -> None:
+        """Fault-injection point for the chaos harness: a 'drop_push'
+        rule matching this push's (rank, key) simulates a network loss
+        — mode=request loses the request before it is sent,
+        mode=response (default, the hard case) delivers the request but
+        loses the reply, so the caller's retry RESENDS and the server
+        must dedupe the duplicate via pseq."""
+        from . import chaos as _chaos
+
+        if not isinstance(msg, dict) or msg.get("op") != "push":
+            return
+        rule = _chaos.fault("drop_push", rank=msg.get("worker"),
+                            key=msg.get("key"))
+        if rule is None:
+            return
+        mode = str(rule.params.get("mode", "response"))
+        if mode != "request":
+            send_msg(self.sock, msg)  # the server DID get this push
+        self.broken = True
+        raise ConnectionError(
+            "chaos: dropped push %s for key %r (rank %s)"
+            % (mode, msg.get("key"), msg.get("worker")))
+
     def request(self, msg: Any, timeout: Optional[float] = None) -> Any:
         t = timeout if timeout is not None else (
             self.timeout if self.timeout is not None else request_timeout())
@@ -311,6 +358,10 @@ class Client:
                     "interrupted exchange)" % self.addr)
             try:
                 self.sock.settimeout(t)
+                from . import chaos as _chaos
+
+                if _chaos.enabled():
+                    self._chaos_fault(msg)
                 send_msg(self.sock, msg)
                 return recv_msg(self.sock)
             except socket.timeout:
@@ -347,18 +398,28 @@ class Client:
 
 
 class Heartbeat:
-    """Background liveness beacon: a daemon thread on its own scheduler
-    connection (barriers block the main connection, so heartbeats ride a
-    side channel)."""
+    """Background liveness beacon + dead-peer detector: a daemon thread
+    on its own scheduler connection (barriers block the main connection,
+    so heartbeats ride a side channel).
+
+    Each beat also asks the scheduler for peers whose heartbeat has
+    aged out (``dead_nodes``, the ps::Postoffice::GetDeadNodes role) and
+    feeds the answer to ``diagnostics.set_dead_peers`` — every flight-
+    recorder dump header then names them, and ``merge_traces.py
+    --health`` reports them next to the desync laggards.  A peer is
+    declared dead after missing ~3 beats (``3 x
+    MXNET_PS_HEARTBEAT_INTERVAL``, floor 1s)."""
 
     def __init__(self, role: str, rank: int):
         self.role, self.rank = role, rank
+        self.dead: List[str] = []
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     def _run(self):
         interval = heartbeat_interval()
+        dead_after = max(3.0 * interval, 1.0)
         client = None
         while not self._stop.wait(interval):
             try:
@@ -366,12 +427,35 @@ class Heartbeat:
                     client = connect_scheduler(retries=1)
                 client.request({"op": "heartbeat", "role": self.role,
                                 "rank": self.rank}, timeout=interval)
+                resp = client.request({"op": "dead_nodes",
+                                       "timeout": dead_after},
+                                      timeout=interval)
+                dead = sorted(resp.get("dead", [])) if resp else []
+                me = "%s:%d" % (self.role, self.rank)
+                dead = [d for d in dead if d != me]
+                if dead != self.dead:
+                    self.dead = dead
+                    self._publish(dead)
             except (OSError, ConnectionError):
                 if client is not None:
                     client.close()
                 client = None
         if client is not None:
             client.close()
+
+    def _publish(self, dead: List[str]) -> None:
+        try:
+            from . import diagnostics as _diag
+
+            _diag.set_dead_peers(dead)
+            # unconditional, including 0: a recovered peer must clear
+            # the gauge, or alerts see a dead peer in a healthy fleet
+            _diag.metrics.gauge(
+                "mxnet_ps_dead_peers",
+                help="peers whose scheduler heartbeat aged out"
+            ).set(len(dead))
+        except Exception:
+            pass  # liveness telemetry must never kill the beacon
 
     def stop(self):
         self._stop.set()

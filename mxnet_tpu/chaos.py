@@ -1,0 +1,341 @@
+"""mx.chaos — env-driven fault injection: the harness that PROVES the
+fault-tolerance layer recovers instead of asserting that it would.
+
+The reference's dist kvstore was hardened by nightly adversarial tests
+(tests/nightly/dist_sync_kvstore.py) that could only exercise faults
+the *test script* could produce.  This module injects faults inside the
+runtime itself, where real failures happen: the PS transport, the
+collective record path, and the training step.  Rules come from ONE
+env knob so the same unmodified training script can be run healthy or
+under fault (``tools/launch.py`` children inherit it):
+
+    MXNET_CHAOS="drop_push:rank=1,nth=2;kill:rank=1,step=5"
+
+Grammar: semicolon-separated rules, each ``kind:key=val,key=val``.
+Every rule fires ``count`` times (default 1) once its match conditions
+hold; ``nth`` skips the first nth-1 candidate events.  Kinds:
+
+  * ``drop_push``      — the PS transport loses a push exchange on the
+    matching rank (``mode=response`` drops the server's reply AFTER
+    delivery — the hard case: retry must resend and the server must
+    dedupe via pseq; ``mode=request`` drops the request itself).
+    Match keys: ``rank``, ``key``, ``nth``, ``count``, ``mode``.
+  * ``delay_collective`` — sleep ``ms`` (default 200) before the
+    matching collective is recorded/issued.  Match keys: ``rank``,
+    ``op``, ``nth``, ``count``, ``ms``.
+  * ``kill``           — ``os._exit(137)`` mid-step (after
+    forward/backward, before update) at global step ``step`` on
+    ``rank`` — a SIGKILL-grade preemption the checkpoint/resume path
+    must absorb.  Match keys: ``rank``, ``step``.
+  * ``nan_grad``       — poison every gradient with NaN at global step
+    ``step`` on ``rank`` — what the ``MXNET_SKIP_NONFINITE_GRADS``
+    guard must catch before the push poisons the fleet.  Match keys:
+    ``rank``, ``step``, ``count``.
+
+Injected faults count into ``mxnet_chaos_injected_total{kind=...}``
+(diagnostics.metrics) so a test can assert the fault actually fired —
+a chaos test whose fault silently failed to inject proves nothing.
+
+``python -m mxnet_tpu.chaos --self-test`` exercises parsing, matching,
+nth/count windows and the injection counters (tier-1 via
+tests/test_fault_tolerance.py).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Rule", "rules", "enabled", "fault", "should_kill",
+           "injected_total", "reset", "KILL_EXIT_CODE"]
+
+_log = logging.getLogger(__name__)
+
+#: the exit code chaos 'kill' dies with — 128+9, what a real SIGKILL'd
+#: worker reports through the launcher
+KILL_EXIT_CODE = 137
+
+_INT_KEYS = ("rank", "nth", "count", "step")
+_FLOAT_KEYS = ("ms",)
+
+
+class Rule:
+    """One parsed fault rule + its firing state."""
+
+    def __init__(self, kind: str, params: Dict[str, Any]):
+        self.kind = kind
+        self.params = params
+        self.nth = int(params.get("nth", 1))
+        self.count = int(params.get("count", 1))
+        self.seen = 0    # matching candidate events observed
+        self.fired = 0   # faults actually injected
+        self._lock = threading.Lock()
+
+    def matches(self, ctx: Dict[str, Any]) -> bool:
+        """Every match key present in the rule must equal the context's
+        value (string-compared for non-numeric keys like ``key``/``op``;
+        a context that omits the key does not match)."""
+        for k, want in self.params.items():
+            if k in ("nth", "count", "ms", "mode"):
+                continue
+            if k not in ctx:
+                return False
+            have = ctx[k]
+            if isinstance(want, (int, float)):
+                try:
+                    if int(have) != int(want):
+                        return False
+                except (TypeError, ValueError):
+                    return False
+            elif str(have) != str(want):
+                return False
+        return True
+
+    def try_fire(self, ctx: Dict[str, Any]) -> bool:
+        """Candidate event -> does this rule inject now?  (nth-windowed,
+        count-limited, thread-safe.)"""
+        if not self.matches(ctx):
+            return False
+        with self._lock:
+            self.seen += 1
+            if self.seen < self.nth or self.fired >= self.count:
+                return False
+            self.fired += 1
+            return True
+
+    def describe(self) -> str:
+        return "%s:%s" % (self.kind, ",".join(
+            "%s=%s" % (k, v) for k, v in sorted(self.params.items())))
+
+
+def parse_spec(spec: str) -> List[Rule]:
+    out: List[Rule] = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, rest = part.partition(":")
+        kind = kind.strip()
+        params: Dict[str, Any] = {}
+        for kv in rest.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            k, v = k.strip(), v.strip()
+            if k in _INT_KEYS:
+                params[k] = int(v)
+            elif k in _FLOAT_KEYS:
+                params[k] = float(v)
+            else:
+                params[k] = v
+        out.append(Rule(kind, params))
+    return out
+
+
+_lock = threading.Lock()
+_cached_spec: Optional[str] = None
+_cached_rules: List[Rule] = []
+
+
+def rules() -> List[Rule]:
+    """Rules parsed from MXNET_CHAOS, cached per spec value (firing
+    state lives on the Rule objects, so re-reads must not reparse while
+    the spec is unchanged)."""
+    global _cached_spec, _cached_rules
+    from . import env as _env
+
+    spec = _env.get_str("MXNET_CHAOS") or ""
+    with _lock:
+        if spec != _cached_spec:
+            _cached_spec = spec
+            _cached_rules = parse_spec(spec)
+            if _cached_rules:
+                _log.warning(
+                    "CHAOS INJECTION ACTIVE: %s",
+                    "; ".join(r.describe() for r in _cached_rules))
+        return list(_cached_rules)
+
+
+def reset() -> None:
+    """Forget parsed rules + firing state (tests)."""
+    global _cached_spec, _cached_rules
+    with _lock:
+        _cached_spec = None
+        _cached_rules = []
+
+
+def enabled() -> bool:
+    """Hot-path guard (called per PS request / per recorded
+    collective): when MXNET_CHAOS is unset this is one env lookup, no
+    lock, no parse — production runs pay nothing for the harness."""
+    from . import env as _env
+
+    if not _env.get_str("MXNET_CHAOS"):
+        return False
+    return bool(rules())
+
+
+def _default_rank(ctx: Dict[str, Any]) -> Dict[str, Any]:
+    if "rank" not in ctx:
+        try:
+            from . import profiler as _profiler
+
+            ctx = dict(ctx, rank=_profiler._dist_info()[0])
+        except Exception:
+            pass
+    return ctx
+
+
+def _count_injection(kind: str) -> None:
+    try:
+        from . import diagnostics as _diag
+
+        _diag.metrics.counter(
+            "mxnet_chaos_injected_total",
+            help="faults injected by the chaos harness",
+            labels={"kind": kind}).inc()
+    except Exception:
+        pass
+
+
+def fault(kind: str, **ctx) -> Optional[Rule]:
+    """The injection points' one question: should a ``kind`` fault fire
+    for this event?  Returns the firing rule (params carry ``ms``/
+    ``mode``/... for the caller to act on) or None.  ``rank`` defaults
+    to this process's rank.  Never raises — a broken chaos spec must
+    not take down a healthy run."""
+    try:
+        rs = rules()
+        if not rs:
+            return None
+        ctx = _default_rank(ctx)
+        for r in rs:
+            if r.kind == kind and r.try_fire(ctx):
+                _log.warning("chaos: injecting %s (%s) at %s",
+                             kind, r.describe(), ctx)
+                _count_injection(kind)
+                return r
+        return None
+    except Exception:
+        return None
+
+
+def maybe_delay(op: str, **ctx) -> None:
+    """delay_collective hook (diagnostics.record path): sleep ms when a
+    rule fires."""
+    r = fault("delay_collective", op=op, **ctx)
+    if r is not None:
+        time.sleep(float(r.params.get("ms", 200.0)) / 1e3)
+
+
+def should_kill(step: int, **ctx) -> None:
+    """kill hook (fit's step loop): exits the process with
+    KILL_EXIT_CODE when a rule fires — mid-step, like a real
+    preemption that didn't say goodbye."""
+    r = fault("kill", step=step, **ctx)
+    if r is not None:
+        _log.warning("chaos: killing this worker at step %d (exit %d)",
+                     step, KILL_EXIT_CODE)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(KILL_EXIT_CODE)
+
+
+def injected_total(kind: Optional[str] = None) -> int:
+    """Faults injected so far (per kind, or all kinds)."""
+    total = 0
+    for r in rules():
+        if kind is None or r.kind == kind:
+            total += r.fired
+    return total
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m mxnet_tpu.chaos --self-test
+# ---------------------------------------------------------------------------
+def _self_test() -> tuple:
+    checks: Dict[str, bool] = {}
+
+    # 1) grammar: kinds, int/float coercion, multi-rule specs
+    rs = parse_spec("drop_push:rank=1,nth=2,mode=response; "
+                    "delay_collective:op=push,ms=1.5 ;kill:rank=0,step=7;"
+                    "nan_grad:rank=1,step=3,count=2")
+    checks["parse_n_rules"] = len(rs) == 4
+    checks["parse_int"] = rs[0].params["rank"] == 1 and rs[0].nth == 2
+    checks["parse_float"] = rs[1].params["ms"] == 1.5
+    checks["parse_str"] = rs[0].params["mode"] == "response"
+
+    # 2) matching: rank + step must agree; absent ctx keys don't match
+    kill = rs[2]
+    checks["match_hit"] = kill.matches({"rank": 0, "step": 7})
+    checks["match_wrong_step"] = not kill.matches({"rank": 0, "step": 6})
+    checks["match_missing_key"] = not kill.matches({"rank": 0})
+
+    # 3) nth window + count limit: nth=2 skips the first candidate,
+    # count=1 stops after one injection
+    drop = rs[0]
+    fires = [drop.try_fire({"rank": 1}) for _ in range(4)]
+    checks["nth_skips_first"] = fires == [False, True, False, False]
+    nan = rs[3]
+    fires = [nan.try_fire({"rank": 1, "step": 3}) for _ in range(3)]
+    checks["count_twice"] = fires == [True, True, False]
+
+    # 4) the env-driven entry points + injection counter (the write is
+    # the test fixture, not a bypassed read)
+    os.environ["MXNET_CHAOS"] = "nan_grad:rank=0,step=5"  # mxlint: disable=MXL002
+    reset()
+    try:
+        checks["fault_wrong_step"] = fault("nan_grad", rank=0,
+                                           step=4) is None
+        hit = fault("nan_grad", rank=0, step=5)
+        checks["fault_hit"] = hit is not None
+        checks["fault_consumed"] = fault("nan_grad", rank=0,
+                                         step=5) is None
+        checks["injected_total"] = injected_total("nan_grad") == 1
+        from . import diagnostics as _diag
+
+        c = _diag.metrics.counter("mxnet_chaos_injected_total",
+                                  labels={"kind": "nan_grad"})
+        checks["metric_fed"] = c.value >= 1
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        reset()
+
+    # 5) disabled == inert (and never raises)
+    checks["disabled_inert"] = not enabled() and \
+        fault("kill", step=1) is None
+
+    return all(checks.values()), checks
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.chaos",
+        description="fault-injection harness self-test / spec check")
+    ap.add_argument("--self-test", action="store_true",
+                    help="exercise spec parsing, matching, nth/count "
+                         "windows, injection counters")
+    ap.add_argument("--explain", action="store_true",
+                    help="parse MXNET_CHAOS and print the active rules")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        ok, checks = _self_test()
+        print(json.dumps({"self_test_ok": ok, "checks": checks}))
+        return 0 if ok else 1
+    if args.explain:
+        for r in rules():
+            print(r.describe())
+        return 0
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

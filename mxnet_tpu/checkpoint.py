@@ -1,0 +1,330 @@
+"""mx.checkpoint — elastic, atomic, per-rank-sharded training snapshots.
+
+The reference's fault story was built on the ps-lite layer: kvstore
+``save_optimizer_states`` plus ``Module.save_checkpoint`` wrote params
+and momenta, and a preempted run was restarted by hand from the last
+epoch boundary (python/mxnet/model.py save_checkpoint + the
+``is_recovery`` rejoin in src/kvstore/kvstore_dist.h:54-58).  This
+module upgrades that to *step-granular elastic* checkpoints with an
+exact-resume contract:
+
+  * **Atomic**: every shard is written to ``<name>.tmp`` and
+    ``os.replace``d into place — a rank killed mid-write leaves either
+    the previous complete shard set or a prefix that
+    :func:`latest_step` ignores, never a torn file.
+  * **Versioned**: shards carry ``FORMAT_VERSION``; loading a newer
+    format raises instead of misreading it.
+  * **Per-rank sharded**: rank K writes ``step_{N}/rank{K}.ckpt``.  A
+    step is *complete* only when every expected rank's shard exists, so
+    a fleet that died unevenly resumes from the newest step ALL ranks
+    reached.
+  * **Full state**: params, aux (BN moments), optimizer/momenta state
+    (the local Updater's, or the gathered server shards on the dist
+    kvstore path), RNG key state, epoch/step, and the data-iterator
+    position — everything needed for a resumed run to bitwise-match an
+    uninterrupted control on the CPU mesh (the fp64/lr0 control
+    methodology from the scaling reports applies unchanged).
+  * **Asynchronous**: the device->host snapshot is synchronous (it must
+    be consistent), but pickling + writing + retention GC run on a
+    background thread (``MXNET_CKPT_ASYNC``) so the blocking host work
+    overlaps the compiled step.  :meth:`CheckpointManager.wait` joins
+    pending writes; the SIGTERM preemption path calls it before
+    exiting.
+
+``Module.fit(checkpoint_every_n=, checkpoint_dir=, resume_from=)``
+drives this (module/base_module.py); knobs: ``MXNET_CKPT_DIR``,
+``MXNET_CKPT_EVERY_N``, ``MXNET_CKPT_KEEP``, ``MXNET_CKPT_ASYNC``,
+``MXNET_CKPT_DRAIN_S`` (mxnet_tpu/env.py).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import queue
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "FORMAT_VERSION", "CheckpointManager", "save_checkpoint",
+    "load_checkpoint", "latest_step", "list_steps", "step_dir",
+    "shard_path",
+]
+
+_log = logging.getLogger(__name__)
+
+FORMAT_VERSION = 1
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _rank_info() -> Tuple[int, int]:
+    from . import profiler as _profiler
+
+    return _profiler._dist_info()
+
+
+def step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, "step_%08d" % int(step))
+
+
+def shard_path(directory: str, step: int, rank: int) -> str:
+    return os.path.join(step_dir(directory, step), "rank%d.ckpt" % rank)
+
+
+def list_steps(directory: str) -> List[int]:
+    """Step numbers with a directory present (complete or not)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    steps = []
+    for n in names:
+        m = _STEP_RE.match(n)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def _is_complete(directory: str, step: int, num_ranks: int) -> bool:
+    return all(os.path.exists(shard_path(directory, step, r))
+               for r in range(num_ranks))
+
+
+def latest_step(directory: str,
+                num_ranks: Optional[int] = None) -> Optional[int]:
+    """The newest step every expected rank finished writing (None when
+    the directory holds no complete checkpoint).  ``num_ranks`` defaults
+    to this process's fleet size — a single-rank reader of a 2-rank
+    directory must pass it explicitly."""
+    if num_ranks is None:
+        num_ranks = max(_rank_info()[1], 1)
+    for step in reversed(list_steps(directory)):
+        if _is_complete(directory, step, num_ranks):
+            return step
+    return None
+
+
+def _snapshot_params(params: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Device arrays -> host numpy, synchronously: the caller's training
+    loop may mutate the live buffers right after save() returns, so the
+    copy cannot ride the async writer."""
+    import numpy as np
+
+    out = {}
+    for k, v in (params or {}).items():
+        # the per-param transfer IS the checkpoint's job here
+        out[k] = np.asarray(  # mxlint: disable=MXL004
+            v.asnumpy() if hasattr(v, "asnumpy") else v)
+    return out
+
+
+def rng_state() -> dict:
+    """Snapshot of mxnet_tpu.random's global PRNG (root key + derive
+    counter + generation) — numpy-typed so it pickles without jax."""
+    import numpy as np
+
+    from . import random as _random
+
+    with _random._lock:
+        key = _random._root_key
+        return {
+            "root_key": None if key is None else np.asarray(key),
+            "counter": int(_random._counter),
+            "generation": int(_random._generation),
+        }
+
+
+def set_rng_state(state: Optional[dict]) -> None:
+    if not state:
+        return
+    import jax.numpy as jnp
+
+    from . import random as _random
+
+    with _random._lock:
+        if state.get("root_key") is not None:
+            _random._root_key = jnp.asarray(state["root_key"])
+        _random._counter = int(state.get("counter", 0))
+        # bump, don't restore: live compiled steps watching the
+        # generation must notice the key changed under them
+        _random._generation += 1
+
+
+class CheckpointManager:
+    """Writes (and garbage-collects) one rank's shard stream under a
+    shared checkpoint directory."""
+
+    def __init__(self, directory: str, keep: Optional[int] = None,
+                 async_write: Optional[bool] = None,
+                 rank: Optional[int] = None,
+                 num_ranks: Optional[int] = None):
+        from . import env as _env
+
+        self.directory = directory
+        r, n = _rank_info()
+        self.rank = r if rank is None else int(rank)
+        self.num_ranks = max(n if num_ranks is None else int(num_ranks), 1)
+        self.keep = _env.get_int("MXNET_CKPT_KEEP") if keep is None \
+            else int(keep)
+        self.async_write = _env.get_bool("MXNET_CKPT_ASYNC") \
+            if async_write is None else bool(async_write)
+        self._q: "queue.Queue" = queue.Queue()
+        self._writer: Optional[threading.Thread] = None
+        self._errors: List[BaseException] = []
+        self._lock = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+
+    # -- save ----------------------------------------------------------
+    def save(self, step: int, *, params=None, aux_params=None,
+             optimizer_states: Optional[bytes] = None,
+             epoch: int = 0, nbatch: int = 0,
+             iterator_state: Optional[dict] = None,
+             extra: Optional[dict] = None,
+             blocking: Optional[bool] = None) -> str:
+        """Snapshot now, write now (blocking) or on the writer thread.
+        Returns the shard path that will exist once the write lands."""
+        payload = {
+            "format_version": FORMAT_VERSION,
+            "step": int(step), "epoch": int(epoch), "nbatch": int(nbatch),
+            "rank": self.rank, "num_ranks": self.num_ranks,
+            "params": _snapshot_params(params),
+            "aux_params": _snapshot_params(aux_params),
+            "optimizer_states": optimizer_states,
+            "rng": rng_state(),
+            "iterator": dict(iterator_state) if iterator_state else None,
+            "extra": dict(extra) if extra else None,
+        }
+        path = shard_path(self.directory, step, self.rank)
+        sync = not self.async_write if blocking is None else blocking
+        if sync:
+            self._write(int(step), payload, path)
+        else:
+            self._ensure_writer()
+            self._q.put((int(step), payload, path))
+        return path
+
+    def _ensure_writer(self) -> None:
+        with self._lock:
+            if self._writer is None or not self._writer.is_alive():
+                self._writer = threading.Thread(
+                    target=self._writer_loop, name="mx-ckpt-writer",
+                    daemon=True)
+                self._writer.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            step, payload, path = self._q.get()
+            try:
+                self._write(step, payload, path)
+            except BaseException as e:  # surfaced by wait()/next save
+                with self._lock:
+                    self._errors.append(e)
+            finally:
+                self._q.task_done()
+
+    def _write(self, step: int, payload: dict, path: str) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # readers never see a torn shard
+        self._gc(keep_at_least=step)
+
+    def _gc(self, keep_at_least: int) -> None:
+        """Drop the oldest COMPLETE steps beyond the retention window.
+        Incomplete steps older than the newest complete one are stale
+        debris from a dead fleet and go too; rank 0 does the shared
+        cleanup (every rank deleting races harmlessly — ENOENT is
+        ignored — but one janitor is enough)."""
+        if self.keep <= 0 or self.rank != 0:
+            return
+        steps = list_steps(self.directory)
+        complete = [s for s in steps
+                    if _is_complete(self.directory, s, self.num_ranks)]
+        for s in complete[:-self.keep]:
+            if s >= keep_at_least:
+                continue
+            self._rm_step(s)
+        # stale incomplete steps: anything OLDER than the newest
+        # complete step can never become resumable (the fleet moved
+        # on) — without this, every uneven death leaves a permanent
+        # step_*/ debris directory.  Newer incomplete steps are left
+        # alone: a peer rank may be mid-write on them right now.
+        if complete:
+            newest = complete[-1]
+            for s in steps:
+                if s < newest and s not in complete:
+                    self._rm_step(s)
+
+    def _rm_step(self, step: int) -> None:
+        d = step_dir(self.directory, step)
+        try:
+            for name in os.listdir(d):
+                try:
+                    os.unlink(os.path.join(d, name))
+                except OSError:
+                    pass
+            os.rmdir(d)
+        except OSError:
+            pass
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until queued writes land (Queue.join has no timeout, so
+        poll unfinished_tasks).  Raises the first writer error, if any.
+        Returns False when the timeout expired with writes pending."""
+        import time as _time
+
+        t0 = _time.monotonic()
+        while self._writer is not None and self._writer.is_alive() \
+                and self._q.unfinished_tasks:
+            if timeout is not None and _time.monotonic() - t0 > timeout:
+                return False
+            _time.sleep(0.01)
+        with self._lock:
+            if self._errors:
+                raise self._errors.pop(0)
+        return True
+
+    # -- load ----------------------------------------------------------
+    def load(self, step: Optional[int] = None) -> dict:
+        return load_checkpoint(self.directory, step=step, rank=self.rank,
+                               num_ranks=self.num_ranks)
+
+    def latest_step(self) -> Optional[int]:
+        return latest_step(self.directory, num_ranks=self.num_ranks)
+
+
+def save_checkpoint(directory: str, step: int, **kw) -> str:
+    """One-shot blocking save of this rank's shard (see
+    :meth:`CheckpointManager.save` for the keyword surface)."""
+    kw.setdefault("blocking", True)
+    return CheckpointManager(directory).save(step, **kw)
+
+
+def load_checkpoint(directory: str, step: Optional[int] = None,
+                    rank: Optional[int] = None,
+                    num_ranks: Optional[int] = None) -> dict:
+    """Load one rank's shard of the given (default: newest complete)
+    step.  Raises FileNotFoundError when nothing is resumable and
+    ValueError on a format from the future."""
+    if rank is None:
+        rank = _rank_info()[0]
+    if step is None:
+        step = latest_step(directory, num_ranks=num_ranks)
+        if step is None:
+            raise FileNotFoundError(
+                "no complete checkpoint under %r (a step is complete "
+                "only when every rank's shard exists)" % directory)
+    path = shard_path(directory, step, rank)
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    version = payload.get("format_version")
+    if version is None or version > FORMAT_VERSION:
+        raise ValueError(
+            "checkpoint %s has format_version %r; this build reads <= %d"
+            % (path, version, FORMAT_VERSION))
+    return payload

@@ -65,12 +65,109 @@ __all__ = [
     "flight_enabled", "instrument_jit", "recompile_stats",
     "reset_recompile_stats", "recorded_steps", "Gauge", "Counter",
     "Histogram", "MetricsRegistry", "metrics", "record_step",
-    "validate_prom_text",
+    "validate_prom_text", "EXIT_PREEMPTED", "EXIT_WATCHDOG_ABORT",
+    "register_preemption_hook", "unregister_preemption_hook",
+    "run_preemption_hooks", "set_dead_peers", "dead_peers",
 ]
 
 _log = logging.getLogger(__name__)
 
 DEFAULT_RING_SIZE = 256
+
+#: SIGTERM landed, in-flight collectives drained, preemption hooks
+#: (checkpoint) ran — the run is resumable from its checkpoint dir.
+EXIT_PREEMPTED = 83
+#: the collective watchdog's second threshold (MXNET_COLLECTIVE_ABORT_S)
+#: fired: the fleet was permanently desynced, evidence dumped,
+#: checkpoint attempted, process aborted restartably instead of hanging.
+EXIT_WATCHDOG_ABORT = 85
+
+
+def _dump_dir_path(path: str) -> str:
+    """Relative artifact paths land under MXNET_DUMP_DIR (created on
+    demand) so test/bench runs stop littering the CWD; absolute paths —
+    and unset env — pass through untouched."""
+    if os.path.isabs(path):
+        return path
+    from . import env as _envmod
+
+    base = _envmod.get_str("MXNET_DUMP_DIR")
+    if not base:
+        return path
+    try:
+        os.makedirs(base, exist_ok=True)
+    except OSError:
+        return path
+    return os.path.join(base, path)
+
+
+# ---------------------------------------------------------------------------
+# preemption hooks: the bridge from "evidence dumped" to "run recovers".
+# Module.fit registers a checkpoint closure here; the SIGTERM handler and
+# the watchdog's abort threshold invoke them (dump -> drain -> hooks ->
+# exit) so a preempted or permanently-desynced fleet terminates
+# RESTARTABLY instead of dying stateless or hanging forever.
+# ---------------------------------------------------------------------------
+# reentrant: SIGTERM may land on the main thread WHILE it is inside
+# register/unregister holding this lock — run_preemption_hooks must
+# still be able to take it (the same self-deadlock class the flight
+# recorder's ring lock was converted to RLock for)
+_preempt_lock = threading.RLock()
+_preempt_hooks: "Dict[Any, Any]" = {}
+_dead_peers_lock = threading.Lock()
+_dead_peers: List[str] = []
+
+
+def register_preemption_hook(fn, key: Any = None) -> Any:
+    """Register ``fn()`` to run when this process is preempted (SIGTERM)
+    or watchdog-aborted.  Hooks must be best-effort-safe: they run in a
+    signal handler / watchdog thread.  Returns the key for
+    :func:`unregister_preemption_hook`.
+
+    Also arms the SIGTERM handler immediately: normally it installs on
+    the first recorded collective, but a preemption landing during the
+    long FIRST compile (no collective yet) must still checkpoint-and-
+    exit-83 rather than die bare."""
+    key = key if key is not None else id(fn)
+    with _preempt_lock:
+        _preempt_hooks[key] = fn
+    if not recorder._signals_installed:
+        recorder.install_signal_handlers()
+    return key
+
+
+def unregister_preemption_hook(key: Any) -> None:
+    with _preempt_lock:
+        _preempt_hooks.pop(key, None)
+
+
+def run_preemption_hooks(reason: str) -> int:
+    """Run every registered hook (newest first); returns how many ran
+    without raising.  Never raises — this is the last thing a dying
+    process does."""
+    with _preempt_lock:
+        hooks = list(_preempt_hooks.items())
+    ran = 0
+    for key, fn in reversed(hooks):
+        try:
+            fn()
+            ran += 1
+        except Exception:
+            _log.exception("preemption hook %r failed (%s)", key, reason)
+    return ran
+
+
+def set_dead_peers(peers) -> None:
+    """Record heartbeat-declared dead peers (_ps.Heartbeat feeds this
+    from the scheduler's dead_nodes query) — stamped into every flight
+    dump header so ``merge_traces.py --health`` can name them."""
+    with _dead_peers_lock:
+        _dead_peers[:] = [str(p) for p in (peers or [])]
+
+
+def dead_peers() -> List[str]:
+    with _dead_peers_lock:
+        return list(_dead_peers)
 
 
 def _dump_env() -> Tuple[bool, Optional[str]]:
@@ -144,6 +241,13 @@ class FlightRecorder:
         if not self.enabled:
             return None
         try:
+            from . import chaos as _chaos
+
+            if _chaos.enabled():
+                # chaos 'delay_collective': a seeded straggler — the
+                # sleep happens where the collective is issued, so the
+                # watchdog/straggler analyses see a real stall
+                _chaos.maybe_delay(str(op))
             entry = {
                 "seq": -1, "op": str(op),
                 "keys": self._norm_keys(keys),
@@ -238,6 +342,7 @@ class FlightRecorder:
                 "dropped": self._dropped,
                 "bucket_plan": dict(self._bucket_plan)
                 if self._bucket_plan else None,
+                "dead_peers": dead_peers(),
                 "pid": os.getpid(), "dump_ts": time.time(),
             }
             entries = [dict(e) for e in self._entries]
@@ -265,7 +370,7 @@ class FlightRecorder:
                 base = path_override  # the dump flag may carry the path
         rank, _ = _rank_info()
         root, ext = os.path.splitext(base)
-        return "%s_rank%d%s" % (root, rank, ext or ".json")
+        return _dump_dir_path("%s_rank%d%s" % (root, rank, ext or ".json"))
 
     def dump(self, path: Optional[str] = None, reason: str = "on_demand"
              ) -> Optional[str]:
@@ -286,21 +391,52 @@ class FlightRecorder:
     # -- signal handlers + watchdog -------------------------------------
     def _arm(self) -> None:
         """First-record arming: signal handlers (main thread only) and
-        the collective watchdog (when the timeout env is set)."""
+        the collective watchdog (when the suspect-timeout or the abort
+        escalation env is set)."""
         if not self._signals_installed:
             self.install_signal_handlers()
         from . import env as _envmod
 
         timeout = _envmod.get_float("MXNET_COLLECTIVE_TIMEOUT_S", None)
-        if timeout and self._watchdog is None:
-            self._start_watchdog(timeout)
+        abort = _envmod.get_float("MXNET_COLLECTIVE_ABORT_S", None)
+        if (timeout or abort) and self._watchdog is None:
+            self._start_watchdog(timeout, abort)
+
+    def drain(self, timeout_s: float) -> bool:
+        """Wait for in-flight collectives to complete (suspects never
+        will — they don't block the drain past the timeout).  The
+        SIGTERM/abort path calls this BEFORE checkpointing so the
+        snapshot isn't taken mid-collective.  Returns True when nothing
+        is left in flight."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while time.monotonic() < deadline:
+            pending = [e for e in self.in_flight()
+                       if e["state"] == "in_flight"]
+            if not pending:
+                break
+            time.sleep(0.01)
+        return not self.in_flight()
 
     def install_signal_handlers(self) -> bool:
         """SIGUSR1 dumps without disturbing the run, then chains to any
         handler the app installed (the default action — terminate — is
-        NOT chained); SIGTERM dumps then chains to the previous handler
-        (default: die) so external timeouts still kill the process AND
-        leave the artifact behind."""
+        NOT chained).
+
+        SIGTERM is the preemption path, with an EXPLICIT ordering
+        contract (covered by a subprocess test so it can't silently
+        regress):
+
+          1. **dump** the flight ring (reason=SIGTERM) — evidence
+             first: a hook that hangs must not cost the post-mortem;
+          2. **drain** in-flight collectives (MXNET_CKPT_DRAIN_S) so
+             the checkpoint isn't taken mid-collective;
+          3. **checkpoint** via the registered preemption hooks
+             (Module.fit registers one while fitting);
+          4. **exit(EXIT_PREEMPTED=83)** when a hook ran — the run is
+             resumable, and the launcher can tell a clean preemption
+             from a crash; otherwise **chain** to the previous handler
+             (default: die) so external timeouts still kill the
+             process AND leave the artifact behind."""
         if threading.current_thread() is not threading.main_thread():
             # don't burn the one-shot flag: a later main-thread
             # collective must still get to install the handlers
@@ -319,10 +455,24 @@ class FlightRecorder:
             prev_term = signal.getsignal(signal.SIGTERM)
 
             def _term(signum, frame):
-                self.dump(reason="SIGTERM")
+                self.dump(reason="SIGTERM")                    # 1. dump
+                from . import env as _envmod
+
+                try:
+                    drain_s = _envmod.get_float("MXNET_CKPT_DRAIN_S")
+                except Exception:
+                    drain_s = 5.0
+                self.drain(drain_s)                            # 2. drain
+                ran = run_preemption_hooks("SIGTERM")     # 3. checkpoint
+                if ran:
+                    _log.warning(
+                        "SIGTERM: flight ring dumped, collectives "
+                        "drained, %d preemption hook(s) checkpointed — "
+                        "exiting %d (resumable)", ran, EXIT_PREEMPTED)
+                    os._exit(EXIT_PREEMPTED)              # 4. exit 83
                 if prev_term is signal.SIG_IGN:
                     return  # the app deliberately ignores SIGTERM
-                if callable(prev_term):
+                if callable(prev_term):                   # 4'. chain
                     prev_term(signum, frame)
                 else:
                     signal.signal(signal.SIGTERM, signal.SIG_DFL)
@@ -336,13 +486,15 @@ class FlightRecorder:
             # signals: recording still works, on-signal dumps don't
             return False
 
-    def _start_watchdog(self, timeout_s: float) -> None:
+    def _start_watchdog(self, timeout_s: Optional[float],
+                        abort_s: Optional[float] = None) -> None:
         def loop():
-            period = max(min(timeout_s / 4.0, 5.0), 0.05)
+            base = min(t for t in (timeout_s, abort_s) if t)
+            period = max(min(base / 4.0, 5.0), 0.05)
             while True:
                 time.sleep(period)
                 try:
-                    self.check_timeouts(timeout_s)
+                    self.check_timeouts(timeout_s, abort_s=abort_s)
                 except Exception:
                     pass
 
@@ -351,19 +503,30 @@ class FlightRecorder:
         self._watchdog = t
         t.start()
 
-    def check_timeouts(self, timeout_s: float) -> int:
-        """Mark in-flight entries older than ``timeout_s`` as suspect;
-        dump when NEW suspects appeared.  Returns the suspect count.
-        (The watchdog calls this on its period; tests call it
-        directly.)"""
+    def check_timeouts(self, timeout_s: Optional[float],
+                       abort_s: Optional[float] = None) -> int:
+        """Two-threshold watchdog (the watchdog thread calls this on its
+        period; tests call it directly).  Returns the suspect count.
+
+        * past ``timeout_s``: mark in-flight entries suspect + dump when
+          NEW suspects appeared — diagnosis, the run keeps going;
+        * past ``abort_s`` (MXNET_COLLECTIVE_ABORT_S): escalate — the
+          collective is never completing (permanent desync / dead
+          peer), so dump, checkpoint via the preemption hooks, and
+          abort with EXIT_WATCHDOG_ABORT so the fleet terminates
+          RESTARTABLY instead of hanging forever."""
         now = time.time()
         n_suspect = 0
+        oldest_age = 0.0
         with self._lock:
             suspects = set()
             for e in self._entries:
+                age = now - e["enqueue_ts"]
                 if e["state"] == "in_flight" and \
-                        now - e["enqueue_ts"] > timeout_s:
+                        timeout_s is not None and age > timeout_s:
                     e["state"] = "suspect"
+                if e["state"] in ("in_flight", "suspect"):
+                    oldest_age = max(oldest_age, age)
                 if e["state"] == "suspect":
                     n_suspect += 1
                     suspects.add(e["seq"])
@@ -372,6 +535,8 @@ class FlightRecorder:
             # recovered incident must still dump
             newly = bool(suspects - self._suspect_dumped)
             self._suspect_dumped |= suspects
+        if abort_s is not None and oldest_age > abort_s:
+            self._escalate_abort(oldest_age, abort_s)
         if newly:
             _log.warning(
                 "collective watchdog: %d collective(s) in flight longer "
@@ -379,6 +544,26 @@ class FlightRecorder:
                 "NOT killed)", n_suspect, timeout_s, self.dump_path())
             self.dump(reason="watchdog_timeout")
         return n_suspect
+
+    def _escalate_abort(self, age_s: float, abort_s: float) -> None:
+        """The escalation leg: same explicit ordering as SIGTERM (dump
+        -> drain is pointless here, the collective IS the hang ->
+        checkpoint hooks -> abort with the documented exit code)."""
+        _log.error(
+            "collective watchdog ESCALATION: a collective has been in "
+            "flight %.1fs (> MXNET_COLLECTIVE_ABORT_S=%.1fs) — the "
+            "fleet is permanently desynced.  Dumping evidence, "
+            "checkpointing if possible, aborting with exit code %d so "
+            "the run can be restarted from its last checkpoint.",
+            age_s, abort_s, EXIT_WATCHDOG_ABORT)
+        self.dump(reason="watchdog_abort")
+        ran = run_preemption_hooks("watchdog_abort")
+        if ran:
+            _log.error("watchdog abort: %d preemption hook(s) "
+                       "checkpointed before exit", ran)
+        # os._exit, not sys.exit: this may run on the watchdog thread,
+        # and the main thread is wedged inside the hung collective
+        os._exit(EXIT_WATCHDOG_ABORT)
 
 
 #: process-wide recorder (capacity from MXNET_FLIGHT_RECORDER_SIZE)
@@ -950,6 +1135,7 @@ class MetricsRegistry:
         if num_workers > 1:
             root, ext = os.path.splitext(path)
             path = "%s_rank%d%s" % (root, rank, ext or ".prom")
+        path = _dump_dir_path(path)
         tmp = path + ".tmp"
         try:
             with open(tmp, "w") as f:

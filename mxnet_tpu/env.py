@@ -199,9 +199,55 @@ register("MXNET_PS_REQUEST_TIMEOUT", "float", 900.0,
          "Client-side PS request timeout (s); exceeds the server sync "
          "window so tolerated stragglers are not aborted client-side.")
 register("MXNET_PS_HEARTBEAT_INTERVAL", "float", 5.0,
-         "Worker->scheduler heartbeat period (s).")
+         "Worker->scheduler heartbeat period (s).  The heartbeat "
+         "thread also queries dead peers each beat and feeds them to "
+         "the flight-recorder header for merge_traces --health.")
+register("MXNET_PS_RETRY_MAX", "int", 3,
+         "Transport retries per PS request after a timeout/connection "
+         "failure (reconnect + resend with exponential backoff); 0 "
+         "fails fast like the pre-retry behavior.")
+register("MXNET_PS_RETRY_BACKOFF_S", "float", 0.1,
+         "Initial retry backoff (s); doubles per attempt with +-50% "
+         "jitter so a rebooted server is not thundering-herded.")
+
+# chaos.py — fault injection for the chaos harness
+register("MXNET_CHAOS", "str", None,
+         "Fault-injection spec: semicolon-separated rules "
+         "'kind:k=v,k=v' with kinds drop_push / delay_collective / "
+         "kill / nan_grad (see mxnet_tpu/chaos.py).  Unset disables "
+         "all injection.")
+
+# module — non-finite gradient guard
+register("MXNET_SKIP_NONFINITE_GRADS", "bool", False,
+         "Check gradients for NaN/Inf before the kvstore push/update "
+         "and skip the step (counting "
+         "mxnet_training_skipped_steps_total) instead of poisoning "
+         "the fleet.  Costs one host sync per step; off by default.")
+
+# checkpoint.py — elastic checkpoint/resume (fault tolerance)
+register("MXNET_CKPT_DIR", "str", None,
+         "Default checkpoint directory for Module.fit when "
+         "checkpoint_every_n is set without an explicit dir.")
+register("MXNET_CKPT_EVERY_N", "int", 0,
+         "Checkpoint every N optimizer steps in Module.fit; 0 disables "
+         "(the checkpoint_every_n fit argument overrides).")
+register("MXNET_CKPT_KEEP", "int", 3,
+         "Completed checkpoint steps retained per directory; older "
+         "steps are garbage-collected after each save. 0 keeps all.")
+register("MXNET_CKPT_ASYNC", "bool", True,
+         "Write checkpoint shards on a background thread so the host "
+         "serialization overlaps the compiled step (the device->host "
+         "snapshot itself is always synchronous).")
+register("MXNET_CKPT_DRAIN_S", "float", 5.0,
+         "How long the SIGTERM/watchdog preemption path waits for "
+         "in-flight collectives to drain before checkpointing.")
 
 # diagnostics.py — flight recorder / recompile tracking / metrics
+register("MXNET_DUMP_DIR", "str", None,
+         "Directory for relative-path telemetry artifacts "
+         "(flightrecorder_rank*.json, profile_rank*.json, metrics "
+         "expositions); unset writes to the CWD.  Explicit absolute "
+         "paths always win.")
 register("MXNET_FLIGHT_RECORDER_SIZE", "int", 256,
          "Collective flight-recorder ring capacity; 0 disables.")
 register("MXNET_FLIGHT_RECORDER_FILE", "str", "flightrecorder.json",
@@ -212,6 +258,11 @@ register("MXNET_FLIGHT_RECORDER_DUMP", "str", None,
 register("MXNET_COLLECTIVE_TIMEOUT_S", "float", None,
          "Watchdog: collectives in flight longer than this are marked "
          "suspect and the ring dumps (run keeps going).")
+register("MXNET_COLLECTIVE_ABORT_S", "float", None,
+         "Watchdog escalation: a collective in flight longer than this "
+         "checkpoints via the registered preemption hooks and aborts "
+         "the process with exit code 85 (EXIT_WATCHDOG_ABORT) so a "
+         "desynced fleet terminates restartably instead of hanging.")
 register("MXNET_RECOMPILE_WARN_N", "int", 1,
          "Warn RECOMPILATION STORM when one step function compiles "
          "more than N times.")

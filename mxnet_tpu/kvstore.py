@@ -335,17 +335,27 @@ class KVStore:
     def send_command_to_servers(self, head: int, body: str) -> None:
         pass
 
-    def save_optimizer_states(self, fname: str, dump_optimizer: bool = False) -> None:
+    def get_optimizer_states_bytes(self, dump_optimizer: bool = False
+                                   ) -> bytes:
+        """Optimizer/momenta state as ONE opaque blob — what the
+        checkpoint layer (mxnet_tpu/checkpoint.py) shards per rank.
+        The dist store overrides this to gather every server shard."""
         if self._opt_updater is None:
             raise MXNetError("no optimizer state to save")
-        with open(fname, "wb") as f:
-            f.write(self._opt_updater.get_states(dump_optimizer))
+        return self._opt_updater.get_states(dump_optimizer)
 
-    def load_optimizer_states(self, fname: str) -> None:
+    def set_optimizer_states_bytes(self, states: bytes) -> None:
         if self._opt_updater is None:
             raise MXNetError("set_optimizer before loading states")
+        self._opt_updater.set_states(states)
+
+    def save_optimizer_states(self, fname: str, dump_optimizer: bool = False) -> None:
+        with open(fname, "wb") as f:
+            f.write(self.get_optimizer_states_bytes(dump_optimizer))
+
+    def load_optimizer_states(self, fname: str) -> None:
         with open(fname, "rb") as f:
-            self._opt_updater.set_states(f.read())
+            self.set_optimizer_states_bytes(f.read())
 
 
 class KVStoreTPU(KVStore):
@@ -457,6 +467,12 @@ def _key_value(key, value):
     return [key], [value]
 
 
+class PSConnectionLost(MXNetError, ConnectionError):
+    """A PS peer vanished mid-exchange.  Subclasses both MXNetError
+    (the API's error surface, existing handlers keep working) and
+    ConnectionError (the retry layer's transport-failure signal)."""
+
+
 class KVStoreDist(KVStore):
     """Multi-process parameter-server worker
     (ref: src/kvstore/kvstore_dist.h:49 KVStoreDist).
@@ -471,6 +487,7 @@ class KVStoreDist(KVStore):
     def __init__(self, kind: str):
         super().__init__(kind)
         import os
+        import threading as _threading
 
         from . import _ps
 
@@ -497,12 +514,31 @@ class KVStoreDist(KVStore):
         # barrier; ref: is_recovery skips only the *startup* barrier)
         self._barrier_skip = resp.get("barrier_gen", 0) \
             if self._recovery else 0
-        self._server_clients = [_ps.Client(a) for a in resp["servers"]]
+        self._server_addrs = [tuple(a) for a in resp["servers"]]
+        self._server_clients = [_ps.Client(a) for a in self._server_addrs]
+        self._reconnect_lock = _threading.Lock()
+        # per-key monotonic push sequence: rides every push frame so a
+        # retried (resent) push is deduped server-side instead of
+        # double-counted into the sync aggregation round
+        self._pseq: Dict[Any, int] = {}
+        self._pseq_lock = _threading.Lock()
         self._sched = sched
         _, _, _, nw = _ps.env_cluster()
         self._nw = nw
         self._gc = None
         self._closed = False
+        if self._recovery:
+            # re-seed the per-key push counters from every server's
+            # pushed_by high water: a rejoined worker restarting at
+            # pseq=1 would otherwise have its every push deduped as a
+            # stale resend (and the fleet's sync rounds would starve)
+            for c in self._server_clients:
+                resp = self._req(c, {"op": "worker_hello",
+                                     "worker": self._rank,
+                                     "recovery": True})
+                for key, count in (resp.get("pseq") or {}).items():
+                    self._pseq[key] = max(self._pseq.get(key, 0),
+                                          int(count))
         self._heartbeat = _ps.Heartbeat("worker", self._rank)
         from concurrent.futures import ThreadPoolExecutor
 
@@ -528,10 +564,12 @@ class KVStoreDist(KVStore):
         return self._nw
 
     def _server_for(self, key):
+        return self._server_clients[self._server_idx(key)]
+
+    def _server_idx(self, key) -> int:
         import zlib
 
-        return self._server_clients[
-            zlib.crc32(str(key).encode()) % len(self._server_clients)]
+        return zlib.crc32(str(key).encode()) % len(self._server_clients)
 
     @staticmethod
     def _req(client, msg):
@@ -539,13 +577,96 @@ class KVStoreDist(KVStore):
         silently swallowed)."""
         resp = client.request(msg)
         if resp is None:
-            raise MXNetError("server connection lost during %r"
-                             % msg.get("op"))
+            # EOF mid-exchange: the peer died.  Poison the connection
+            # (nothing can be paired on this stream anymore) and raise
+            # the dual-typed error — MXNetError for API compat,
+            # ConnectionError so _req_server's retry treats it as the
+            # transport failure it is.
+            client.broken = True
+            try:
+                client.sock.close()
+            except OSError:
+                pass
+            raise PSConnectionLost("server connection lost during %r"
+                                   % msg.get("op"))
         if resp.get("error") or resp.get("ok") is False:
             raise MXNetError("server rejected %r: %s"
                              % (msg.get("op"),
                                 resp.get("error", "unknown error")))
         return resp
+
+    # ops safe to resend on a transport failure: init is idempotent
+    # (set-if-absent), pulls are reads, pushes dedupe server-side via
+    # pseq.  Control ops (set_optimizer, stop, ...) keep fail-fast
+    # semantics — a lost 'stop' ack retried could double-count a
+    # worker's shutdown and end the server under its peers.
+    _RETRY_OPS = frozenset(("init", "push", "pull", "pull_rows"))
+
+    def _req_server(self, idx: int, msg):
+        """Server request with bounded retry: on a transport failure
+        (timeout / dead connection / dropped response) back off with
+        jitter (MXNET_PS_RETRY_BACKOFF_S), reconnect, and resend up to
+        MXNET_PS_RETRY_MAX times — the failure-absorption ps-lite gives
+        the reference through its resend timers.  Server-side errors
+        (error frames) are NOT retried: the server is alive and said
+        no."""
+        import time as _time
+
+        op = msg.get("op")
+        retries = self._ps.retry_max() if op in self._RETRY_OPS else 0
+        delays = [0.0] + self._ps.backoff_delays(retries)
+        last_exc = None
+        for attempt, delay in enumerate(delays):
+            if delay:
+                _time.sleep(delay)
+            try:
+                client = self._server_clients[idx]
+                if client.broken:
+                    client = self._reconnect(idx)
+                return self._req(client, msg)
+            except (ConnectionError, OSError) as e:
+                last_exc = e
+                if attempt >= len(delays) - 1:
+                    break
+                try:
+                    from . import diagnostics as _diag
+
+                    _diag.metrics.counter(
+                        "mxnet_ps_retries_total",
+                        help="PS requests resent after transport "
+                             "failures", labels={"op": str(op)}).inc()
+                except Exception:
+                    pass
+                import logging as _logging
+
+                _logging.getLogger(__name__).warning(
+                    "PS %r to server %d failed (%s) — retry %d/%d after "
+                    "%.2fs backoff", op, idx, e, attempt + 1, retries,
+                    delays[attempt + 1])
+        raise MXNetError(
+            "PS %r to server %d failed after %d attempt(s): %s"
+            % (op, idx, len(delays), last_exc)) from last_exc
+
+    def _reconnect(self, idx: int):
+        """Replace a broken server connection (thread-safe: concurrent
+        fanout threads that both saw the break reconnect once)."""
+        with self._reconnect_lock:
+            client = self._server_clients[idx]
+            if not client.broken:
+                return client  # another thread already reconnected
+            try:
+                client.close()
+            except OSError:
+                pass
+            fresh = self._ps.Client(self._server_addrs[idx])
+            self._server_clients[idx] = fresh
+            return fresh
+
+    def _next_pseq(self, key) -> int:
+        with self._pseq_lock:
+            n = self._pseq.get(key, 0) + 1
+            self._pseq[key] = n
+            return n
 
     def _fanout(self, work):
         """Run per-key request thunks concurrently on the persistent
@@ -560,8 +681,8 @@ class KVStoreDist(KVStore):
     def init(self, key, value) -> None:
         keys, values = _key_value(key, value)
         self._fanout([
-            (lambda k=k, v=v: self._req(
-                self._server_for(k),
+            (lambda k=k, v=v: self._req_server(
+                self._server_idx(k),
                 {"op": "init", "key": k, "data": _as_list(v)[0].asnumpy()}))
             for k, v in zip(keys, values)])
         self.barrier()
@@ -590,7 +711,10 @@ class KVStoreDist(KVStore):
 
         def one(k, vlist):
             merged = self._merge(vlist)
-            msg = {"op": "push", "key": k, "worker": self._rank}
+            # pseq makes the push exactly-once under retry: the server
+            # acks-without-applying any pseq it already counted
+            msg = {"op": "push", "key": k, "worker": self._rank,
+                   "pseq": self._next_pseq(k)}
             if isinstance(merged, _sp.RowSparseNDArray):
                 # only touched rows travel (ref: kvstore_dist.h:444
                 # EncodeRowSparseKey push)
@@ -604,7 +728,7 @@ class KVStoreDist(KVStore):
                 msg.update(compressed=True, data=codes, shape=shape)
             else:
                 msg["data"] = merged.asnumpy()
-            self._req(self._server_for(k), msg)
+            self._req_server(self._server_idx(k), msg)
 
         self._fanout([
             (lambda k=k, v=v: one(k, v)) for k, v in zip(keys, values)])
@@ -614,9 +738,9 @@ class KVStoreDist(KVStore):
         keys, outs = _key_value(key, out)
 
         def one(k, olist):
-            resp = self._req(self._server_for(k),
-                             {"op": "pull", "key": k,
-                              "worker": self._rank})
+            resp = self._req_server(self._server_idx(k),
+                                    {"op": "pull", "key": k,
+                                     "worker": self._rank})
             src = _np.asarray(resp["data"])
             for o in _as_list(olist):
                 o[:] = src.astype(o.dtype, copy=False)
@@ -638,9 +762,9 @@ class KVStoreDist(KVStore):
             rows = _np.unique(
                 (rid.asnumpy() if isinstance(rid, NDArray)
                  else _np.asarray(rid)).astype(_np.int64).ravel())
-            resp = self._req(self._server_for(k),
-                             {"op": "pull_rows", "key": k, "rows": rows,
-                              "worker": self._rank})
+            resp = self._req_server(self._server_idx(k),
+                                    {"op": "pull_rows", "key": k,
+                                     "rows": rows, "worker": self._rank})
             import jax.numpy as jnp
 
             for o in _as_list(olist):
@@ -690,23 +814,35 @@ class KVStoreDist(KVStore):
                               "threshold": self._gc.threshold})
         self.barrier()
 
-    def save_optimizer_states(self, fname: str,
-                              dump_optimizer: bool = False) -> None:
+    def get_optimizer_states_bytes(self, dump_optimizer: bool = False,
+                                   timeout: Optional[float] = None
+                                   ) -> bytes:
         """Gather every server shard's optimizer state — keys shard by
         crc32, so each server holds state only for its own keys
-        (ref: Trainer.save_states round-tripping the server updater)."""
-        blobs = {}
-        for i, c in enumerate(self._server_clients):
-            resp = self._req(c, {"op": "save_optimizer_states",
-                                 "dump_optimizer": dump_optimizer})
-            blobs[i] = resp["data"]
-        with open(fname, "wb") as f:
-            f.write(pickle.dumps({"num_servers": len(blobs),
-                                  "shards": blobs}))
+        (ref: Trainer.save_states round-tripping the server updater).
+        This is the blob the checkpoint layer stores (rank 0 gathers;
+        on resume rank 0 restores it into the fresh servers).
 
-    def load_optimizer_states(self, fname: str) -> None:
-        with open(fname, "rb") as f:
-            payload = pickle.loads(f.read())
+        The gather rides FRESH short-lived connections, never the
+        shared fanout clients: the watchdog-abort/SIGTERM checkpoint
+        hook must not block on a client whose lock is held by the very
+        request that is hung (that wait would be the full
+        MXNET_PS_REQUEST_TIMEOUT — minutes — against the documented
+        exit-within-seconds contract).  ``timeout`` bounds each server
+        exchange; the preemption path passes a small one."""
+        blobs = {}
+        for i, addr in enumerate(self._server_addrs):
+            c = self._ps.Client(addr, timeout=timeout)
+            try:
+                resp = self._req(c, {"op": "save_optimizer_states",
+                                     "dump_optimizer": dump_optimizer})
+                blobs[i] = resp["data"]
+            finally:
+                c.close()
+        return pickle.dumps({"num_servers": len(blobs), "shards": blobs})
+
+    def set_optimizer_states_bytes(self, states: bytes) -> None:
+        payload = pickle.loads(states)
         if payload["num_servers"] != len(self._server_clients):
             raise MXNetError(
                 "optimizer states saved with %d servers, cluster has %d"
@@ -714,6 +850,15 @@ class KVStoreDist(KVStore):
         for i, c in enumerate(self._server_clients):
             self._req(c, {"op": "load_optimizer_states",
                           "data": payload["shards"][i]})
+
+    def save_optimizer_states(self, fname: str,
+                              dump_optimizer: bool = False) -> None:
+        with open(fname, "wb") as f:
+            f.write(self.get_optimizer_states_bytes(dump_optimizer))
+
+    def load_optimizer_states(self, fname: str) -> None:
+        with open(fname, "rb") as f:
+            self.set_optimizer_states_bytes(f.read())
 
     # -- cluster control -----------------------------------------------
     def barrier(self) -> None:

@@ -126,8 +126,19 @@ class KVStoreServer:
                     self.state.setdefault(msg["key"], _KeyState())
             _ps.send_msg(conn, {"ok": True})
         elif op == "push":
-            self._handle_push(msg)
-            _ps.send_msg(conn, {"ok": True})
+            applied = self._handle_push(msg)
+            _ps.send_msg(conn, {"ok": True, "dup": not applied})
+        elif op == "worker_hello":
+            # is_recovery rejoin: the worker's client-side pseq counters
+            # died with it, but pushed_by here did not — hand back the
+            # high-water counts so its fresh pushes are not deduped
+            # into oblivion (exactly-once survives the restart)
+            w = int(msg["worker"])
+            with self.lock:
+                counts = {key: st.pushed_by[w]
+                          for key, st in self.state.items()
+                          if w in st.pushed_by}
+            _ps.send_msg(conn, {"ok": True, "pseq": counts})
         elif op == "pull":
             _ps.send_msg(conn, {"data": self._handle_pull(msg)})
         elif op == "pull_rows":
@@ -188,7 +199,9 @@ class KVStoreServer:
             _ps.send_msg(conn, {"error": "bad op %r" % op})
         return False
 
-    def _handle_push(self, msg):
+    def _handle_push(self, msg) -> bool:
+        """Fold one push into the aggregation round; returns False for
+        a deduplicated resend (nothing applied)."""
         key = msg["key"]
         if msg.get("compressed"):
             grad = self.gc.decompress(msg["data"], msg["shape"]) \
@@ -206,6 +219,14 @@ class KVStoreServer:
         with self.lock:
             st = self.state.setdefault(key, _KeyState())
             w = int(msg["worker"])
+            # exactly-once under worker retry: a push whose RESPONSE was
+            # lost gets resent with the same per-(worker,key) pseq; any
+            # pseq already counted is acked without re-applying (the
+            # worker-side counter and pushed_by advance in lockstep, so
+            # pushed_by IS the highest pseq applied for this worker)
+            pseq = msg.get("pseq")
+            if pseq is not None and int(pseq) <= st.pushed_by.get(w, 0):
+                return False
             st.pushed_by[w] = st.pushed_by.get(w, 0) + 1
             if not self.sync_mode:
                 # ref: dist_async — apply immediately, no barrier
@@ -213,7 +234,7 @@ class KVStoreServer:
                 self._apply(key, grad)
                 st.applied += 1
                 self.lock.notify_all()
-                return
+                return True
             if st.agg is None:
                 st.agg = grad.astype(np.float32).copy()
             else:
@@ -229,6 +250,7 @@ class KVStoreServer:
                 st.parts -= self.num_workers
                 st.applied += 1
                 self.lock.notify_all()
+        return True
 
     def _apply(self, key, merged):
         if self.updater is not None:

@@ -162,9 +162,65 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """The training loop (ref: base_module.py:376 fit)."""
+            monitor=None, sparse_row_id_fn=None,
+            checkpoint_every_n=None, checkpoint_dir=None,
+            resume_from=None):
+        """The training loop (ref: base_module.py:376 fit).
+
+        Fault tolerance (mxnet_tpu/checkpoint.py):
+
+        * ``checkpoint_every_n`` / ``checkpoint_dir`` — save an atomic
+          per-rank checkpoint shard (params, optimizer/momenta, RNG,
+          epoch/step, iterator position) every N optimizer steps
+          (defaults: ``MXNET_CKPT_EVERY_N`` / ``MXNET_CKPT_DIR``);
+          writes are asynchronous (``MXNET_CKPT_ASYNC``) so the host
+          serialization overlaps the compiled step.
+        * ``resume_from`` — a checkpoint directory (or True, meaning
+          ``checkpoint_dir``): loads the newest COMPLETE step and
+          resumes exactly: params + momenta + RNG restored, the data
+          iterator fast-forwarded, step counting continued — a resumed
+          run bitwise-matches an uninterrupted control on the CPU mesh
+          for deterministic iterators.  Multi-worker resume: create the
+          dist kvstore FIRST and pass the instance, so the rank/fleet
+          size are known when the shard is selected.
+        * while fitting, a preemption hook is registered
+          (diagnostics.register_preemption_hook): SIGTERM — and the
+          watchdog's MXNET_COLLECTIVE_ABORT_S escalation — dump the
+          flight ring, drain collectives, checkpoint the last completed
+          step best-effort, and exit with the documented code
+          (83 / 85) so the run restarts from where it died.
+        """
         assert num_epoch is not None, "please specify number of epochs"
+
+        from .. import chaos as _chaos
+        from .. import checkpoint as _ckpt
+        from .. import env as _env
+        from ..ndarray import array as _nd_array
+
+        every_n = checkpoint_every_n if checkpoint_every_n is not None \
+            else _env.get_int("MXNET_CKPT_EVERY_N")
+        ckpt_dir = checkpoint_dir or _env.get_str("MXNET_CKPT_DIR")
+        if resume_from is True:
+            resume_from = ckpt_dir
+        if resume_from and not ckpt_dir:
+            ckpt_dir = resume_from
+        resume_payload = None
+        resume_skip = 0
+        global_step = 0
+        if resume_from:
+            resume_payload = _ckpt.load_checkpoint(resume_from)
+            arg_params = {k: _nd_array(v) for k, v in
+                          resume_payload["params"].items()}
+            aux_params = {k: _nd_array(v) for k, v in
+                          resume_payload["aux_params"].items()}
+            force_init = True
+            begin_epoch = int(resume_payload["epoch"])
+            resume_skip = int(resume_payload["nbatch"])
+            global_step = int(resume_payload["step"])
+            self.logger.info(
+                "resuming from checkpoint step %d (%s): epoch %d, "
+                "batch %d", global_step, resume_from, begin_epoch,
+                resume_skip)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -176,6 +232,29 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if resume_payload is not None:
+            # optimizer/momenta AFTER init_optimizer installed the fresh
+            # updater (dist: rank 0 restores the gathered server shards,
+            # then everyone barriers); RNG last so nothing below
+            # re-derives from the pre-restore key
+            if hasattr(self, "restore_checkpoint_state"):
+                self.restore_checkpoint_state(
+                    {"optimizer_states":
+                     resume_payload.get("optimizer_states")})
+            _ckpt.set_rng_state(resume_payload.get("rng"))
+
+        manager = None
+        if every_n and every_n > 0:
+            if not ckpt_dir:
+                raise ValueError(
+                    "checkpoint_every_n=%d needs checkpoint_dir (or "
+                    "MXNET_CKPT_DIR/resume_from)" % every_n)
+            if hasattr(self, "get_checkpoint_state"):
+                manager = _ckpt.CheckpointManager(ckpt_dir)
+            else:
+                self.logger.warning(
+                    "%s has no get_checkpoint_state — "
+                    "checkpoint_every_n ignored", type(self).__name__)
 
         if validation_metric is None:
             validation_metric = eval_metric
@@ -193,7 +272,12 @@ class BaseModule:
         from .. import engine as _engine
         from .. import profiler as _profiler
 
-        per_batch = monitor is not None or _profiler.is_running()
+        # chaos injection (kill/nan_grad at an exact global step) needs
+        # per-batch stepping — a fused K-step dispatch has no mid-group
+        # injection point
+        chaos_on = _chaos.enabled()
+        per_batch = monitor is not None or _profiler.is_running() \
+            or chaos_on
         bulk_k = 1 if per_batch else max(1, _engine.fit_bulk_size())
         can_bulk = bulk_k > 1 and hasattr(self, "_bulk_fit_steps")
 
@@ -203,11 +287,90 @@ class BaseModule:
             except Exception:
                 return None
 
+        # live progress for the checkpoint layer: the periodic saves,
+        # and the SIGTERM/watchdog preemption hook, both label their
+        # shard with the LAST COMPLETED optimizer step
+        progress = {"step": global_step, "epoch": begin_epoch,
+                    "nbatch": resume_skip}
+
+        def _save_checkpoint(blocking=None) -> None:
+            # blocking=None lets MXNET_CKPT_ASYNC decide (the periodic
+            # saves); the preemption hook forces True — it runs last
+            st = self.get_checkpoint_state()
+            manager.save(progress["step"],
+                         params=st["arg_params"],
+                         aux_params=st["aux_params"],
+                         optimizer_states=st["optimizer_states"],
+                         epoch=progress["epoch"],
+                         nbatch=progress["nbatch"],
+                         iterator_state={"cursor": getattr(
+                             train_data, "cursor", None)},
+                         blocking=blocking)
+
+        hook_key = None
+        if manager is not None:
+            hook_key = _diag.register_preemption_hook(
+                lambda: _save_checkpoint(blocking=True),
+                key="module_fit_%d" % id(self))
+
+        try:
+            self._fit_epochs(
+                train_data, eval_data, eval_metric, validation_metric,
+                epoch_end_callback, batch_end_callback,
+                eval_end_callback, eval_batch_end_callback, monitor,
+                begin_epoch, num_epoch, can_bulk, bulk_k, chaos_on,
+                progress, resume_skip, manager, every_n,
+                _save_checkpoint, _batch_samples)
+        finally:
+            if hook_key is not None:
+                _diag.unregister_preemption_hook(hook_key)
+            if manager is not None:
+                manager.wait()
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, epoch_end_callback,
+                    batch_end_callback, eval_end_callback,
+                    eval_batch_end_callback, monitor, begin_epoch,
+                    num_epoch, can_bulk, bulk_k, chaos_on, progress,
+                    resume_skip, manager, every_n, _save_checkpoint,
+                    _batch_samples):
+        """The epoch/batch loop body of :meth:`fit` (split out so the
+        checkpoint hook registration wraps it in one try/finally)."""
+        from .. import chaos as _chaos
+        from .. import diagnostics as _diag
+        from .. import profiler as _profiler
+
+        progress["last_save"] = progress["step"]
+
+        def _maybe_save() -> None:
+            """Save when an every_n boundary was crossed since the last
+            save (the bulk path crosses several per group — one shard,
+            labeled with the group-end step, covers them)."""
+            if manager is None or not every_n:
+                return
+            if progress["step"] // every_n > \
+                    progress["last_save"] // every_n:
+                progress["last_save"] = progress["step"]
+                _save_checkpoint()
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
+            progress["epoch"] = epoch
+            if resume_skip and epoch == begin_epoch:
+                # exact-resume fast-forward: replay the iterator to the
+                # checkpointed position (deterministic iterators only —
+                # the exact-resume contract requires one)
+                for _ in range(resume_skip):
+                    try:
+                        next(data_iter)
+                    except StopIteration:
+                        break
+                nbatch = resume_skip
+            progress["nbatch"] = nbatch
+            start_nbatch = nbatch
 
             if can_bulk:
                 pending = []
@@ -245,6 +408,9 @@ class BaseModule:
                             nbatch = self._fit_batch_end(
                                 epoch, nbatch, eval_metric,
                                 batch_end_callback)
+                            progress["step"] += 1
+                            progress["nbatch"] = nbatch
+                            _maybe_save()
                         continue
                     # the K steps ran as ONE dispatch: amortize its wall
                     # time uniformly over the group's batches.  The
@@ -266,15 +432,35 @@ class BaseModule:
                             metric_values=eval_metric.get_name_value())
                         nbatch = self._fit_batch_end(
                             epoch, nbatch, eval_metric, batch_end_callback)
+                        progress["step"] += 1
+                        progress["nbatch"] = nbatch
+                    # device state is post-GROUP: save once here so the
+                    # shard's step label matches the params it holds
+                    _maybe_save()
             else:
                 end_of_batch = False
-                next_data_batch = next(data_iter)
+                try:
+                    next_data_batch = next(data_iter)
+                except StopIteration:
+                    # a resume landing exactly on an epoch boundary
+                    # fast-forwarded through the whole epoch
+                    end_of_batch = True
+                    data_batch = None
                 while not end_of_batch:
                     data_batch = next_data_batch
                     if monitor is not None:
                         monitor.tic()
                     step_tic = time.time()
                     self.forward_backward(data_batch)
+                    if chaos_on:
+                        # mid-step fault window: backward done, update
+                        # not — exactly where a real preemption hurts
+                        _chaos.should_kill(progress["step"] + 1)
+                        if _chaos.fault("nan_grad",
+                                        step=progress["step"] + 1) \
+                                is not None and \
+                                hasattr(self, "_corrupt_grads_nan"):
+                            self._corrupt_grads_nan()
                     self.update()
                     try:
                         next_data_batch = next(data_iter)
@@ -295,6 +481,20 @@ class BaseModule:
                         for cb in _as_list(batch_end_callback):
                             cb(param)
                     nbatch += 1
+                    progress["step"] += 1
+                    progress["nbatch"] = nbatch
+                    _maybe_save()
+
+            if resume_skip and epoch == begin_epoch and \
+                    nbatch == start_nbatch and start_nbatch > 0:
+                # the checkpoint was taken on this epoch's LAST batch —
+                # its training completed before the interruption, so
+                # the fast-forward consumed the whole iterator and zero
+                # steps ran here.  Re-running the epoch tail would fire
+                # duplicate epoch-end callbacks and score a freshly
+                # reset (empty) metric; skip straight to the next epoch.
+                train_data.reset()
+                continue
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -317,6 +317,31 @@ class Module(BaseModule):
             self._do_update()
 
     def _do_update(self):
+        from .. import env as _env
+
+        if _env.get_bool("MXNET_SKIP_NONFINITE_GRADS") and \
+                not self._grads_finite():
+            # non-finite guard: a NaN/Inf gradient pushed into the
+            # kvstore poisons EVERY worker's next pull.  Local path:
+            # skip the step outright.  Kvstore path: zero the grads and
+            # fall through — the sync aggregation round still gets this
+            # worker's part (a skipped push would stall every peer's
+            # pull), it just contributes nothing.  Counted either way
+            # so an operator sees divergence building.
+            from .. import diagnostics as _diag
+
+            _diag.metrics.counter(
+                "mxnet_training_skipped_steps_total",
+                help="optimizer steps skipped (or neutralized) by the "
+                     "non-finite gradient guard").inc()
+            self.logger.warning(
+                "non-finite gradient detected — %s this optimizer step "
+                "(MXNET_SKIP_NONFINITE_GRADS=1)",
+                "neutralizing" if self._kvstore is not None
+                else "skipping")
+            if self._kvstore is None:
+                return
+            self._zero_grads()
         if self._kvstore is not None:
             for i, name in enumerate(self._param_names):
                 grad = self._exec.grad_dict.get(name)
@@ -365,6 +390,35 @@ class Module(BaseModule):
         mon.install(self._exec)
 
     # ------------------------------------------------------------------
+    def _grads_finite(self) -> bool:
+        """One fused all-finite check over every gradient buffer (a
+        single host sync — the price of the MXNET_SKIP_NONFINITE_GRADS
+        guard)."""
+        import jax.numpy as jnp
+
+        ok = True
+        for name in self._param_names:
+            g = self._exec.grad_dict.get(name)
+            if g is None:
+                continue
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g._data)))
+        return bool(ok)
+
+    def _zero_grads(self) -> None:
+        for name in self._param_names:
+            g = self._exec.grad_dict.get(name)
+            if g is not None:
+                g[:] = 0
+
+    def _corrupt_grads_nan(self) -> None:
+        """Chaos 'nan_grad' injection target: poison every gradient with
+        NaN — what a diverged loss or a bad reduction does for real."""
+        for name in self._param_names:
+            g = self._exec.grad_dict.get(name)
+            if g is not None:
+                g[:] = float("nan")
+
+    # ------------------------------------------------------------------
     def _active_updater(self):
         """The updater that actually holds optimizer state: the kvstore's
         when update_on_kvstore, else the local one (ref: module.py
@@ -372,6 +426,57 @@ class Module(BaseModule):
         if self._update_on_kvstore and self._kvstore is not None:
             return self._kvstore._opt_updater
         return self._updater
+
+    # -- elastic checkpoint/resume surface (mxnet_tpu/checkpoint.py) ----
+    def get_checkpoint_state(self) -> dict:
+        """Everything fit()'s checkpoint shard needs from the module:
+        params, aux (BN moments), and the optimizer/momenta blob.  On a
+        dist kvstore, rank 0 gathers the server-held states (other
+        ranks shard None — params are replicated, momenta live
+        server-side); locally it is the active Updater's pickle."""
+        arg_params, aux_params = self.get_params()
+        opt_states = None
+        kv = self._kvstore
+        try:
+            if kv is not None and hasattr(kv, "_server_clients"):
+                if getattr(kv, "rank", 0) == 0:
+                    # bounded: this also runs from the SIGTERM/watchdog
+                    # preemption hook, where waiting out the full PS
+                    # request timeout would break the exit-within-
+                    # seconds contract (momenta are then best-effort)
+                    from .. import env as _env
+
+                    bound = max(_env.get_float("MXNET_CKPT_DRAIN_S"),
+                                5.0)
+                    opt_states = kv.get_optimizer_states_bytes(
+                        dump_optimizer=True, timeout=bound)
+            else:
+                updater = self._active_updater()
+                if updater is not None:
+                    opt_states = updater.get_states(dump_optimizer=True)
+        except Exception:
+            self.logger.exception(
+                "checkpoint: optimizer state capture failed — the shard "
+                "will resume with fresh momenta")
+        return {"arg_params": arg_params, "aux_params": aux_params,
+                "optimizer_states": opt_states}
+
+    def restore_checkpoint_state(self, payload: dict) -> None:
+        """Re-install a loaded shard's optimizer state after
+        init_optimizer (params were already applied through
+        init_params(arg_params=...)).  Dist kvstore: rank 0 pushes the
+        gathered server states back, then everyone barriers so no
+        worker races ahead of the restore."""
+        opt_states = payload.get("optimizer_states")
+        kv = self._kvstore
+        if kv is not None and hasattr(kv, "_server_clients"):
+            if getattr(kv, "rank", 0) == 0 and opt_states is not None:
+                kv.set_optimizer_states_bytes(opt_states)
+            kv.barrier()
+        elif opt_states is not None:
+            updater = self._active_updater()
+            if updater is not None:
+                updater.set_states(opt_states)
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         """ref: module.py save_checkpoint → model.py:366."""

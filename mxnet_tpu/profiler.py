@@ -472,6 +472,13 @@ def dump(finished=True):
         if num_workers > 1:
             base, ext = os.path.splitext(fname)
             fname = "%s_rank%d%s" % (base, rank, ext or ".json")
+        if not os.path.isabs(fname):
+            # relative trace dumps land under MXNET_DUMP_DIR like the
+            # flight-recorder/metrics artifacts (diagnostics.py) so
+            # test/bench runs stop littering the CWD
+            from . import diagnostics as _diag
+
+            fname = _diag._dump_dir_path(fname)
         events = [dict(e, pid=rank) for e in _events]
         meta = [{"name": "process_name", "ph": "M", "pid": rank, "tid": 0,
                  "args": {"name": "rank %d" % rank}}]

@@ -7,10 +7,17 @@ one host — the reference used multi-context CPU tests
 we use XLA's virtual host devices.
 """
 import os
+import tempfile
 
 # disable the axon TPU tunnel for tests and present 8 virtual CPU devices
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
+# telemetry artifacts with relative paths (flightrecorder_rank*.json,
+# profile_rank*.json, metrics expositions) land in a throwaway dir
+# instead of the CWD/repo root; subprocess workers inherit it.  Tests
+# that assert on dumps pass absolute paths, which always win.
+os.environ.setdefault("MXNET_DUMP_DIR",
+                      tempfile.mkdtemp(prefix="mxnet-test-dumps-"))
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:

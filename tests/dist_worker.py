@@ -195,10 +195,46 @@ def run_flight_desync():
     print("worker %d OK" % kv.rank)
 
 
+def run_chaos_drop():
+    """Retry/backoff + exactly-once proof (mxnet_tpu/chaos.py): the test
+    sets MXNET_CHAOS=drop_push:rank=1,nth=2 — rank 1's second push
+    DELIVERS but its response is lost.  The transport must back off,
+    reconnect and resend (kvstore._req_server), the server must dedupe
+    the resent pseq (kvstore_server._handle_push), and the sync
+    aggregate must stay EXACT with zero operator intervention."""
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2
+    kv.init("a", nd.zeros((4,)))
+    for rnd in range(1, 4):
+        kv.push("a", nd.ones((4,)) * (rank + 1) * rnd)
+        out = nd.zeros((4,))
+        kv.pull("a", out=out)
+        # no optimizer: the server REPLACES with each round's aggregate
+        want = sum(r + 1 for r in range(nw)) * rnd
+        np.testing.assert_allclose(out.asnumpy(), want)
+    from mxnet_tpu import chaos as _chaos
+    from mxnet_tpu import diagnostics as _diag
+
+    if rank == 1:
+        # the fault really fired, and the retry path really absorbed it
+        assert _chaos.injected_total("drop_push") == 1
+        retries = _diag.metrics.counter("mxnet_ps_retries_total",
+                                        labels={"op": "push"})
+        assert retries.value >= 1, "drop was absorbed without a retry?"
+    else:
+        assert _chaos.injected_total() == 0
+    kv.barrier()
+    kv.close()
+    print("worker %d OK" % rank)
+
+
 def main():
     kind = sys.argv[1] if len(sys.argv) > 1 else "dist_sync"
     if kind == "flight":
         return run_flight_desync()
+    if kind == "chaos_drop":
+        return run_chaos_drop()
     kv = mx.kv.create(kind)
     assert kv.num_workers >= 1
     if kind == "dist_sync":

@@ -1,0 +1,62 @@
+"""Fault-tolerance e2e worker: a 2-worker dist_sync Module.fit with
+elastic checkpointing, run three ways by test_fault_tolerance.py:
+
+  control — uninterrupted run; dumps final params per rank.
+  victim  — MXNET_CHAOS kills rank 1 mid-step; rank 0's sync pull
+            times out; the fleet dies leaving checkpoint shards +
+            flight dumps (rank 0's header names worker:1 dead).
+  resume  — fresh cluster resumes from the newest COMPLETE checkpoint
+            step and finishes; final params must match control
+            BITWISE (2-worker sums are commutative-exact, and the
+            server momenta round-trip through the gathered optimizer
+            state blob).
+
+Usage: ft_worker.py <mode> <ckpt_dir> <out_prefix>
+"""
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=8)
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=4)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def main():
+    mode, ckpt_dir, out_prefix = sys.argv[1], sys.argv[2], sys.argv[3]
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+    # per-rank data (seeded): both runs of a rank see identical batches;
+    # identical param init on every rank (replicated-params contract)
+    rng = np.random.RandomState(100 + rank)
+    x = rng.randn(12, 6).astype(np.float32)
+    y = rng.randint(0, 4, (12,)).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, batch_size=4, shuffle=False)
+    np.random.seed(0)
+    mx.random.seed(0)
+    mod = mx.mod.Module(symbol=mlp(), context=mx.cpu())
+    kw = dict(checkpoint_every_n=2, checkpoint_dir=ckpt_dir)
+    if mode == "resume":
+        kw["resume_from"] = ckpt_dir
+    # 2 epochs x 3 steps; the victim's kill (chaos env) lands at step 5,
+    # so the resume replays from the step-4 shard across epoch 1
+    mod.fit(train, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0, "wd": 0.0},
+            num_epoch=2, **kw)
+    args, _ = mod.get_params()
+    np.savez("%s_rank%d.npz" % (out_prefix, rank),
+             **{k: v.asnumpy() for k, v in args.items()})
+    kv.close()
+    print("ft worker %d done (%s)" % (rank, mode))
+
+
+if __name__ == "__main__":
+    main()

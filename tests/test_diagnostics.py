@@ -107,6 +107,9 @@ def test_watchdog_marks_suspect_and_dumps(tmp_path):
 
 def test_dump_on_demand_rank_suffix(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
+    # unset MXNET_DUMP_DIR (conftest defaults it): this test pins the
+    # env-less behavior — relative dumps land in the CWD
+    monkeypatch.delenv("MXNET_DUMP_DIR", raising=False)
     fr = diag.FlightRecorder(capacity=4)
     s = fr.start("push", keys=["a"], nbytes=16, dtype="float32")
     fr.complete(s)
@@ -571,6 +574,7 @@ raise SystemExit(0)  # atexit runs; neither dump was explicit
         capture_output=True, text=True, cwd=str(tmp_path),
         env=dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
                  T_TRACE=str(trace),
+                 MXNET_DUMP_DIR=str(tmp_path),  # relative dumps -> here
                  PYTHONPATH=os.path.abspath(
                      os.path.join(os.path.dirname(__file__), "..")) +
                  os.pathsep + os.environ.get("PYTHONPATH", "")))

@@ -18,9 +18,11 @@ barrier jitter, which is itself informative).
 together with the rank traces and reports: the last collective seq each
 rank completed, which ranks diverge and at exactly which seq/bucket/key
 ("rank 1 never entered seq 12"), collectives still in flight or marked
-suspect by the watchdog, bucket-plan mismatches between ranks, and
-per-rank step-time distributions with slowest-rank / p50-vs-p99
-straggler flags.  Exit code 2 when a desync was detected.
+suspect by the watchdog, heartbeat-declared dead peers (each rank's
+header carries the scheduler's dead_nodes answer), bucket-plan
+mismatches between ranks, and per-rank step-time distributions with
+slowest-rank / p50-vs-p99 straggler flags.  Exit code 2 when a desync,
+dead peer or plan mismatch was detected.
 
 Usage:
     tools/merge_traces.py profile_rank0.json profile_rank1.json -o merged.json
@@ -181,6 +183,20 @@ def analyze_desync(flight):
             "max_completed_seq": max_done, "laggards": laggards}
 
 
+def analyze_dead_peers(flight):
+    """Heartbeat-declared dead peers: each rank's flight header carries
+    the scheduler's dead_nodes answer (_ps.Heartbeat feeds it via
+    diagnostics.set_dead_peers).  Reported as {peer: [ranks that saw it
+    dead]} — a peer every surviving rank declares dead IS the hang's
+    root cause, named directly instead of inferred from seq lag."""
+    seen = {}
+    for rank, payload in sorted(flight.items()):
+        for peer in payload.get("header", {}).get("dead_peers") or []:
+            seen.setdefault(str(peer), []).append(rank)
+    return {"detected": bool(seen),
+            "peers": {p: sorted(r) for p, r in sorted(seen.items())}}
+
+
 def analyze_bucket_plans(flight):
     """Bucket-plan fingerprints per rank + mismatch detection — two
     ranks reducing under DIFFERENT plans desync by construction."""
@@ -254,6 +270,7 @@ def health_report(flight, traces):
               "desync": analyze_desync(flight)}
     if flight:
         report["bucket_plans"] = analyze_bucket_plans(flight)
+        report["dead_peers"] = analyze_dead_peers(flight)
     stragglers = analyze_stragglers(traces)
     if stragglers is not None:
         report["stragglers"] = stragglers
@@ -293,6 +310,13 @@ def format_health(report):
     elif desync.get("ranks"):
         lines.append("no desync: all ranks completed seq %d"
                      % desync["max_completed_seq"])
+    dead = report.get("dead_peers", {})
+    if dead.get("detected"):
+        for peer, ranks in dead["peers"].items():
+            lines.append(
+                "DEAD PEER (heartbeat): %s — declared dead by rank%s %s"
+                % (peer, "" if len(ranks) == 1 else "s",
+                   ",".join(map(str, ranks))))
     if report.get("bucket_plans", {}).get("mismatch"):
         lines.append("BUCKET PLAN MISMATCH: ranks are reducing under "
                      "different bucket plans (see report.bucket_plans)")
@@ -325,10 +349,13 @@ def run_health(paths, out_path=None) -> int:
         with open(out_path, "w") as f:
             json.dump(report, f, indent=1)
         print("health report -> %s" % out_path)
-    # bucket-plan mismatch is a desync by construction — same exit
-    # contract as a seq divergence so script consumers catch both
+    # bucket-plan mismatch is a desync by construction, and a
+    # heartbeat-declared dead peer is a fleet failure even when the
+    # dead rank left no dump to diverge from — same exit contract as a
+    # seq divergence so script consumers catch all three
     unhealthy = report["desync"].get("detected") or \
-        report.get("bucket_plans", {}).get("mismatch")
+        report.get("bucket_plans", {}).get("mismatch") or \
+        report.get("dead_peers", {}).get("detected")
     return 2 if unhealthy else 0
 
 
@@ -372,7 +399,7 @@ def self_test() -> int:
         # --health: rank 1's flight recorder stops one collective short
         # (and has one in flight) — the analysis must name rank 1, the
         # stalled seq and its bucket/keys
-        def flight_dump(rank, n_done, in_flight=None):
+        def flight_dump(rank, n_done, in_flight=None, dead=None):
             entries = [{"seq": s, "op": "bucket_reduce", "bucket": s % 3,
                         "keys": ["w%d" % s], "bytes": 1024,
                         "dtype": "float32", "enqueue_ts": 100.0 + s,
@@ -388,6 +415,7 @@ def self_test() -> int:
             payload = {"header": {"flight_recorder": True, "rank": rank,
                                   "num_workers": 2, "capacity": 256,
                                   "next_seq": len(entries), "dropped": 0,
+                                  "dead_peers": list(dead or []),
                                   "bucket_plan": {"n_buckets": 3,
                                                   "total_bytes": 3072,
                                                   "cap_bytes": 4 << 20}},
@@ -397,7 +425,7 @@ def self_test() -> int:
                 json.dump(payload, f)
             return p
 
-        f0 = flight_dump(0, 13)
+        f0 = flight_dump(0, 13, dead=["worker:1"])
         f1 = flight_dump(1, 12, in_flight=12)
         flight, traces = load_health_inputs([f0, f1] + paths)
         assert set(flight) == {0, 1} and set(traces) == {0, 1}
@@ -410,9 +438,13 @@ def self_test() -> int:
         assert lag["collective"]["bucket"] == 0
         assert lag["collective"]["keys"] == ["w12"]
         assert not report["bucket_plans"]["mismatch"]
+        # heartbeat-declared dead peers ride the header into the report
+        assert report["dead_peers"]["detected"]
+        assert report["dead_peers"]["peers"] == {"worker:1": [0]}
         text = "\n".join(format_health(report))
         assert "rank 1 never completed seq 12" in text, text
         assert "bucket 0" in text and "w12" in text, text
+        assert "DEAD PEER (heartbeat): worker:1" in text, text
         # straggler flags over the synthetic traces: identical spans on
         # both ranks -> nobody flagged
         st = report["stragglers"]
