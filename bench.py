@@ -637,6 +637,41 @@ def bench_recordio_input(compute_ips=None, compute_dtype="bfloat16",
     return row
 
 
+def bench_serving(slo_p99_ms=50.0):
+    """The ROADMAP serving acceptance row: QPS the batching model
+    server sustains at a fixed admitted-p99 SLO (open-loop load ramp
+    via serving.qps_at_slo — offered load keeps rising until p99
+    breaks the SLO or >2% of traffic is shed; the row reports the
+    last rate that held).  In-process over the demo MLP: the number
+    measures the serving tier (queue + batcher + AOT executors), not
+    a particular model's FLOPs."""
+    from mxnet_tpu import serving
+
+    rt = serving.demo_runtime("bench_serve", dim=64, hidden=128,
+                              classes=16, max_batch=32)
+    srv = serving.ModelServer(max_batch=32, queue_max=128,
+                              batch_deadline_ms=2,
+                              default_deadline_ms=slo_p99_ms * 4)
+    t0 = time.time()
+    srv.add_model(rt)  # AOT-compiles + warms every batch bucket
+    compile_s = time.time() - t0
+    rep = serving.qps_at_slo(srv, "bench_serve", slo_p99_ms=slo_p99_ms,
+                             start_qps=100.0, max_qps=20000.0,
+                             window_s=1.0)
+    srv.drain(timeout_s=10.0)
+    return {
+        "pipeline": "serving (dynamic batching, AOT bf16 buckets)",
+        "model": "demo_mlp(64-128-16)",
+        "slo_p99_ms": slo_p99_ms,
+        "qps_at_slo": rep["qps_at_slo"],
+        "p50_ms_at_slo": rep["p50_ms_at_slo"],
+        "p99_ms_at_slo": rep["p99_ms_at_slo"],
+        "batch_buckets": list(rt.plan),
+        "compile_warmup_s": round(compile_s, 2),
+        "ramp": rep["ramp"],
+    }
+
+
 def _sym_resnet50(num_classes=1000):
     """Symbolic ResNet-50 v1 (bottleneck 3-4-6-3, He et al. 2015 table 1)
     for the Module.fit path — built on mx.sym so the fit-loop bench
@@ -845,8 +880,8 @@ def _memory_probe(batch=16, bulk_k=2, img=128):
 # --------------------------------------------------------------------
 _STATE = {
     "table": [], "io": None, "fit_loop": None, "bare_jax": [],
-    "memory": None, "mfu_attribution": None, "headline": None,
-    "peak": None, "kind": None, "emitted": False,
+    "memory": None, "mfu_attribution": None, "serving": None,
+    "headline": None, "peak": None, "kind": None, "emitted": False,
 }
 
 
@@ -877,6 +912,7 @@ def _emit_final(reason=None):
         "bare_jax": _STATE["bare_jax"],
         "memory": _STATE["memory"],
         "mfu_attribution": _STATE["mfu_attribution"],
+        "serving": _STATE["serving"],
     }
     # which reduction schedule produced these numbers: the bucketing
     # config + the last bucket plan the FusedTrainStep runs stamped into
@@ -1215,6 +1251,17 @@ def main():
     else:
         _STATE["table"].append(
             {"skipped": "resnet50_v1/float32 bs32 — budget"})
+
+    # ---- phase 3c: serving row (QPS at a fixed p99 SLO — the ROADMAP
+    # item-1 acceptance line; in-process, CPU-cheap, budget-gated) ----
+    try:
+        if left() < 60:
+            raise RuntimeError("time budget spent before serving row "
+                               "(elapsed %.0fs)" % elapsed())
+        _STATE["serving"] = bench_serving()
+    except Exception as exc:
+        _STATE["serving"] = {"pipeline": "serving", "error": repr(exc)}
+    _progress({"serving": _STATE["serving"]})
 
     # io comparator: the bf16@32 headline row
     io_compute_ref, io_ref_label = None, None

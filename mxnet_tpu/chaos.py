@@ -31,6 +31,15 @@ hold; ``nth`` skips the first nth-1 candidate events.  Kinds:
     ``step`` on ``rank`` — what the ``MXNET_SKIP_NONFINITE_GRADS``
     guard must catch before the push poisons the fleet.  Match keys:
     ``rank``, ``step``, ``count``.
+  * ``slow_request``   — sleep ``ms`` (default 50) at the serving
+    batcher's dispatch point before the matching model's batch
+    executes — a seeded slow executor the admission-control/deadline
+    layer must bound instead of letting queues grow without limit.
+    Match keys: ``model``, ``nth``, ``count``, ``ms``.
+  * ``fail_execute``   — the serving model runtime raises from
+    ``execute()`` for the matching model — consecutive failures must
+    trip the per-model circuit breaker into fast-fail instead of
+    queueing doomed work.  Match keys: ``model``, ``nth``, ``count``.
 
 Injected faults count into ``mxnet_chaos_injected_total{kind=...}``
 (diagnostics.metrics) so a test can assert the fault actually fired —
@@ -51,6 +60,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["Rule", "rules", "enabled", "fault", "should_kill",
+           "maybe_slow_request", "should_fail_execute",
            "injected_total", "reset", "KILL_EXIT_CODE"]
 
 _log = logging.getLogger(__name__)
@@ -216,8 +226,11 @@ def fault(kind: str, **ctx) -> Optional[Rule]:
         ctx = _default_rank(ctx)
         for r in rs:
             if r.kind == kind and r.try_fire(ctx):
-                _log.warning("chaos: injecting %s (%s) at %s",
-                             kind, r.describe(), ctx)
+                # first firing per rule is loud; the rest (a serving
+                # rule can fire thousands of times a second) are debug
+                log = _log.warning if r.fired == 1 else _log.debug
+                log("chaos: injecting %s (%s) at %s",
+                    kind, r.describe(), ctx)
                 _count_injection(kind)
                 return r
         return None
@@ -244,6 +257,22 @@ def should_kill(step: int, **ctx) -> None:
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(KILL_EXIT_CODE)
+
+
+def maybe_slow_request(model: str, **ctx) -> None:
+    """slow_request hook (serving batcher dispatch): sleep ms when a
+    rule fires — the seeded straggler executor the overload e2e test
+    drives load against."""
+    r = fault("slow_request", model=model, **ctx)
+    if r is not None:
+        time.sleep(float(r.params.get("ms", 50.0)) / 1e3)
+
+
+def should_fail_execute(model: str, **ctx) -> bool:
+    """fail_execute hook (serving model runtime): True when the matching
+    model's execute() should raise — what must trip the circuit
+    breaker after MXNET_SERVE_BREAKER_N consecutive hits."""
+    return fault("fail_execute", model=model, **ctx) is not None
 
 
 def injected_total(kind: Optional[str] = None) -> int:
@@ -306,7 +335,27 @@ def _self_test() -> tuple:
         del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
         reset()
 
-    # 5) disabled == inert (and never raises)
+    # 5) the serving kinds: slow_request sleeps its ms budget on the
+    # matching model only; fail_execute fires its count then stops
+    os.environ["MXNET_CHAOS"] = (  # mxlint: disable=MXL002
+        "slow_request:model=rn50,ms=1;fail_execute:model=rn50,count=2")
+    reset()
+    try:
+        t0 = time.time()
+        maybe_slow_request("other_model")
+        checks["slow_request_model_scoped"] = time.time() - t0 < 0.5 \
+            and injected_total("slow_request") == 0
+        maybe_slow_request("rn50")
+        checks["slow_request_fires"] = injected_total("slow_request") == 1
+        fires = [should_fail_execute("rn50") for _ in range(3)]
+        checks["fail_execute_count"] = fires == [True, True, False]
+        checks["fail_execute_wrong_model"] = \
+            not should_fail_execute("other_model")
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        reset()
+
+    # 6) disabled == inert (and never raises)
     checks["disabled_inert"] = not enabled() and \
         fault("kill", step=1) is None
 

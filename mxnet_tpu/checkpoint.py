@@ -49,7 +49,7 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "FORMAT_VERSION", "CheckpointManager", "save_checkpoint",
     "load_checkpoint", "latest_step", "list_steps", "step_dir",
-    "shard_path",
+    "shard_path", "missing_ranks",
 ]
 
 _log = logging.getLogger(__name__)
@@ -90,6 +90,27 @@ def list_steps(directory: str) -> List[int]:
 def _is_complete(directory: str, step: int, num_ranks: int) -> bool:
     return all(os.path.exists(shard_path(directory, step, r))
                for r in range(num_ranks))
+
+
+def missing_ranks(directory: str, step: int, num_ranks: int) -> List[int]:
+    """Which ranks' shards are absent from ``step`` — the difference
+    between "missing-file error" and an actionable one: a server that
+    refuses to load a model must say WHOSE shard never landed."""
+    return [r for r in range(num_ranks)
+            if not os.path.exists(shard_path(directory, step, r))]
+
+
+def _incomplete_detail(directory: str, num_ranks: int) -> str:
+    """One line naming the newest incomplete step's missing ranks (or
+    the absence of any step directory) for load errors."""
+    steps = list_steps(directory)
+    if not steps:
+        return "no step_* directories exist"
+    newest = steps[-1]
+    missing = missing_ranks(directory, newest, num_ranks)
+    present = [r for r in range(num_ranks) if r not in missing]
+    return ("newest step %d is missing shard(s) for rank(s) %s of %d "
+            "(present: %s)" % (newest, missing, num_ranks, present))
 
 
 def latest_step(directory: str,
@@ -225,13 +246,26 @@ class CheckpointManager:
                 self._q.task_done()
 
     def _write(self, step: int, payload: dict, path: str) -> None:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)  # readers never see a torn shard
+        # one retry: a peer rank's janitor may rmdir this step between
+        # our makedirs and the replace (GC of a stale incomplete step
+        # racing the async writer) — recreate and land the shard; the
+        # atomicity contract (tmp + os.replace) holds either way, so
+        # readers still never see a torn or half-deleted-yet-"complete"
+        # step: a shard either fully exists or is absent
+        for attempt in (0, 1):
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    pickle.dump(payload, f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)  # readers never see a torn shard
+                break
+            except FileNotFoundError:
+                if attempt:
+                    raise
         self._gc(keep_at_least=step)
 
     def _gc(self, keep_at_least: int) -> None:
@@ -313,14 +347,26 @@ def load_checkpoint(directory: str, step: Optional[int] = None,
     ValueError on a format from the future."""
     if rank is None:
         rank = _rank_info()[0]
+    nr = max(_rank_info()[1], 1) if num_ranks is None else int(num_ranks)
     if step is None:
         step = latest_step(directory, num_ranks=num_ranks)
         if step is None:
             raise FileNotFoundError(
                 "no complete checkpoint under %r (a step is complete "
-                "only when every rank's shard exists)" % directory)
+                "only when every rank's shard exists): %s"
+                % (directory, _incomplete_detail(directory, nr)))
     path = shard_path(directory, step, rank)
-    with open(path, "rb") as f:
+    try:
+        f = open(path, "rb")
+    except FileNotFoundError:
+        missing = missing_ranks(directory, step, nr)
+        present = [r for r in range(nr) if r not in missing]
+        raise FileNotFoundError(
+            "checkpoint step %d under %r is incomplete: missing "
+            "shard(s) for rank(s) %s of %d (present: %s) — every rank "
+            "must finish writing before the step is loadable"
+            % (step, directory, missing or [rank], nr, present))
+    with f:
         payload = pickle.load(f)
     version = payload.get("format_version")
     if version is None or version > FORMAT_VERSION:

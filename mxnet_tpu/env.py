@@ -271,6 +271,36 @@ register("MXNET_METRICS_FILE", "str", None,
 register("MXNET_METRICS_INTERVAL_S", "float", 30.0,
          "Period of the metrics file flush (s).")
 
+# serving/ — batching model server (admission, deadlines, drain)
+register("MXNET_SERVE_QUEUE_MAX", "int", 128,
+         "Per-model admission bound (requests).  A submit arriving at a "
+         "full queue is shed with reason=queue_full and a retry-after "
+         "hint instead of growing an unbounded backlog.")
+register("MXNET_SERVE_MAX_BATCH", "int", 32,
+         "Largest dynamic batch (samples) the batcher assembles; also "
+         "the top of the compiled batch-bucket ladder.")
+register("MXNET_SERVE_BATCH_DEADLINE_MS", "float", 5.0,
+         "How long the dynamic batcher holds the first queued request "
+         "open for co-batching before dispatching a partial batch.")
+register("MXNET_SERVE_DEADLINE_MS", "float", 1000.0,
+         "Default per-request deadline; admitted requests that expire "
+         "in the queue are dropped before dispatch (never batched), "
+         "counted mxnet_serve_requests_total{outcome=expired}; a "
+         "deadline already dead at submit sheds with reason=deadline.")
+register("MXNET_SERVE_DRAIN_S", "float", 10.0,
+         "Graceful drain budget: stop admitting, flush queued + "
+         "in-flight batches, then exit (SIGTERM preemption-hook path).")
+register("MXNET_SERVE_BREAKER_N", "int", 5,
+         "Per-model circuit breaker: consecutive executor failures "
+         "before the model fast-fails submits (reason=breaker_open) "
+         "instead of queueing doomed work.  0 disables the breaker.")
+register("MXNET_SERVE_BREAKER_RESET_S", "float", 5.0,
+         "How long an open circuit breaker waits before letting one "
+         "half-open probe batch through; success closes it.")
+register("MXNET_SERVE_PORT", "int", 8000,
+         "HTTP front-end port for python -m mxnet_tpu.serving --serve "
+         "(predict + healthz/readyz/metrics).")
+
 # image/image.py — decode pool
 register("MXNET_CPU_WORKER_NTHREADS", "int", 1,
          "Decode worker threads for ImageIter augmentation.")

@@ -1,0 +1,45 @@
+"""mx.serving — the production inference tier: a dynamic-batching
+model server built robustness-first on the checkpoint / diagnostics /
+chaos stack.
+
+The amalgamation + c_predict ABI proved python-free single-shape
+inference; this package is what actually fronts traffic: per-model
+bounded queues with admission control and explicit load shedding,
+deadline propagation (expired work is never batched), AOT-compiled
+bf16 executors per batch bucket with a warmup pass (the first request
+never pays compile latency), a per-model circuit breaker, graceful
+SIGTERM drain through the shared preemption-hook path (exit 83 — see
+the README exit-code table), distinct liveness/readiness probes, and
+Prometheus metrics (p50/p99 latency, QPS, queue depth, shed counts)
+through ``diagnostics.metrics``.
+
+Quickstart::
+
+    from mxnet_tpu import serving
+
+    rt = serving.ModelRuntime.from_checkpoint(
+        "resnet", "/ckpts/resnet", apply_fn, sample_shape=(3, 224, 224))
+    srv = serving.ModelServer()
+    srv.add_model(rt)                     # compiles + warms every bucket
+    srv.install_preemption_hook()         # SIGTERM -> drain -> exit 83
+    out = srv.predict("resnet", batch, deadline_ms=250)
+
+``python -m mxnet_tpu.serving --self-test`` exercises admission,
+deadline expiry, breaker trip/reset, and drain ordering (tier-1 via
+tests/test_serving.py); ``--serve`` runs the HTTP front-end.
+"""
+from .batching import Request, RequestQueue
+from .errors import (REJECT_REASONS, DeadlineExceeded, ExecutorFailure,
+                     Rejected, ServeError)
+from .http import HttpFrontend
+from .loadgen import BackgroundLoad, qps_at_slo, run_load
+from .runtime import ModelRuntime, demo_runtime, plan_batch_buckets
+from .server import CircuitBreaker, ModelServer
+
+__all__ = [
+    "Request", "RequestQueue", "ServeError", "Rejected",
+    "DeadlineExceeded", "ExecutorFailure", "REJECT_REASONS",
+    "ModelRuntime", "demo_runtime", "plan_batch_buckets",
+    "CircuitBreaker", "ModelServer", "HttpFrontend",
+    "run_load", "qps_at_slo", "BackgroundLoad",
+]

@@ -1,0 +1,265 @@
+"""CLI: ``python -m mxnet_tpu.serving --self-test`` (tier-1 via
+tests/test_serving.py, mirroring the chaos/diagnostics pattern) and
+``--serve`` (HTTP front-end over the demo model, SIGTERM-drainable).
+
+The self-test drives the robustness layer through stub runtimes whose
+failure modes are deterministic (an executor gated on an Event, one
+that always raises) so queue admission, deadline expiry, breaker
+trip/reset and drain ordering are asserted without timing luck.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Dict
+
+from .batching import Request  # noqa: F401  (re-exported surface)
+from .errors import DeadlineExceeded, ExecutorFailure, Rejected
+from .runtime import demo_runtime, plan_batch_buckets
+from .server import ModelServer
+
+
+class _StubRuntime:
+    """Deterministic executor for the self-test: optionally gated on an
+    Event (a 'slow' executor the tests release), optionally failing."""
+
+    def __init__(self, name: str, fail: bool = False,
+                 gate: threading.Event = None, max_batch: int = 8):
+        self.name = name
+        self.sample_shape = (2,)
+        self.max_batch = max_batch
+        self.plan = plan_batch_buckets(max_batch)
+        self.compiled = True
+        self.fail = fail
+        self.gate = gate
+        self.executed_samples = 0
+        self.executed_batches = 0
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.plan:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def execute(self, batch):
+        if self.gate is not None:
+            self.gate.wait(10.0)
+        if self.fail:
+            raise ExecutorFailure("stub %r always fails" % self.name)
+        import numpy as np
+
+        arr = np.asarray(batch)
+        self.executed_samples += int(arr.shape[0])
+        self.executed_batches += 1
+        return arr.sum(axis=-1)
+
+
+def _self_test() -> tuple:
+    import numpy as np
+
+    checks: Dict[str, bool] = {}
+    x = np.ones((1, 2), dtype="float32")
+
+    # 1) bucket ladder + padding correctness on the REAL runtime: a
+    # single sample answers identically however it is padded
+    rt = demo_runtime(max_batch=8)
+    checks["bucket_ladder"] = plan_batch_buckets(32) == (1, 2, 4, 8, 16,
+                                                        32)
+    rt.compile(warmup=True)
+    checks["aot_compiled_all_buckets"] = rt.compiled and \
+        set(rt.compile_stats()) == {1, 2, 4, 8}
+    one = np.random.RandomState(3).randn(1, 16).astype("float32")
+    cls1, logits1 = rt.execute(one)
+    cls5, logits5 = rt.execute(np.concatenate([one] * 5))
+    checks["padding_is_invisible"] = (
+        cls1.shape == (1,) and logits5.shape[0] == 5
+        and int(cls1[0]) == int(cls5[0])
+        and np.allclose(np.float64(logits1[0]), np.float64(logits5[0])))
+
+    # 2) queue admission: a gated executor wedges the worker; the
+    # bounded queue sheds the overflow with queue_full + retry-after.
+    # 8 submits against (<=2 riding the wedged batch + 3 queue slots):
+    # at least 3 MUST shed whatever the take/submit interleaving
+    gate = threading.Event()
+    gated = _StubRuntime("gated", gate=gate, max_batch=2)
+    srv = ModelServer(queue_max=3, max_batch=2, batch_deadline_ms=1,
+                      default_deadline_ms=10_000, breaker_n=2,
+                      breaker_reset_s=0.2)
+    srv.add_model(gated)
+    reqs, n_shed = [], 0
+    for _ in range(8):
+        try:
+            reqs.append(srv.submit("gated", x))
+        except Rejected as e:
+            n_shed += 1
+            checks.setdefault("shed_reason_queue_full",
+                              e.reason == "queue_full"
+                              and e.retry_after_s is not None)
+    checks["shed_happened"] = n_shed >= 3
+    checks["admitted_bounded"] = len(reqs) <= 5
+    gate.set()  # release the worker
+    outcomes = []
+    for r in reqs:
+        try:
+            r.wait(10.0)
+            outcomes.append("ok")
+        except Exception as e:
+            outcomes.append(type(e).__name__)
+    checks["admitted_complete_on_release"] = all(
+        o == "ok" for o in outcomes)
+
+    # 3) deadline expiry: a request whose deadline passes while it is
+    # QUEUED behind a wedged batch fails with DeadlineExceeded and is
+    # never executed (purged before dispatch, not batched)
+    gate3 = threading.Event()
+    wedge_rt = _StubRuntime("wedge", gate=gate3, max_batch=2)
+    srv_b = ModelServer(queue_max=8, max_batch=2, batch_deadline_ms=1,
+                        default_deadline_ms=10_000)
+    srv_b.add_model(wedge_rt)
+    blocker = srv_b.submit("wedge", x)  # rides alone, wedges the worker
+    time.sleep(0.05)                    # let the batcher take it
+    victim = srv_b.submit("wedge", x, deadline_ms=30)
+    time.sleep(0.08)                    # victim expires in the queue
+    gate3.set()
+    try:
+        blocker.wait(10.0)
+        checks["blocker_completes"] = True
+    except Exception:
+        checks["blocker_completes"] = False
+    try:
+        victim.wait(5.0)
+        checks["deadline_expired_fails"] = False
+    except DeadlineExceeded:
+        checks["deadline_expired_fails"] = True
+    except Exception:
+        checks["deadline_expired_fails"] = False
+    checks["expired_never_executed"] = wedge_rt.executed_samples == 1
+
+    # 4) breaker: consecutive failures (one per batch: each submit is
+    # waited before the next) trip it; submits fast-fail with
+    # breaker_open; after reset_s the half-open probe (healthy again)
+    # closes it
+    flaky = _StubRuntime("flaky", fail=True, max_batch=2)
+    srv2 = ModelServer(queue_max=8, max_batch=2, batch_deadline_ms=1,
+                       default_deadline_ms=10_000, breaker_n=2,
+                       breaker_reset_s=0.15)
+    srv2.add_model(flaky)
+    for _ in range(2):
+        try:
+            r = srv2.submit("flaky", x)
+            try:
+                r.wait(10.0)
+            except ExecutorFailure:
+                pass
+        except Rejected:
+            pass
+    deadline = time.monotonic() + 5.0
+    while srv2._get("flaky").breaker.state() == "closed" and \
+            time.monotonic() < deadline:
+        time.sleep(0.005)
+    checks["breaker_trips"] = \
+        srv2._get("flaky").breaker.state() != "closed"
+    try:
+        srv2.submit("flaky", x)
+        checks["breaker_fast_fails"] = False
+    except Rejected as e:
+        checks["breaker_fast_fails"] = e.reason == "breaker_open"
+    time.sleep(0.2)  # reset window passes -> half-open probe allowed
+    flaky.fail = False
+    try:
+        probe = srv2.submit("flaky", x)
+        probe.wait(10.0)
+        checks["breaker_probe_closes"] = \
+            srv2._get("flaky").breaker.state() == "closed"
+    except Exception:
+        checks["breaker_probe_closes"] = False
+
+    # 5) drain ordering: queued work completes, post-drain submits shed
+    # with reason=draining, drain reports zero left
+    slow = _StubRuntime("slow", max_batch=4)
+    srv3 = ModelServer(queue_max=16, max_batch=4, batch_deadline_ms=1,
+                       default_deadline_ms=10_000)
+    srv3.add_model(slow)
+    pend = [srv3.submit("slow", x) for _ in range(9)]
+    rep = srv3.drain(timeout_s=10.0)
+    checks["drain_zero_left"] = rep["drained"] and rep["left"] == 0
+    checks["drain_completes_admitted"] = all(r.done() and r.error is None
+                                             for r in pend)
+    checks["drain_executed_all_samples"] = slow.executed_samples == 9
+    try:
+        srv3.submit("slow", x)
+        checks["post_drain_sheds"] = False
+    except Rejected as e:
+        checks["post_drain_sheds"] = e.reason == "draining"
+    checks["drained_not_live"] = not srv3.live()
+
+    # 6) probes + prom exposition: ready flips with drain, and the
+    # registry renders valid prom text including the serving counters
+    from .. import diagnostics as _diag
+
+    srv4 = ModelServer(queue_max=4, max_batch=2, batch_deadline_ms=1)
+    srv4.add_model(_StubRuntime("probe", max_batch=2))
+    checks["ready_when_compiled"] = srv4.ready()["ready"] is True
+    checks["live_when_healthy"] = srv4.live() is True
+    srv4.drain(timeout_s=5.0)
+    checks["not_ready_when_draining"] = srv4.ready()["ready"] is False
+    text = _diag.metrics.to_prom()
+    checks["prom_valid"] = not _diag.validate_prom_text(text)
+    checks["prom_has_shed_counter"] = "mxnet_serve_rejected_total" in text
+    checks["prom_has_latency_quantiles"] = \
+        "mxnet_serve_latency_seconds_p99" in text
+
+    return all(checks.values()), checks
+
+
+def _serve(port: int) -> int:
+    """Demo server: the fixed-seed MLP behind the HTTP front-end,
+    SIGTERM-drainable via the shared preemption-hook path."""
+    from .http import HttpFrontend
+
+    rt = demo_runtime()
+    srv = ModelServer()
+    srv.add_model(rt)
+    srv.install_preemption_hook()
+    fe = HttpFrontend(srv, port=port)
+    host, bound = fe.start()
+    print(json.dumps({"serving": rt.name, "host": host, "port": bound,
+                      "buckets": list(rt.plan)}), flush=True)
+    try:
+        while srv.live():
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        srv.drain()
+    fe.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.serving",
+        description="batching model server: self-test / demo serve")
+    ap.add_argument("--self-test", action="store_true",
+                    help="exercise queue admission, deadline expiry, "
+                         "breaker trip/reset, drain ordering")
+    ap.add_argument("--serve", action="store_true",
+                    help="serve the demo model over HTTP until SIGTERM")
+    ap.add_argument("--port", type=int, default=None,
+                    help="HTTP port (default MXNET_SERVE_PORT; 0 picks "
+                         "a free one)")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        ok, checks = _self_test()
+        print(json.dumps({"self_test_ok": ok, "checks": checks}))
+        return 0 if ok else 1
+    if args.serve:
+        return _serve(args.port)
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

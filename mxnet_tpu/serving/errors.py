@@ -1,0 +1,49 @@
+"""Serving error taxonomy — every way a request can fail is a TYPED
+outcome the caller (and the HTTP front-end's status mapping) can switch
+on, and every rejection carries the ``reason`` label that feeds
+``mxnet_serve_rejected_total{reason=...}``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["ServeError", "Rejected", "DeadlineExceeded",
+           "ExecutorFailure", "REJECT_REASONS"]
+
+#: the closed set of admission-rejection reasons (metric label values)
+REJECT_REASONS = ("queue_full", "breaker_open", "draining", "too_large",
+                  "unknown_model", "bad_input", "deadline")
+
+
+class ServeError(RuntimeError):
+    """Base of every serving-layer failure."""
+
+
+class Rejected(ServeError):
+    """The request was never admitted (load shed, breaker open,
+    draining, malformed).  ``retry_after_s`` is the server's estimate
+    of when capacity frees up — the HTTP layer turns it into a
+    ``Retry-After`` header."""
+
+    def __init__(self, reason: str, detail: str = "",
+                 retry_after_s: Optional[float] = None):
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        msg = "rejected (%s)" % reason
+        if detail:
+            msg += ": " + detail
+        if retry_after_s is not None:
+            msg += " — retry after %.2fs" % retry_after_s
+        super().__init__(msg)
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline expired while it was queued (it was
+    dropped BEFORE dispatch — an expired request is never batched) or
+    while the caller waited."""
+
+
+class ExecutorFailure(ServeError):
+    """The compiled executor raised while running the batch this
+    request rode in.  Consecutive failures trip the model's circuit
+    breaker."""

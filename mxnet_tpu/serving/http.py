@@ -1,0 +1,178 @@
+"""Thin HTTP front-end over :class:`ModelServer` — stdlib-only
+(``http.server``), because the serving robustness lives in the server/
+batcher layers, not the transport.
+
+Routes:
+  * ``GET  /healthz``  — liveness (200 while the process is worth
+    keeping, 503 once drained/crashed);
+  * ``GET  /readyz``   — readiness (200 only when every model is
+    compiled + warm and queues are below the shed watermark; body is
+    the JSON condition report);
+  * ``GET  /metrics``  — the diagnostics registry's Prometheus text
+    exposition (p50/p99 gauges included);
+  * ``POST /v1/models/<name>:predict`` — body
+    ``{"instances": [[...], ...], "deadline_ms": 250}``; responds
+    ``{"predictions": ...}``.
+
+Status mapping is the load-shedding contract made visible: 429 +
+``Retry-After`` for a shed (queue_full), 503 + ``Retry-After`` for an
+open breaker or draining, 504 for an expired deadline, 400/404 for
+caller errors.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from .errors import DeadlineExceeded, ExecutorFailure, Rejected
+
+__all__ = ["HttpFrontend", "REASON_STATUS"]
+
+_log = logging.getLogger(__name__)
+
+#: Rejected.reason -> HTTP status
+REASON_STATUS = {
+    "queue_full": 429, "breaker_open": 503, "draining": 503,
+    "too_large": 413, "unknown_model": 404, "bad_input": 400,
+    "deadline": 504,
+}
+
+
+def _jsonable(tree):
+    """Result pytree -> JSON (bf16 arrays included)."""
+    import numpy as np
+
+    if isinstance(tree, (list, tuple)):
+        return [_jsonable(t) for t in tree]
+    if isinstance(tree, dict):
+        return {k: _jsonable(v) for k, v in tree.items()}
+    arr = np.asarray(tree)
+    if arr.dtype.kind in "fc" or str(arr.dtype) == "bfloat16":
+        return arr.astype("float64").tolist()
+    return arr.tolist()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxnet-tpu-serving/1.0"
+
+    # the ModelServer rides on the HTTPServer instance
+    @property
+    def _srv(self):
+        return self.server.model_server
+
+    def log_message(self, fmt, *args):  # quiet: metrics, not stdout
+        _log.debug("http: " + fmt, *args)
+
+    def _reply(self, status: int, payload: dict,
+               retry_after: Optional[float] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            # RFC 7231: delta-seconds is an integer — round UP so a
+            # conformant client never retries before capacity frees
+            self.send_header("Retry-After",
+                             "%d" % max(1, int(-(-retry_after // 1))))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            ok = self._srv.live()
+            self._reply(200 if ok else 503, {"live": ok})
+        elif self.path == "/readyz":
+            rep = self._srv.ready()
+            self._reply(200 if rep["ready"] else 503, rep)
+        elif self.path == "/metrics":
+            from .. import diagnostics as _diag
+
+            body = _diag.metrics.to_prom().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/stats":
+            self._reply(200, self._srv.stats())
+        else:
+            self._reply(404, {"error": "no route %r" % self.path})
+
+    def do_POST(self):
+        model = self._route_model()
+        if model is None:
+            self._reply(404, {"error": "no route %r" % self.path})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object, got %s"
+                                 % type(payload).__name__)
+            instances = payload["instances"]
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(400, {"error": "bad request body: %r" % e})
+            return
+        deadline_ms = payload.get("deadline_ms", "default")
+        try:
+            result = self._srv.predict(model, instances,
+                                       deadline_ms=deadline_ms)
+            self._reply(200, {"predictions": _jsonable(result)})
+        except Rejected as e:
+            self._reply(REASON_STATUS.get(e.reason, 503),
+                        {"error": str(e), "reason": e.reason},
+                        retry_after=e.retry_after_s)
+        except DeadlineExceeded as e:
+            self._reply(504, {"error": str(e), "reason": "deadline"})
+        except ExecutorFailure as e:
+            self._reply(500, {"error": str(e), "reason": "executor"})
+        except Exception as e:  # transport must outlive any request
+            _log.exception("http: predict failed")
+            self._reply(500, {"error": repr(e)})
+
+    def _route_model(self) -> Optional[str]:
+        prefix = "/v1/models/"
+        if self.path.startswith(prefix) and \
+                self.path.endswith(":predict"):
+            return self.path[len(prefix):-len(":predict")] or None
+        return None
+
+
+class HttpFrontend:
+    """Owns the ThreadingHTTPServer; ``start()`` binds (port 0 picks a
+    free port — tests), ``stop()`` shuts the listener down.  Draining
+    is the ModelServer's job; the listener just starts answering 503."""
+
+    def __init__(self, model_server, host: str = "127.0.0.1",
+                 port: Optional[int] = None):
+        from .. import env as _env
+
+        self.host = host
+        self.port = _env.get_int("MXNET_SERVE_PORT") if port is None \
+            else int(port)
+        self._model_server = model_server
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> Tuple[str, int]:
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          _Handler)
+        self._httpd.model_server = self._model_server
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="mx-serve-http")
+        self._thread.start()
+        _log.info("serving: http front-end on %s:%d", self.host,
+                  self.port)
+        return self.host, self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,596 @@
+"""ModelServer — per-model queues, dispatch workers, and the
+robustness layer (admission control, deadline propagation, circuit
+breaker, graceful drain, probes).
+
+Degradation contract under overload (what the chaos e2e proves):
+admitted requests keep a bounded p99 because the queue is bounded and
+expired work is dropped before dispatch; EXCESS traffic is shed with
+``Rejected(queue_full)`` + retry-after, counted in
+``mxnet_serve_rejected_total{reason=...}``.  A model whose executor
+fails ``MXNET_SERVE_BREAKER_N`` consecutive times trips its circuit
+breaker: submits fast-fail (reason=breaker_open) and the already-
+queued doomed work is failed immediately rather than timed out one
+batch at a time; after ``MXNET_SERVE_BREAKER_RESET_S`` one half-open
+probe batch decides re-close vs re-open.
+
+SIGTERM drain reuses the fault-tolerance plumbing from PR 7: the
+server registers a ``diagnostics.register_preemption_hook`` that stops
+admission, flushes every queued + in-flight batch within
+``MXNET_SERVE_DRAIN_S``, and lets the shared handler exit with the
+documented code 83 (EXIT_PREEMPTED — for serving: drained, zero
+admitted requests lost; see the README exit-code table).
+
+Probes are DISTINCT, as orchestrators require: ``live()`` is "the
+process is worth keeping" (workers haven't crashed, not drained);
+``ready()`` is "send traffic here now" (every model compiled + warm,
+queues below the shed watermark, not draining).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .batching import Request, RequestQueue
+from .errors import DeadlineExceeded, ExecutorFailure, Rejected
+
+__all__ = ["CircuitBreaker", "ModelServer"]
+
+_log = logging.getLogger(__name__)
+
+#: ready() flips false once any queue passes this fraction of its bound
+READY_WATERMARK = 0.8
+
+
+class CircuitBreaker:
+    """Per-model consecutive-failure breaker: ``closed`` (healthy) ->
+    ``open`` after N consecutive executor failures (submits fast-fail)
+    -> ``half_open`` after the reset window (ONE probe batch through;
+    success closes, failure re-opens)."""
+
+    def __init__(self, n_failures: int, reset_s: float):
+        self.n_failures = int(n_failures)
+        self.reset_s = float(reset_s)
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._opened_ts: Optional[float] = None
+        self._probing = False
+        self._probe_ts = 0.0
+
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_ts is None:
+                return "closed"
+            if self._probing:
+                return "half_open"
+            if time.monotonic() - self._opened_ts >= self.reset_s:
+                return "half_open"
+            return "open"
+
+    def admit(self) -> bool:
+        """May new work enter the queue?  closed: yes.  open: no.
+        half-open: one probe's worth (the first admit after the reset
+        window) — concurrent submits keep fast-failing until the probe
+        decides.  A probe that vanished without a verdict (shed at
+        offer, expired in the queue) must not wedge the breaker open
+        forever: the reservation itself times out after reset_s and a
+        new probe is allowed."""
+        with self._lock:
+            if self._opened_ts is None:
+                return True
+            now = time.monotonic()
+            if self._probing:
+                if now - self._probe_ts >= self.reset_s:
+                    self._probe_ts = now  # lost probe: allow another
+                    return True
+                return False
+            if now - self._opened_ts >= self.reset_s:
+                self._probing = True
+                self._probe_ts = now
+                return True
+            return False
+
+    def abort_probe(self) -> None:
+        """The admitted probe never made it into the queue (offer
+        shed it) — release the reservation so the next submit can
+        probe immediately instead of waiting out the reservation
+        timeout."""
+        with self._lock:
+            self._probing = False
+
+    def retry_after_s(self) -> Optional[float]:
+        with self._lock:
+            if self._opened_ts is None:
+                return None
+            return max(self.reset_s -
+                       (time.monotonic() - self._opened_ts), 0.0)
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._opened_ts = None
+            self._probing = False
+
+    def on_failure(self) -> bool:
+        """Returns True when this failure TRIPPED the breaker (closed
+        -> open transition, or a failed half-open probe re-opening)."""
+        with self._lock:
+            self._consecutive += 1
+            if self._probing or (self.n_failures > 0
+                                 and self._consecutive >= self.n_failures
+                                 and self._opened_ts is None):
+                # closed -> open, or a failed half-open probe re-opening
+                self._opened_ts = time.monotonic()
+                self._probing = False
+                return True
+            return False
+
+
+class _ServedModel:
+    """One model's runtime + queue + worker + breaker + throughput
+    estimate (the retry-after hint)."""
+
+    def __init__(self, runtime, queue_max: int, breaker_n: int,
+                 breaker_reset_s: float, on_expired):
+        self.runtime = runtime
+        self.queue = RequestQueue(queue_max, on_expired=on_expired)
+        self.breaker = CircuitBreaker(breaker_n, breaker_reset_s)
+        self.worker: Optional[threading.Thread] = None
+        self.inflight = 0          # samples taken off-queue, not done
+        self.ewma_batch_s = 0.05   # batch latency estimate (retry-after)
+        self.completed = 0
+        self.failed = 0
+        self._lock = threading.Lock()
+
+
+class ModelServer:
+    """The batching model server.  In-process API: :meth:`submit` (a
+    Request future) / :meth:`predict` (blocking); the HTTP front-end
+    (serving/http.py) is a thin adapter over the same calls."""
+
+    def __init__(self, *, queue_max: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 batch_deadline_ms: Optional[float] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 drain_s: Optional[float] = None,
+                 breaker_n: Optional[int] = None,
+                 breaker_reset_s: Optional[float] = None):
+        from .. import env as _env
+
+        def knob(v, name, get=_env.get_float):
+            return get(name) if v is None else v
+
+        self.queue_max = int(knob(queue_max, "MXNET_SERVE_QUEUE_MAX",
+                                  _env.get_int))
+        self.max_batch = int(knob(max_batch, "MXNET_SERVE_MAX_BATCH",
+                                  _env.get_int))
+        self.batch_deadline_s = float(
+            knob(batch_deadline_ms, "MXNET_SERVE_BATCH_DEADLINE_MS")) / 1e3
+        self.default_deadline_s = float(
+            knob(default_deadline_ms, "MXNET_SERVE_DEADLINE_MS")) / 1e3
+        self.drain_timeout_s = float(knob(drain_s, "MXNET_SERVE_DRAIN_S"))
+        self._breaker_n = int(knob(breaker_n, "MXNET_SERVE_BREAKER_N",
+                                   _env.get_int))
+        self._breaker_reset_s = float(
+            knob(breaker_reset_s, "MXNET_SERVE_BREAKER_RESET_S"))
+        self._models: Dict[str, _ServedModel] = {}
+        # reentrant: the SIGTERM preemption hook runs drain() inside a
+        # signal handler ON the main thread, which may be interrupted
+        # while holding this lock in submit()/_get()/stats() — the same
+        # self-deadlock class diagnostics' _preempt_lock was converted
+        # to RLock for.  (Queue Conditions are reentrant by default.)
+        self._lock = threading.RLock()
+        self._draining = False
+        self._drained = False
+        self._hook_key: Optional[Any] = None
+
+    # -- model lifecycle ----------------------------------------------
+    def add_model(self, runtime, warmup: bool = True) -> None:
+        """Register + AOT-compile a model and start its dispatch
+        worker.  The server only reports ready() once every added
+        model compiled."""
+        if runtime.name in self._models:
+            raise ValueError("model %r already served" % runtime.name)
+        sm = _ServedModel(runtime, self.queue_max, self._breaker_n,
+                          self._breaker_reset_s,
+                          on_expired=lambda r: self._count_outcome(
+                              runtime.name, "expired"))
+        if hasattr(runtime, "compile") and not runtime.compiled:
+            runtime.compile(warmup=warmup)
+        sm.worker = threading.Thread(
+            target=self._worker_loop, args=(sm,), daemon=True,
+            name="mx-serve-%s" % runtime.name)
+        with self._lock:
+            self._models[runtime.name] = sm
+        sm.worker.start()
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def _get(self, model: str) -> _ServedModel:
+        with self._lock:
+            sm = self._models.get(model)
+        if sm is None:
+            self._count_rejected("unknown_model")
+            raise Rejected("unknown_model", "no model %r (serving: %s)"
+                           % (model, self.models()))
+        return sm
+
+    # -- submission ----------------------------------------------------
+    def submit(self, model: str, data, *,
+               deadline_ms: Any = "default",
+               request_id: Optional[str] = None) -> Request:
+        """Admit one request (``data``: one sample of the model's
+        sample shape, or a ``(n, *sample_shape)`` mini-batch) or shed
+        it by raising :class:`Rejected`.  Returns the Request future;
+        ``wait()`` it for the result."""
+        import numpy as np
+
+        sm = self._get(model)
+        if self._draining:
+            self._count_rejected("draining")
+            raise Rejected("draining", "server is draining")
+        arr = np.asarray(data)
+        if arr.shape == tuple(sm.runtime.sample_shape):
+            arr = arr[None]  # single sample convenience
+        if arr.shape[1:] != tuple(sm.runtime.sample_shape):
+            self._count_rejected("bad_input")
+            raise Rejected("bad_input",
+                           "expected sample shape %s, got %s"
+                           % (sm.runtime.sample_shape, arr.shape[1:]))
+        n = int(arr.shape[0])
+        max_n = min(self.max_batch, sm.runtime.max_batch)
+        if n > max_n:
+            self._count_rejected("too_large")
+            raise Rejected("too_large",
+                           "%d samples > max batch %d" % (n, max_n))
+        if not sm.breaker.admit():
+            self._count_rejected("breaker_open")
+            raise Rejected(
+                "breaker_open",
+                "model %r breaker is open after consecutive executor "
+                "failures" % model,
+                retry_after_s=sm.breaker.retry_after_s())
+        deadline_s = self.default_deadline_s \
+            if deadline_ms == "default" else (
+                None if deadline_ms is None else float(deadline_ms) / 1e3)
+        req = Request(model, arr, n, deadline_s=deadline_s,
+                      request_id=request_id)
+        try:
+            sm.queue.offer(req, retry_after_s=self._retry_after(sm))
+        except Rejected as e:
+            # if this submit was the half-open probe, release the
+            # reservation — a shed probe must not wedge the breaker
+            sm.breaker.abort_probe()
+            self._count_rejected(e.reason)
+            raise
+        self._gauge_depth(sm)
+        return req
+
+    def predict(self, model: str, data, *, deadline_ms: Any = "default",
+                timeout_s: Optional[float] = None):
+        """submit + wait.  The default wait bound is the request's own
+        deadline plus one batch-latency of slack."""
+        req = self.submit(model, data, deadline_ms=deadline_ms)
+        if timeout_s is None:
+            sm = self._get(model)
+            slack = max(sm.ewma_batch_s * 4, 1.0)
+            timeout_s = slack if req.deadline_ts is None else \
+                (req.deadline_ts - time.monotonic()) + slack
+        return req.wait(timeout_s)
+
+    def _retry_after(self, sm: _ServedModel) -> float:
+        """Shed hint: how long until a full queue's worth of work
+        drains at the current batch rate."""
+        batches_queued = max(sm.queue.depth() / max(self.max_batch, 1),
+                             1.0)
+        return round(batches_queued * max(sm.ewma_batch_s, 1e-3), 3)
+
+    # -- dispatch worker ----------------------------------------------
+    def _worker_loop(self, sm: _ServedModel) -> None:
+        from .. import chaos as _chaos
+
+        while True:
+            batch = sm.queue.take_batch(
+                min(self.max_batch, sm.runtime.max_batch),
+                self.batch_deadline_s)
+            self._gauge_depth(sm)
+            if batch is None:
+                return  # drained: queue closed and empty
+            if not batch:
+                continue
+            # final deadline gate: expired co-riders are rejected HERE,
+            # before dispatch — an expired request is never executed
+            now = time.monotonic()
+            live = []
+            for r in batch:
+                if r.expired(now):
+                    r.set_error(DeadlineExceeded(
+                        "request %s: deadline expired at dispatch"
+                        % r.id))
+                    self._count_outcome(sm.runtime.name, "expired")
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            if _chaos.enabled():
+                # chaos 'slow_request': the seeded slow executor the
+                # overload test bounds — injected at the dispatch point
+                # so queue-depth/deadline behavior is what's exercised
+                _chaos.maybe_slow_request(sm.runtime.name)
+            self._dispatch(sm, live)
+
+    def _dispatch(self, sm: _ServedModel, live: List[Request]) -> None:
+        import numpy as np
+
+        name = sm.runtime.name
+        total = sum(r.n for r in live)
+        with sm._lock:
+            sm.inflight += total
+        self._gauge_inflight(sm)
+        t0 = time.monotonic()
+        try:
+            data = live[0].data if len(live) == 1 else \
+                np.concatenate([r.data for r in live], axis=0)
+            out = sm.runtime.execute(data)
+            batch_s = time.monotonic() - t0
+            self._split_results(live, out)
+            sm.ewma_batch_s = 0.8 * sm.ewma_batch_s + 0.2 * batch_s
+            sm.breaker.on_success()
+            with sm._lock:
+                sm.completed += len(live)
+            self._observe_batch(sm, live, total, batch_s)
+        except Exception as e:
+            err = e if isinstance(e, ExecutorFailure) else \
+                ExecutorFailure("dispatch for %r failed: %r"
+                                % (name, e))
+            for r in live:
+                r.set_error(err)
+                self._count_outcome(name, "error")
+            with sm._lock:
+                sm.failed += len(live)
+            tripped = sm.breaker.on_failure()
+            _log.warning("serving: batch of %d for %r failed: %r",
+                         len(live), name, e)
+            if tripped:
+                self._on_breaker_trip(sm)
+        finally:
+            with sm._lock:
+                sm.inflight -= total
+            self._gauge_inflight(sm)
+
+    def _split_results(self, live: List[Request], out) -> None:
+        """Slice the batch output tree back into per-request results
+        (row ranges in ride order)."""
+        import jax
+
+        off = 0
+        for r in live:
+            lo, hi = off, off + r.n
+            r.set_result(jax.tree_util.tree_map(
+                lambda a: a[lo:hi], out))
+            off = hi
+            self._count_outcome(r.model, "ok")
+            self._observe_latency(r)
+
+    def _on_breaker_trip(self, sm: _ServedModel) -> None:
+        """Fast-fail the queued doomed work and flag the gauge — the
+        fleet's scrapers see the trip, and callers get answers NOW
+        instead of deadline timeouts one batch at a time."""
+        name = sm.runtime.name
+        _log.error(
+            "serving: circuit breaker OPEN for %r after %d consecutive "
+            "executor failures — fast-failing queued work, half-open "
+            "probe in %.1fs", name, sm.breaker.n_failures,
+            sm.breaker.reset_s)
+        failed = sm.queue.fail_all(lambda r: Rejected(
+            "breaker_open", "model %r breaker tripped while request "
+            "was queued" % name,
+            retry_after_s=sm.breaker.retry_after_s()))
+        for _ in failed:
+            self._count_rejected("breaker_open")
+        self._gauge_breaker(sm)
+        self._gauge_depth(sm)
+
+    # -- drain + probes -----------------------------------------------
+    def drain(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Graceful drain: stop admitting (submits shed with
+        reason=draining), flush every queued + in-flight batch, join
+        workers.  Returns {drained, completed, failed, left} —
+        ``left`` MUST be 0 on a clean drain (no admitted request is
+        ever lost)."""
+        timeout = self.drain_timeout_s if timeout_s is None \
+            else float(timeout_s)
+        self._draining = True
+        with self._lock:
+            models = list(self._models.values())
+        for sm in models:
+            sm.queue.close()
+        deadline = time.monotonic() + timeout
+        for sm in models:
+            remaining = max(deadline - time.monotonic(), 0.0)
+            if sm.worker is not None:
+                sm.worker.join(remaining)
+        left = sum(sm.queue.depth() + sm.inflight for sm in models)
+        report = {
+            "drained": all(sm.worker is None or not sm.worker.is_alive()
+                           for sm in models) and left == 0,
+            "completed": sum(sm.completed for sm in models),
+            "failed": sum(sm.failed for sm in models),
+            "left": left,
+        }
+        self._drained = True
+        _log.info("serving: drain %s — %d completed, %d failed, %d "
+                  "left", "complete" if report["drained"] else
+                  "TIMED OUT", report["completed"], report["failed"],
+                  left)
+        return report
+
+    def install_preemption_hook(self) -> Any:
+        """SIGTERM -> (shared handler: dump flight ring, drain
+        collectives) -> THIS hook drains the server -> exit 83.  The
+        same plumbing Module.fit uses to checkpoint; for serving,
+        "checkpoint" is "answer everything you admitted"."""
+        from .. import diagnostics as _diag
+
+        if self._hook_key is None:
+            self._hook_key = _diag.register_preemption_hook(
+                lambda: self.drain(), key="mx-serve-drain-%d" % id(self))
+        return self._hook_key
+
+    def uninstall_preemption_hook(self) -> None:
+        from .. import diagnostics as _diag
+
+        if self._hook_key is not None:
+            _diag.unregister_preemption_hook(self._hook_key)
+            self._hook_key = None
+
+    def live(self) -> bool:
+        """Liveness: the process is worth keeping — workers healthy (or
+        never started), not yet drained.  After drain() this goes
+        false so an orchestrator recycles the pod."""
+        if self._drained:
+            return False
+        with self._lock:
+            models = list(self._models.values())
+        return all(sm.worker is None or sm.worker.is_alive()
+                   for sm in models)
+
+    def ready(self) -> Dict[str, Any]:
+        """Readiness: send traffic here NOW — every model compiled,
+        every queue below the shed watermark, not draining.  Returns a
+        dict with ``ready`` plus the failing conditions (the HTTP probe
+        body)."""
+        with self._lock:
+            models = dict(self._models)
+        not_compiled = [n for n, sm in models.items()
+                        if not sm.runtime.compiled]
+        watermark = int(self.queue_max * READY_WATERMARK)
+        congested = {n: sm.queue.depth() for n, sm in models.items()
+                     if sm.queue.depth() >= watermark}
+        breakers = {n: sm.breaker.state() for n, sm in models.items()
+                    if sm.breaker.state() != "closed"}
+        return {
+            "ready": (not self._draining and not not_compiled
+                      and not congested and bool(models)),
+            "draining": self._draining,
+            "models": sorted(models),
+            "not_compiled": not_compiled,
+            "congested": congested,
+            "breakers_open": breakers,
+            "queue_watermark": watermark,
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            models = dict(self._models)
+        return {name: {
+            "queue_depth": sm.queue.depth(),
+            "inflight": sm.inflight,
+            "completed": sm.completed,
+            "failed": sm.failed,
+            "breaker": sm.breaker.state(),
+            "ewma_batch_ms": round(sm.ewma_batch_s * 1e3, 3),
+            "buckets": list(getattr(sm.runtime, "plan", ())),
+            "compiled": sm.runtime.compiled,
+        } for name, sm in models.items()}
+
+    # -- metrics feeds (all guarded: telemetry never fails serving) ----
+    def _count_rejected(self, reason: str) -> None:
+        try:
+            from .. import diagnostics as _diag
+
+            _diag.metrics.counter(
+                "mxnet_serve_rejected_total",
+                help="requests shed before admission or fast-failed",
+                labels={"reason": reason}).inc()
+        except Exception:
+            pass
+
+    def _count_outcome(self, model: str, outcome: str) -> None:
+        try:
+            from .. import diagnostics as _diag
+
+            _diag.metrics.counter(
+                "mxnet_serve_requests_total",
+                help="admitted requests by final outcome",
+                labels={"model": model, "outcome": outcome}).inc()
+        except Exception:
+            pass
+
+    def _observe_latency(self, r: Request) -> None:
+        try:
+            from .. import diagnostics as _diag
+
+            lat = r.latency_s()
+            if lat is not None:
+                _diag.metrics.histogram(
+                    "mxnet_serve_latency_seconds",
+                    help="admitted-request latency (enqueue to reply)",
+                    labels={"model": r.model}).observe(lat)
+        except Exception:
+            pass
+
+    def _observe_batch(self, sm: _ServedModel, live: List[Request],
+                       total: int, batch_s: float) -> None:
+        try:
+            from .. import diagnostics as _diag
+
+            name = sm.runtime.name
+            bucket = sm.runtime.bucket_for(total) \
+                if hasattr(sm.runtime, "bucket_for") else total
+            _diag.metrics.counter(
+                "mxnet_serve_batches_total",
+                help="dispatched batches", labels={"model": name}).inc()
+            _diag.metrics.histogram(
+                "mxnet_serve_batch_size",
+                help="samples per dispatched batch",
+                labels={"model": name},
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)).observe(total)
+            _diag.metrics.counter(
+                "mxnet_serve_padded_samples_total",
+                help="bucket padding waste (samples)",
+                labels={"model": name}).inc(max(bucket - total, 0))
+            _diag.metrics.histogram(
+                "mxnet_serve_batch_seconds",
+                help="executor wall time per batch",
+                labels={"model": name}).observe(batch_s)
+            _diag.metrics.maybe_flush()
+        except Exception:
+            pass
+
+    def _gauge_depth(self, sm: _ServedModel) -> None:
+        try:
+            from .. import diagnostics as _diag
+
+            _diag.metrics.gauge(
+                "mxnet_serve_queue_depth",
+                help="admitted requests waiting to be batched",
+                labels={"model": sm.runtime.name}).set(sm.queue.depth())
+        except Exception:
+            pass
+
+    def _gauge_inflight(self, sm: _ServedModel) -> None:
+        try:
+            from .. import diagnostics as _diag
+
+            _diag.metrics.gauge(
+                "mxnet_serve_inflight_samples",
+                help="samples dispatched, not yet answered",
+                labels={"model": sm.runtime.name}).set(sm.inflight)
+        except Exception:
+            pass
+
+    def _gauge_breaker(self, sm: _ServedModel) -> None:
+        try:
+            from .. import diagnostics as _diag
+
+            _diag.metrics.gauge(
+                "mxnet_serve_breaker_open",
+                help="1 while the model's circuit breaker is open",
+                labels={"model": sm.runtime.name}).set(
+                    0 if sm.breaker.state() == "closed" else 1)
+        except Exception:
+            pass

@@ -1,0 +1,53 @@
+"""Subprocess worker for the serving SIGTERM-drain test.
+
+Serves the demo model (optionally slowed by MXNET_CHAOS slow_request
+from the parent), keeps submitting requests from the main thread, and
+registers a preemption hook that drains the server and writes an
+accounting JSON.  The parent SIGTERMs it mid-load and asserts:
+
+  * exit code 83 (EXIT_PREEMPTED — the shared handler's contract);
+  * the report says drained with zero admitted requests left;
+  * every admitted request completed before exit (none hung/lost).
+
+Usage: python serve_worker.py <report.json>
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+from mxnet_tpu import diagnostics as diag
+from mxnet_tpu import serving
+
+
+def main() -> int:
+    report_path = sys.argv[1]
+    rt = serving.demo_runtime(max_batch=8)
+    srv = serving.ModelServer(max_batch=8, queue_max=64,
+                              batch_deadline_ms=2,
+                              default_deadline_ms=30_000)
+    srv.add_model(rt)
+    admitted = []
+
+    def hook():
+        rep = srv.drain()
+        done = sum(1 for r in admitted if r.done())
+        ok = sum(1 for r in admitted if r.done() and r.error is None)
+        with open(report_path, "w") as f:
+            json.dump({"drain": rep, "admitted": len(admitted),
+                       "done": done, "ok": ok}, f)
+
+    diag.register_preemption_hook(hook, key="serve-worker-accounting")
+    x = np.zeros((1, 16), dtype="float32")
+    print("READY", flush=True)
+    while True:  # parent SIGTERMs us out of this loop
+        try:
+            admitted.append(srv.submit("demo", x))
+        except serving.Rejected:
+            pass
+        time.sleep(0.002)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -357,6 +357,51 @@ def test_metrics_histogram_percentile():
     assert h.count == 100
 
 
+def test_metrics_histogram_quantile_interpolates():
+    """quantile() is the interpolated (prometheus histogram_quantile)
+    variant percentile()'s coarse upper bound keeps its old contract
+    next to: values land INSIDE the containing bucket."""
+    h = diag.Histogram("t_q")
+    for _ in range(99):
+        h.observe(0.004)
+    h.observe(5.0)
+    q50 = h.quantile(0.5)
+    assert 0.0025 < q50 < 0.005  # inside (0.0025, 0.005], not the bound
+    assert h.quantile(0.99) <= 0.005
+    # +Inf bucket clamps to the top finite bound instead of inventing
+    h2 = diag.Histogram("t_q2", buckets=(1.0, 2.0))
+    h2.observe(50.0)
+    assert h2.quantile(0.99) == 2.0
+    assert diag.Histogram("t_q3").quantile(0.5) is None
+
+
+def test_to_prom_derives_p50_p99_gauges():
+    """The serving-SLO satellite: every histogram exports derived
+    ``_p50``/``_p99`` gauge families (typed, labeled, grouped) and the
+    whole exposition still validates."""
+    reg = diag.MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", help="latency",
+                      labels={"model": "m1"})
+    for v in (0.004, 0.009, 0.02, 0.02, 3.0):
+        h.observe(v)
+    reg.histogram("t_lat_seconds", labels={"model": "m2"}).observe(0.5)
+    text = reg.to_prom()
+    assert not diag.validate_prom_text(text), \
+        diag.validate_prom_text(text)
+    assert "# TYPE t_lat_seconds_p50 gauge" in text
+    assert "# TYPE t_lat_seconds_p99 gauge" in text
+    assert 't_lat_seconds_p50{model="m1"}' in text
+    assert 't_lat_seconds_p50{model="m2"}' in text
+    assert 't_lat_seconds_p99{model="m1"}' in text
+    # families stay grouped: both p50 samples precede the p99 header
+    assert text.index('t_lat_seconds_p50{model="m2"}') < \
+        text.index("# TYPE t_lat_seconds_p99")
+    # an empty histogram derives nothing (no NaN gauges)
+    reg2 = diag.MetricsRegistry()
+    reg2.histogram("t_empty_seconds")
+    assert "_p50" not in reg2.to_prom()
+
+
 def test_metrics_dump_json_and_flush(tmp_path):
     reg = diag.MetricsRegistry()
     reg.gauge("t_flush_gauge").set(7)

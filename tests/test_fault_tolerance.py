@@ -137,6 +137,122 @@ def test_checkpoint_async_writer(tmp_path):
     np.testing.assert_array_equal(mgr.load()["params"]["w"], 0)
 
 
+def test_load_checkpoint_names_missing_ranks(tmp_path):
+    """The serving satellite: a failed load must say exactly WHICH
+    ranks' shards are missing, not just 'file not found' — server
+    startup has to explain why a model won't load."""
+    d = str(tmp_path / "partial")
+    m0 = ckpt.CheckpointManager(d, async_write=False, rank=0,
+                                num_ranks=4)
+    m2 = ckpt.CheckpointManager(d, async_write=False, rank=2,
+                                num_ranks=4)
+    m0.save(9, params={})
+    m2.save(9, params={})  # ranks 1 and 3 died before writing
+    # newest-complete path: no step is complete, error names the gaps
+    with pytest.raises(FileNotFoundError) as ei:
+        ckpt.load_checkpoint(d, num_ranks=4, rank=0)
+    msg = str(ei.value)
+    assert "rank(s) [1, 3]" in msg and "of 4" in msg, msg
+    assert "present: [0, 2]" in msg, msg
+    # explicit-step path: same naming when the requested shard is gone
+    with pytest.raises(FileNotFoundError) as ei:
+        ckpt.load_checkpoint(d, step=9, rank=3, num_ranks=4)
+    msg = str(ei.value)
+    assert "step 9" in msg and "rank(s) [1, 3]" in msg, msg
+    assert ckpt.missing_ranks(d, 9, 4) == [1, 3]
+    # an empty directory reports that there is nothing at all
+    with pytest.raises(FileNotFoundError, match="no step_"):
+        ckpt.load_checkpoint(str(tmp_path / "void"), rank=0,
+                             num_ranks=1)
+
+
+def test_ckpt_write_retries_when_janitor_removes_dir(tmp_path,
+                                                     monkeypatch):
+    """Deterministic half of the GC-vs-writer race satellite: the
+    janitor rmdir's a step between the writer's makedirs and its
+    os.replace — the write must retry once and land the shard instead
+    of surfacing a spurious writer error."""
+    import shutil
+
+    d = str(tmp_path / "retry")
+    mgr = ckpt.CheckpointManager(d, keep=0, async_write=False, rank=0,
+                                 num_ranks=1)
+    real_replace = os.replace
+    struck = {"n": 0}
+
+    def janitor_strikes_once(src, dst):
+        if dst.endswith("rank0.ckpt") and struck["n"] == 0:
+            struck["n"] = 1
+            shutil.rmtree(os.path.dirname(dst))
+            raise FileNotFoundError(dst)
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", janitor_strikes_once)
+    mgr.save(3, params={"w": np.ones(4, "f4")}, blocking=True)
+    monkeypatch.undo()
+    assert struck["n"] == 1  # the race actually fired
+    assert ckpt.latest_step(d, num_ranks=1) == 3
+    loaded = ckpt.load_checkpoint(d, step=3, rank=0, num_ranks=1)
+    np.testing.assert_array_equal(loaded["params"]["w"], 1)
+
+
+def test_ckpt_gc_janitor_vs_async_writer_stress(tmp_path):
+    """Stress half of the race satellite: rank 0's retention janitor
+    (keep=1) GCs steps WHILE both ranks' async writers stream shards
+    and a reader polls.  Invariants: latest_step never names a step a
+    reader can't load (unless GC legitimately advanced past it), no
+    torn/corrupt shard is ever read, and the writers surface no
+    errors."""
+    d = str(tmp_path / "race")
+    m0 = ckpt.CheckpointManager(d, keep=1, async_write=True, rank=0,
+                                num_ranks=2)
+    m1 = ckpt.CheckpointManager(d, keep=1, async_write=True, rank=1,
+                                num_ranks=2)
+    params = {"w": np.arange(256, dtype="f4")}
+    stop = threading.Event()
+    problems = []
+
+    def reader():
+        while not stop.is_set():
+            s = ckpt.latest_step(d, num_ranks=2)
+            if s is None:
+                time.sleep(0.001)
+                continue
+            try:
+                for r in (0, 1):
+                    payload = ckpt.load_checkpoint(d, step=s, rank=r,
+                                                   num_ranks=2)
+                    if payload["step"] != s:
+                        problems.append("step %d shard says %r"
+                                        % (s, payload["step"]))
+            except FileNotFoundError:
+                # only legitimate when the janitor moved PAST s: a
+                # half-deleted dir still reported by latest_step is
+                # exactly the bug this test exists to catch
+                s2 = ckpt.latest_step(d, num_ranks=2)
+                if s2 is None or s2 <= s:
+                    problems.append(
+                        "latest_step says %r but step %d unloadable"
+                        % (s2, s))
+            except Exception as e:  # torn pickle etc.
+                problems.append(repr(e))
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        for step in range(1, 26):
+            m1.save(step, params=params, blocking=False)
+            m0.save(step, params=params, blocking=False)
+        assert m0.wait(timeout=60)  # raises on any writer error
+        assert m1.wait(timeout=60)
+    finally:
+        stop.set()
+        t.join(10)
+    assert not problems, problems[:5]
+    # the retention window held: exactly the newest complete step left
+    assert ckpt.latest_step(d, num_ranks=2) == 25
+
+
 # ---------------------------------------------------------------------
 # exact resume (single process; the dist version is the e2e below)
 # ---------------------------------------------------------------------
