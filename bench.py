@@ -658,6 +658,7 @@ def bench_serving(slo_p99_ms=50.0):
     rep = serving.qps_at_slo(srv, "bench_serve", slo_p99_ms=slo_p99_ms,
                              start_qps=100.0, max_qps=20000.0,
                              window_s=1.0)
+    reload_rep = _bench_serving_reload(srv)
     srv.drain(timeout_s=10.0)
     return {
         "pipeline": "serving (dynamic batching, AOT bf16 buckets)",
@@ -668,8 +669,59 @@ def bench_serving(slo_p99_ms=50.0):
         "p99_ms_at_slo": rep["p99_ms_at_slo"],
         "batch_buckets": list(rt.plan),
         "compile_warmup_s": round(compile_s, 2),
+        "reload": reload_rep,
         "ramp": rep["ramp"],
     }
+
+
+def _bench_serving_reload(srv):
+    """The hot-swap row: reload a new model version from a checkpoint
+    WHILE open-loop load is flowing, and report swap latency, requests
+    in flight during the swap, and the zero-drop confirmation (every
+    request offered during the swap window was answered or accounted
+    as an admission shed — none hung, none errored)."""
+    import shutil
+    import tempfile
+
+    from mxnet_tpu import checkpoint as mckpt
+    from mxnet_tpu import serving
+
+    ckdir = tempfile.mkdtemp(prefix="bench-serve-reload-")
+    try:
+        mckpt.save_checkpoint(
+            ckdir, 1, params=serving.demo_params(dim=64, hidden=128,
+                                                 classes=16, seed=7))
+        bg = serving.BackgroundLoad(
+            srv, "bench_serve", qps=400.0, duration_s=4.0,
+            deadline_ms=4000).start()
+        time.sleep(0.5)  # load established before the swap begins
+        depth_at_swap = srv.stats()["bench_serve"]["queue_depth"]
+        inflight_at_swap = srv.stats()["bench_serve"]["inflight"]
+        t0 = time.time()
+        state = srv.reload("bench_serve", ckdir, wait_s=30.0)
+        swap_s = time.time() - t0
+        acct = bg.join(30.0) or {}
+        zero_drop = (acct.get("hung", 1) == 0
+                     and acct.get("errors", 1) == 0
+                     and acct.get("rejected_after_admit", 1) == 0)
+        return {
+            "state": state.get("state"),
+            "from_version": state.get("from_version"),
+            "to_version": state.get("to_version"),
+            "swap_latency_s": round(swap_s, 3),
+            "queue_depth_at_swap": depth_at_swap,
+            "inflight_at_swap": inflight_at_swap,
+            "requests_during_swap": {
+                k: acct.get(k) for k in
+                ("offered", "admitted", "ok", "expired", "errors",
+                 "hung", "shed_total")},
+            "zero_drop": bool(zero_drop),
+            "canary_stats": state.get("canary_stats"),
+        }
+    except Exception as exc:  # the bench row must not die on a swap bug
+        return {"error": repr(exc)}
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
 
 
 def _sym_resnet50(num_classes=1000):

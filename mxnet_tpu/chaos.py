@@ -40,6 +40,17 @@ hold; ``nth`` skips the first nth-1 candidate events.  Kinds:
     ``execute()`` for the matching model — consecutive failures must
     trip the per-model circuit breaker into fast-fail instead of
     queueing doomed work.  Match keys: ``model``, ``nth``, ``count``.
+  * ``corrupt_shard``  — flip bytes in a LANDED checkpoint shard right
+    after its true digest was recorded in the sidecar/manifest — the
+    bit-rot that ``MXNET_CKPT_VERIFY`` must catch, naming the exact
+    shard and falling back to the newest verified step.  Match keys:
+    ``rank``, ``step``, ``nth``, ``count``, ``nbytes`` (how many bytes
+    to flip, default 8).
+  * ``bad_version``    — the NEW model version brought up by
+    ``ModelServer.reload`` fails at its canary dispatch — what must
+    drive the auto-rollback with zero admitted requests dropped (the
+    failed canary batch re-executes on the stable version).  Match
+    keys: ``model``, ``version``, ``nth``, ``count``.
 
 Injected faults count into ``mxnet_chaos_injected_total{kind=...}``
 (diagnostics.metrics) so a test can assert the fault actually fired —
@@ -61,6 +72,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["Rule", "rules", "enabled", "fault", "should_kill",
            "maybe_slow_request", "should_fail_execute",
+           "maybe_corrupt_shard", "should_fail_version",
            "injected_total", "reset", "KILL_EXIT_CODE"]
 
 _log = logging.getLogger(__name__)
@@ -69,7 +81,7 @@ _log = logging.getLogger(__name__)
 #: worker reports through the launcher
 KILL_EXIT_CODE = 137
 
-_INT_KEYS = ("rank", "nth", "count", "step")
+_INT_KEYS = ("rank", "nth", "count", "step", "version", "nbytes")
 _FLOAT_KEYS = ("ms",)
 
 
@@ -90,7 +102,7 @@ class Rule:
         value (string-compared for non-numeric keys like ``key``/``op``;
         a context that omits the key does not match)."""
         for k, want in self.params.items():
-            if k in ("nth", "count", "ms", "mode"):
+            if k in ("nth", "count", "ms", "mode", "nbytes"):
                 continue
             if k not in ctx:
                 return False
@@ -275,6 +287,39 @@ def should_fail_execute(model: str, **ctx) -> bool:
     return fault("fail_execute", model=model, **ctx) is not None
 
 
+def maybe_corrupt_shard(path: str, step: int, **ctx) -> bool:
+    """corrupt_shard hook (checkpoint._write, AFTER the shard landed
+    and its true digest was recorded): flip ``nbytes`` bytes in the
+    middle of the file — the on-disk bit-rot the verify/fallback path
+    must catch and name.  Returns True when the fault fired."""
+    r = fault("corrupt_shard", step=step, **ctx)
+    if r is None:
+        return False
+    n = int(r.params.get("nbytes", 8))
+    try:
+        size = os.path.getsize(path)
+        off = max(size // 3, 0)
+        with open(path, "r+b") as f:
+            f.seek(off)
+            chunk = f.read(max(n, 1))
+            f.seek(off)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        _log.warning("chaos: corrupted %d byte(s) of %s at offset %d",
+                     len(chunk), path, off)
+        return True
+    except OSError:
+        return False
+
+
+def should_fail_version(model: str, version: int, **ctx) -> bool:
+    """bad_version hook (ModelServer canary dispatch): True when the
+    matching model's NEW version must fail its canary batch — what
+    drives the auto-rollback (the batch re-executes on the stable
+    version, so callers never see the failure)."""
+    return fault("bad_version", model=model, version=version,
+                 **ctx) is not None
+
+
 def injected_total(kind: Optional[str] = None) -> int:
     """Faults injected so far (per kind, or all kinds)."""
     total = 0
@@ -355,7 +400,42 @@ def _self_test() -> tuple:
         del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
         reset()
 
-    # 6) disabled == inert (and never raises)
+    # 6) the integrity/reload kinds: corrupt_shard flips bytes in the
+    # matching rank+step's landed file only; bad_version fires for the
+    # matching model/version with its count window
+    import tempfile
+
+    os.environ["MXNET_CHAOS"] = (  # mxlint: disable=MXL002
+        "corrupt_shard:rank=0,step=4,nbytes=4;"
+        "bad_version:model=rn50,version=2,count=2")
+    reset()
+    try:
+        with tempfile.NamedTemporaryFile(delete=False) as tf:
+            tf.write(b"x" * 64)
+            shard = tf.name
+        try:
+            checks["corrupt_wrong_step"] = not maybe_corrupt_shard(
+                shard, step=3, rank=0)
+            with open(shard, "rb") as f:
+                checks["corrupt_noop_intact"] = f.read() == b"x" * 64
+            checks["corrupt_fires"] = maybe_corrupt_shard(
+                shard, step=4, rank=0)
+            with open(shard, "rb") as f:
+                checks["corrupt_flipped_bytes"] = f.read() != b"x" * 64
+        finally:
+            os.unlink(shard)
+        checks["bad_version_wrong_version"] = \
+            not should_fail_version("rn50", version=1)
+        fires = [should_fail_version("rn50", version=2)
+                 for _ in range(3)]
+        checks["bad_version_count"] = fires == [True, True, False]
+        checks["bad_version_wrong_model"] = \
+            not should_fail_version("other", version=2)
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        reset()
+
+    # 7) disabled == inert (and never raises)
     checks["disabled_inert"] = not enabled() and \
         fault("kill", step=1) is None
 

@@ -1,4 +1,5 @@
-"""mx.checkpoint — elastic, atomic, per-rank-sharded training snapshots.
+"""mx.checkpoint — elastic, atomic, per-rank-sharded training snapshots
+with content integrity and deterministic resharded resume.
 
 The reference's fault story was built on the ps-lite layer: kvstore
 ``save_optimizer_states`` plus ``Module.save_checkpoint`` wrote params
@@ -18,6 +19,24 @@ exact-resume contract:
     step is *complete* only when every expected rank's shard exists, so
     a fleet that died unevenly resumes from the newest step ALL ranks
     reached.
+  * **Integrity** (the dmlc recordio heritage — magic + checksum
+    framing meant the original system never trusted bytes off disk):
+    every shard's sha256 + byte count is recorded in a per-step
+    ``MANIFEST.json`` (format version, world size, per-shard
+    {path, bytes, sha256}, param tree spec).  :func:`load_checkpoint`
+    verifies digests (``MXNET_CKPT_VERIFY``, default on), names the
+    EXACT corrupt shard, and — when asked for the newest step — falls
+    back to the newest *verified* step instead of crashing.  An
+    explicitly requested step fails fast on corruption, never silently
+    substitutes.  ``python -m mxnet_tpu.checkpoint --verify DIR``
+    audits a whole directory.
+  * **Elastic resume**: a checkpoint written by W ranks loads on a
+    W'-rank fleet.  W == W' keeps the bitwise exact-resume contract;
+    W != W' reshards deterministically through the manifest — rank r
+    reads source shard ``r % W`` (params/momenta are replicated per
+    shard by construction, see module.get_checkpoint_state), the
+    iterator position scales by the world-size ratio, and a loud
+    one-line provenance log records the reshard.
   * **Full state**: params, aux (BN moments), optimizer/momenta state
     (the local Updater's, or the gathered server shards on the dist
     kvstore path), RNG key state, epoch/step, and the data-iterator
@@ -31,32 +50,73 @@ exact-resume contract:
     pending writes; the SIGTERM preemption path calls it before
     exiting.
 
+Deletion barrier (the GC-vs-reader protocol): a verifying reader pins
+the step (``.reading-*`` marker) and checks the manifest first; the
+janitor checks for pins first, drops a ``.deleting`` tombstone before
+touching any file, re-checks pins, removes shards, and removes the
+manifest LAST.  A reader that races the janitor re-checks the
+tombstone on any failure: gone-mid-verify means *deleted*, never a
+spurious corruption report, and a pinned step is never deleted.
+
 ``Module.fit(checkpoint_every_n=, checkpoint_dir=, resume_from=)``
 drives this (module/base_module.py); knobs: ``MXNET_CKPT_DIR``,
 ``MXNET_CKPT_EVERY_N``, ``MXNET_CKPT_KEEP``, ``MXNET_CKPT_ASYNC``,
-``MXNET_CKPT_DRAIN_S`` (mxnet_tpu/env.py).
+``MXNET_CKPT_DRAIN_S``, ``MXNET_CKPT_VERIFY`` (mxnet_tpu/env.py).
 """
 from __future__ import annotations
 
+import contextlib
+import hashlib
+import itertools
+import json
 import logging
 import os
 import pickle
 import queue
 import re
+import sys
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
-    "FORMAT_VERSION", "CheckpointManager", "save_checkpoint",
+    "FORMAT_VERSION", "MANIFEST_VERSION", "MANIFEST_NAME",
+    "CheckpointCorrupt", "CheckpointManager", "save_checkpoint",
     "load_checkpoint", "latest_step", "list_steps", "step_dir",
-    "shard_path", "missing_ranks",
+    "shard_path", "manifest_path", "read_manifest", "missing_ranks",
+    "verify_step", "verify_dir", "scale_resume_skip", "main",
 ]
 
 _log = logging.getLogger(__name__)
 
 FORMAT_VERSION = 1
+MANIFEST_VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+
+#: janitor tombstone: present while a step is being deleted — readers
+#: treat the step as already gone, the janitor finishes it next round
+#: if interrupted
+TOMBSTONE_NAME = ".deleting"
+#: reader pin prefix: a fresh pin blocks the janitor from deleting the
+#: step a concurrent load/verify is reading
+_PIN_PREFIX = ".reading-"
+#: pins older than this are debris from a crashed reader, not a barrier
+PIN_STALE_S = 120.0
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+_pin_ids = itertools.count(1)
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A shard's bytes do not match its manifest digest (bit flip,
+    truncation, torn write that somehow survived the atomic-replace
+    contract).  The message names the exact shard(s)."""
+
+
+class _StepVanished(Exception):
+    """Internal: the step was garbage-collected while we were reading
+    it (tombstone appeared / manifest+shards gone).  The newest-step
+    walk treats this as 'keep looking', never as corruption."""
 
 
 def _rank_info() -> Tuple[int, int]:
@@ -73,6 +133,19 @@ def shard_path(directory: str, step: int, rank: int) -> str:
     return os.path.join(step_dir(directory, step), "rank%d.ckpt" % rank)
 
 
+def _sidecar_path(directory: str, step: int, rank: int) -> str:
+    return os.path.join(step_dir(directory, step),
+                        "rank%d.meta.json" % rank)
+
+
+def manifest_path(directory: str, step: int) -> str:
+    return os.path.join(step_dir(directory, step), MANIFEST_NAME)
+
+
+def _tombstone_path(directory: str, step: int) -> str:
+    return os.path.join(step_dir(directory, step), TOMBSTONE_NAME)
+
+
 def list_steps(directory: str) -> List[int]:
     """Step numbers with a directory present (complete or not)."""
     try:
@@ -87,7 +160,87 @@ def list_steps(directory: str) -> List[int]:
     return sorted(steps)
 
 
+def read_manifest(directory: str, step: int) -> Optional[dict]:
+    """The step's MANIFEST.json, or None when it was never assembled
+    (legacy pre-integrity step, or the fleet died before every shard
+    landed)."""
+    try:
+        with open(manifest_path(directory, step)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _tombstoned(directory: str, step: int) -> bool:
+    return os.path.exists(_tombstone_path(directory, step))
+
+
+def _fresh_pins(d: str) -> List[str]:
+    """Reader pins younger than PIN_STALE_S — the janitor's deletion
+    barrier.  Stale pins (crashed readers) don't block GC forever."""
+    out = []
+    now = time.time()
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for n in names:
+        if not n.startswith(_PIN_PREFIX):
+            continue
+        try:
+            if now - os.path.getmtime(os.path.join(d, n)) < PIN_STALE_S:
+                out.append(n)
+        except OSError:
+            pass  # pin released between listdir and stat
+    return out
+
+
+@contextlib.contextmanager
+def _read_pin(directory: str, step: int):
+    """Pin the step against the janitor while a reader verifies/loads
+    it.  Yields a refresh callable the reader invokes between shard
+    hashes — a verify of multi-GB shards can outlast PIN_STALE_S, and
+    a pin that stops looking fresh would hand the janitor the very
+    step being read.  Best-effort: if the step dir is already gone the
+    pin simply doesn't exist and the tombstone re-check handles it."""
+    path = os.path.join(step_dir(directory, step), "%s%d-%d"
+                        % (_PIN_PREFIX, os.getpid(), next(_pin_ids)))
+    made = False
+    try:
+        with open(path, "w"):
+            made = True
+    except OSError:
+        pass
+
+    def refresh() -> None:
+        if made:
+            try:
+                os.utime(path)
+            except OSError:
+                pass
+
+    try:
+        yield refresh
+    finally:
+        if made:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
 def _is_complete(directory: str, step: int, num_ranks: int) -> bool:
+    """Manifest-aware completeness: a tombstoned step is never
+    complete; a manifested step is judged against the world size that
+    WROTE it; a legacy step falls back to the caller's fleet view."""
+    if _tombstoned(directory, step):
+        return False
+    man = read_manifest(directory, step)
+    if man is not None:
+        return all(
+            os.path.exists(os.path.join(step_dir(directory, step),
+                                        info["path"]))
+            for info in man.get("shards", {}).values())
     return all(os.path.exists(shard_path(directory, step, r))
                for r in range(num_ranks))
 
@@ -96,6 +249,9 @@ def missing_ranks(directory: str, step: int, num_ranks: int) -> List[int]:
     """Which ranks' shards are absent from ``step`` — the difference
     between "missing-file error" and an actionable one: a server that
     refuses to load a model must say WHOSE shard never landed."""
+    man = read_manifest(directory, step)
+    if man is not None:
+        num_ranks = int(man.get("num_ranks", num_ranks))
     return [r for r in range(num_ranks)
             if not os.path.exists(shard_path(directory, step, r))]
 
@@ -116,9 +272,9 @@ def _incomplete_detail(directory: str, num_ranks: int) -> str:
 def latest_step(directory: str,
                 num_ranks: Optional[int] = None) -> Optional[int]:
     """The newest step every expected rank finished writing (None when
-    the directory holds no complete checkpoint).  ``num_ranks`` defaults
-    to this process's fleet size — a single-rank reader of a 2-rank
-    directory must pass it explicitly."""
+    the directory holds no complete checkpoint).  Manifested steps are
+    self-describing about their world size; for legacy steps
+    ``num_ranks`` defaults to this process's fleet size."""
     if num_ranks is None:
         num_ranks = max(_rank_info()[1], 1)
     for step in reversed(list_steps(directory)):
@@ -127,6 +283,177 @@ def latest_step(directory: str,
     return None
 
 
+# ---------------------------------------------------------------------------
+# integrity: digests, manifest assembly, verification
+# ---------------------------------------------------------------------------
+def _tree_spec(tree: Dict[str, Any]) -> Dict[str, dict]:
+    out = {}
+    for k, v in (tree or {}).items():
+        out[k] = {"shape": list(getattr(v, "shape", ()) or ()),
+                  "dtype": str(getattr(v, "dtype", "")) or None}
+    return out
+
+
+def _try_assemble_manifest(directory: str, step: int,
+                           num_ranks: int) -> Optional[str]:
+    """Once every rank's shard + digest sidecar landed, fold them into
+    the step's MANIFEST.json (atomic write; racing ranks write
+    identical content).  The digests come from the sidecars — computed
+    from the in-memory pickle BEFORE the bytes hit disk — so on-disk
+    corruption after the write is always detectable."""
+    if os.path.exists(manifest_path(directory, step)):
+        return None
+    shards: Dict[str, dict] = {}
+    tree: Dict[str, Any] = {}
+    for r in range(num_ranks):
+        if not os.path.exists(shard_path(directory, step, r)):
+            return None
+        try:
+            with open(_sidecar_path(directory, step, r)) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return None
+        shards[str(r)] = {"path": "rank%d.ckpt" % r,
+                          "bytes": int(meta["bytes"]),
+                          "sha256": meta["sha256"]}
+        if meta.get("tree"):
+            tree = meta["tree"]
+    manifest = {
+        "manifest_version": MANIFEST_VERSION,
+        "format_version": FORMAT_VERSION,
+        "step": int(step),
+        "num_ranks": int(num_ranks),
+        "shards": shards,
+        "tree": tree,
+    }
+    path = manifest_path(directory, step)
+    tmp = path + ".tmp.%d" % os.getpid()
+    try:
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+def verify_step(directory: str, step: int,
+                num_ranks: Optional[int] = None,
+                digest_ranks: Optional[List[int]] = None,
+                on_shard=None) -> dict:
+    """Audit one step against its manifest.  Returns
+    ``{step, has_manifest, complete, verified, shards: {rank: {ok,
+    error}}, corrupt: [shard names]}``; ``verified`` is None when
+    there is no manifest to verify against (legacy step).
+
+    ``digest_ranks`` limits the expensive sha256 pass to those ranks
+    (everything else still gets the cheap existence + byte-count
+    check): an explicit-step load only needs its OWN source shard
+    hashed — re-hashing a whole multi-rank step per rank would be
+    O(W^2) resume I/O.  ``on_shard`` is called after each shard (the
+    reader's pin-refresh hook, so a long hash can't outlive the GC
+    barrier)."""
+    nr = max(_rank_info()[1], 1) if num_ranks is None else int(num_ranks)
+    man = read_manifest(directory, step)
+    rep: Dict[str, Any] = {"step": int(step), "has_manifest": man is not None,
+                           "complete": False, "verified": None,
+                           "shards": {}, "corrupt": []}
+    if _tombstoned(directory, step):
+        rep["error"] = "tombstoned (mid-deletion)"
+        return rep
+    if man is None:
+        rep["complete"] = all(os.path.exists(shard_path(directory, step, r))
+                              for r in range(nr))
+        return rep
+    d = step_dir(directory, step)
+    want_digest = None if digest_ranks is None \
+        else {int(r) for r in digest_ranks}
+    all_exist = True
+    all_ok = True
+    for r, info in sorted(man.get("shards", {}).items(),
+                          key=lambda kv: int(kv[0])):
+        path = os.path.join(d, info["path"])
+        entry: Dict[str, Any] = {"ok": False}
+        rep["shards"][r] = entry
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            entry["error"] = "missing"
+            all_exist = False
+            all_ok = False
+            continue
+        if size != int(info["bytes"]):
+            entry["error"] = ("truncated: %d bytes on disk, manifest "
+                              "says %d" % (size, info["bytes"]))
+            all_ok = False
+            rep["corrupt"].append(info["path"])
+            continue
+        if want_digest is not None and int(r) not in want_digest:
+            entry["ok"] = True  # existence + size only, by request
+            continue
+        h = hashlib.sha256()
+        try:
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+        except OSError:
+            entry["error"] = "unreadable"
+            all_exist = False
+            all_ok = False
+            continue
+        if on_shard is not None:
+            on_shard()
+        digest = h.hexdigest()
+        if digest != info["sha256"]:
+            entry["error"] = ("sha256 mismatch: disk %s.. != manifest "
+                              "%s.." % (digest[:12], info["sha256"][:12]))
+            all_ok = False
+            rep["corrupt"].append(info["path"])
+        else:
+            entry["ok"] = True
+    rep["complete"] = all_exist
+    rep["verified"] = all_ok and all_exist
+    return rep
+
+
+def verify_dir(directory: str, num_ranks: Optional[int] = None) -> dict:
+    """Audit every step under ``directory`` (the ``--verify`` CLI).
+    ``ok`` is False when any complete step holds a corrupt shard —
+    a checkpoint directory whose NEWEST step would silently lose the
+    fallback race must fail the audit loudly."""
+    steps = []
+    n_corrupt = n_verified = n_legacy = 0
+    for s in list_steps(directory):
+        rep = verify_step(directory, s, num_ranks=num_ranks)
+        steps.append(rep)
+        if rep["corrupt"]:
+            n_corrupt += 1
+        elif rep["verified"]:
+            n_verified += 1
+        elif rep["complete"] and not rep["has_manifest"]:
+            n_legacy += 1
+    return {
+        "directory": directory,
+        "n_steps": len(steps),
+        "n_verified": n_verified,
+        "n_corrupt": n_corrupt,
+        "n_unverifiable_legacy": n_legacy,
+        "ok": n_corrupt == 0,
+        "steps": steps,
+    }
+
+
+def _verify_wanted(verify: Optional[bool]) -> bool:
+    if verify is not None:
+        return bool(verify)
+    from . import env as _env
+
+    return _env.get_bool("MXNET_CKPT_VERIFY")
+
+
+# ---------------------------------------------------------------------------
+# RNG state (unchanged)
+# ---------------------------------------------------------------------------
 def _snapshot_params(params: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     """Device arrays -> host numpy, synchronously: the caller's training
     loop may mutate the live buffers right after save() returns, so the
@@ -246,6 +573,18 @@ class CheckpointManager:
                 self._q.task_done()
 
     def _write(self, step: int, payload: dict, path: str) -> None:
+        blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        # digest of the in-memory bytes, BEFORE they touch disk: any
+        # later on-disk flip/truncation is detectable against it
+        digest = hashlib.sha256(blob).hexdigest()
+        sidecar = {
+            "rank": self.rank, "step": int(step),
+            "num_ranks": self.num_ranks,
+            "bytes": len(blob), "sha256": digest,
+            "format_version": FORMAT_VERSION,
+            "tree": {"params": _tree_spec(payload.get("params")),
+                     "aux_params": _tree_spec(payload.get("aux_params"))},
+        }
         # one retry: a peer rank's janitor may rmdir this step between
         # our makedirs and the replace (GC of a stale incomplete step
         # racing the async writer) — recreate and land the shard; the
@@ -257,15 +596,26 @@ class CheckpointManager:
                 os.makedirs(os.path.dirname(path), exist_ok=True)
                 tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
-                    pickle.dump(payload, f,
-                                protocol=pickle.HIGHEST_PROTOCOL)
+                    f.write(blob)
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, path)  # readers never see a torn shard
+                sc = _sidecar_path(self.directory, step, self.rank)
+                with open(sc + ".tmp", "w") as f:
+                    json.dump(sidecar, f)
+                os.replace(sc + ".tmp", sc)
                 break
             except FileNotFoundError:
                 if attempt:
                     raise
+        from . import chaos as _chaos
+
+        if _chaos.enabled():
+            # chaos 'corrupt_shard': flip bytes in the LANDED file,
+            # after its true digest was recorded — the bit-rot the
+            # verify/fallback path must catch
+            _chaos.maybe_corrupt_shard(path, step=step, rank=self.rank)
+        _try_assemble_manifest(self.directory, step, self.num_ranks)
         self._gc(keep_at_least=step)
 
     def _gc(self, keep_at_least: int) -> None:
@@ -294,17 +644,54 @@ class CheckpointManager:
                 if s < newest and s not in complete:
                     self._rm_step(s)
 
-    def _rm_step(self, step: int) -> None:
+    def _rm_step(self, step: int) -> bool:
+        """Delete one step, honoring the reader barrier: check pins
+        FIRST, drop the tombstone, re-check pins, then remove shards
+        and the manifest LAST (an interrupted deletion leaves a
+        tombstoned dir the next GC round finishes; a reader that races
+        us re-checks the tombstone and reports 'deleted', never
+        'corrupt').  Returns False when a pinned reader deferred the
+        deletion to the next round."""
         d = step_dir(self.directory, step)
+        if not os.path.isdir(d):
+            return True
+        if _fresh_pins(d):
+            return False  # a reader is verifying this step right now
+        tomb = _tombstone_path(self.directory, step)
         try:
-            for name in os.listdir(d):
-                try:
-                    os.unlink(os.path.join(d, name))
-                except OSError:
-                    pass
+            with open(tomb, "w"):
+                pass
+        except OSError:
+            return False
+        if _fresh_pins(d):
+            # a reader pinned between our check and the tombstone:
+            # back off — its tombstone re-check may or may not have
+            # seen us, and skipping deletion is always safe
+            try:
+                os.unlink(tomb)
+            except OSError:
+                pass
+            return False
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return True
+        # shards first, manifest second-to-last, tombstone LAST: while
+        # any shard deletion is in progress the step is tombstoned, so
+        # a racing reader's "manifest present AND no tombstone" check
+        # can never classify a half-deleted step as corrupt
+        for name in sorted(names,
+                           key=lambda n: (n == MANIFEST_NAME)
+                           + 2 * (n == TOMBSTONE_NAME)):
+            try:
+                os.unlink(os.path.join(d, name))
+            except OSError:
+                pass
+        try:
             os.rmdir(d)
         except OSError:
             pass
+        return True
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until queued writes land (Queue.join has no timeout, so
@@ -339,38 +726,256 @@ def save_checkpoint(directory: str, step: int, **kw) -> str:
     return CheckpointManager(directory).save(step, **kw)
 
 
-def load_checkpoint(directory: str, step: Optional[int] = None,
-                    rank: Optional[int] = None,
-                    num_ranks: Optional[int] = None) -> dict:
-    """Load one rank's shard of the given (default: newest complete)
-    step.  Raises FileNotFoundError when nothing is resumable and
-    ValueError on a format from the future."""
-    if rank is None:
-        rank = _rank_info()[0]
-    nr = max(_rank_info()[1], 1) if num_ranks is None else int(num_ranks)
-    if step is None:
-        step = latest_step(directory, num_ranks=num_ranks)
-        if step is None:
+# ---------------------------------------------------------------------------
+# load: verified, elastic, fallback-aware
+# ---------------------------------------------------------------------------
+def _split_step_dir(directory: str) -> Tuple[str, Optional[int]]:
+    """``resume_from`` may point at a specific ``step_NNNNNNNN`` dir —
+    that is the explicit-step (fail-fast, no fallback) spelling."""
+    norm = os.path.normpath(directory)
+    m = _STEP_RE.match(os.path.basename(norm))
+    if m:
+        return os.path.dirname(norm), int(m.group(1))
+    return directory, None
+
+
+def _load_shard(directory: str, step: int, rank: int, nr: int,
+                verify: bool, explicit: bool) -> dict:
+    """Load one step for ``rank`` of an ``nr``-rank fleet, under a
+    reader pin: tombstone checked first, digests verified against the
+    manifest, elastic source-shard selection when the writing world
+    size differs.  Raises CheckpointCorrupt (verification failed),
+    _StepVanished (GC won the race), or FileNotFoundError (shards
+    genuinely missing)."""
+    with _read_pin(directory, step) as refresh_pin:
+        if _tombstoned(directory, step):
+            raise _StepVanished(step)
+        man = read_manifest(directory, step)
+        writer_ranks = int(man["num_ranks"]) if man is not None else nr
+        src = rank if writer_ranks == nr else rank % writer_ranks
+        if verify and man is not None:
+            # explicit step: digest only OUR source shard (there is no
+            # fallback decision to keep fleet-coherent, and re-hashing
+            # the whole step per rank would be O(W^2) resume I/O);
+            # newest-step walk: digest ALL shards so every rank takes
+            # the SAME fallback decision.  Cheap existence+size checks
+            # always cover the full step.
+            rep = verify_step(directory, step,
+                              digest_ranks=[src] if explicit else None,
+                              on_shard=refresh_pin)
+            if not rep["verified"]:
+                # deleted under us, or genuinely corrupt?  The janitor
+                # removes the manifest behind a tombstone that is
+                # removed LAST, so a still-present manifest with no
+                # tombstone means the bytes really are bad.
+                if _tombstoned(directory, step) or \
+                        read_manifest(directory, step) is None:
+                    raise _StepVanished(step)
+                if rep["corrupt"]:
+                    details = "; ".join(
+                        "%s: %s" % (info.get("error"),
+                                    os.path.join(
+                                        step_dir(directory, step),
+                                        man["shards"][r]["path"]))
+                        for r, info in sorted(rep["shards"].items())
+                        if info.get("error"))
+                    raise CheckpointCorrupt(
+                        "checkpoint step %d under %r FAILED integrity "
+                        "verification — corrupt shard(s): %s (%s).  "
+                        "Set MXNET_CKPT_VERIFY=0 to load anyway at "
+                        "your own risk."
+                        % (step, directory, ", ".join(rep["corrupt"]),
+                           details))
+                # shards MISSING (not corrupt): that is the
+                # incomplete-step story — name whose shard is absent
+                missing = sorted(
+                    int(r) for r, info in rep["shards"].items()
+                    if info.get("error") in ("missing", "unreadable"))
+                present = [r for r in range(writer_ranks)
+                           if r not in missing]
+                raise FileNotFoundError(
+                    "checkpoint step %d under %r is incomplete: "
+                    "missing shard(s) for rank(s) %s of %d (present: "
+                    "%s) — every rank must finish writing before the "
+                    "step is loadable"
+                    % (step, directory, missing, writer_ranks, present))
+        path = shard_path(directory, step, src)
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError:
+            if _tombstoned(directory, step) or \
+                    not os.path.isdir(step_dir(directory, step)):
+                raise _StepVanished(step)
+            missing = missing_ranks(directory, step, writer_ranks)
+            present = [r for r in range(writer_ranks) if r not in missing]
             raise FileNotFoundError(
-                "no complete checkpoint under %r (a step is complete "
-                "only when every rank's shard exists): %s"
-                % (directory, _incomplete_detail(directory, nr)))
-    path = shard_path(directory, step, rank)
-    try:
-        f = open(path, "rb")
-    except FileNotFoundError:
-        missing = missing_ranks(directory, step, nr)
-        present = [r for r in range(nr) if r not in missing]
-        raise FileNotFoundError(
-            "checkpoint step %d under %r is incomplete: missing "
-            "shard(s) for rank(s) %s of %d (present: %s) — every rank "
-            "must finish writing before the step is loadable"
-            % (step, directory, missing or [rank], nr, present))
-    with f:
-        payload = pickle.load(f)
+                "checkpoint step %d under %r is incomplete: missing "
+                "shard(s) for rank(s) %s of %d (present: %s) — every "
+                "rank must finish writing before the step is loadable"
+                % (step, directory, missing or [src], writer_ranks,
+                   present))
+        with f:
+            payload = pickle.load(f)
     version = payload.get("format_version")
     if version is None or version > FORMAT_VERSION:
         raise ValueError(
             "checkpoint %s has format_version %r; this build reads <= %d"
             % (path, version, FORMAT_VERSION))
+    if writer_ranks != nr:
+        it = payload.get("iterator") or {}
+        payload["elastic"] = {
+            "from_num_ranks": writer_ranks, "to_num_ranks": nr,
+            "rank": rank, "source_rank": src,
+            "orig_nbatch": int(payload.get("nbatch", 0)),
+            "orig_cursor": it.get("cursor"),
+            "orig_batch_size": it.get("batch_size"),
+        }
+        _log.warning(
+            "ELASTIC RESUME: checkpoint step %d under %r was written "
+            "by %d rank(s); resuming rank %d of %d from source shard "
+            "%d — params/momenta resharded deterministically, iterator "
+            "position scales by %d/%d (exact-resume stays bitwise only "
+            "when the world size matches)",
+            step, directory, writer_ranks, rank, nr, src,
+            writer_ranks, nr)
     return payload
+
+
+def load_checkpoint(directory: str, step: Optional[int] = None,
+                    rank: Optional[int] = None,
+                    num_ranks: Optional[int] = None,
+                    verify: Optional[bool] = None) -> dict:
+    """Load one rank's shard of the given (default: newest verified)
+    step.
+
+    * ``verify`` (default ``MXNET_CKPT_VERIFY``, on): shard digests are
+      checked against the step's MANIFEST.json before unpickling.
+    * An EXPLICIT ``step`` (or a ``directory`` that points straight at
+      a ``step_NNNNNNNN`` dir) fails fast on corruption — no silent
+      fallback can substitute different params than the caller named.
+    * ``step=None`` walks newest-first and falls back PAST corrupt
+      steps to the newest verified one, logging the exact corrupt
+      shard; if nothing verified survives, CheckpointCorrupt names the
+      corrupt shard(s).
+    * A checkpoint written by W ranks loads on a W'-rank fleet (the
+      manifest carries W): rank r reads source shard ``r % W`` and the
+      payload's ``elastic`` entry records the reshard provenance.
+
+    Raises FileNotFoundError when nothing is resumable, ValueError on
+    a format from the future, CheckpointCorrupt on failed digests.
+    """
+    directory, dir_step = _split_step_dir(directory)
+    if step is None:
+        step = dir_step
+    if rank is None:
+        rank = _rank_info()[0]
+    nr = max(_rank_info()[1], 1) if num_ranks is None else int(num_ranks)
+    want_verify = _verify_wanted(verify)
+
+    if step is not None:
+        try:
+            return _load_shard(directory, int(step), rank, nr,
+                               want_verify, explicit=True)
+        except _StepVanished:
+            raise FileNotFoundError(
+                "checkpoint step %d under %r does not exist (never "
+                "written, or garbage-collected by the retention "
+                "janitor); steps present: %s"
+                % (step, directory, list_steps(directory)))
+
+    corrupt_msgs: List[str] = []
+    for s in reversed(list_steps(directory)):
+        if not _is_complete(directory, s, nr):
+            continue
+        try:
+            return _load_shard(directory, s, rank, nr, want_verify,
+                               explicit=False)
+        except _StepVanished:
+            continue
+        except CheckpointCorrupt as e:
+            corrupt_msgs.append(str(e))
+            _log.warning(
+                "checkpoint step %d under %r failed verification — "
+                "falling back to the newest VERIFIED step (%s)",
+                s, directory, e)
+            continue
+        except FileNotFoundError:
+            continue  # raced an uneven writer; keep walking
+    if corrupt_msgs:
+        raise CheckpointCorrupt(
+            "no verified checkpoint under %r: every complete step "
+            "failed integrity verification.  Newest failure: %s"
+            % (directory, corrupt_msgs[0]))
+    raise FileNotFoundError(
+        "no complete checkpoint under %r (a step is complete "
+        "only when every rank's shard exists): %s"
+        % (directory, _incomplete_detail(directory, nr)))
+
+
+def scale_resume_skip(payload: dict,
+                      new_batch_size: Optional[int]) -> int:
+    """Deterministic iterator-position scaling for an elastic resume:
+    the global sample position (per-rank batches x per-rank batch size
+    x world size) is invariant; the resumed fleet's per-rank skip is
+    that position re-divided by ITS per-rank batch x world size.
+    Falls back to pure world-size scaling when the writing batch size
+    was not recorded (legacy shards)."""
+    el = payload.get("elastic")
+    if not el:
+        return int(payload.get("nbatch", 0))
+    w_old = max(int(el["from_num_ranks"]), 1)
+    w_new = max(int(el["to_num_ranks"]), 1)
+    nbatch = int(el.get("orig_nbatch", payload.get("nbatch", 0)))
+    b_old = el.get("orig_batch_size")
+    if b_old and new_batch_size:
+        global_samples = nbatch * int(b_old) * w_old
+        return global_samples // (int(new_batch_size) * w_new)
+    return (nbatch * w_old) // w_new
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m mxnet_tpu.checkpoint --verify DIR
+# ---------------------------------------------------------------------------
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.checkpoint",
+        description="checkpoint directory integrity audit")
+    ap.add_argument("--verify", metavar="DIR",
+                    help="verify every step's shards against its "
+                         "MANIFEST.json; exit 1 when any complete step "
+                         "holds a corrupt shard")
+    ap.add_argument("--num-ranks", type=int, default=None,
+                    help="expected world size for legacy steps without "
+                         "a manifest (manifested steps are "
+                         "self-describing)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    args = ap.parse_args(argv)
+    if not args.verify:
+        ap.print_help()
+        return 0
+    rep = verify_dir(args.verify, num_ranks=args.num_ranks)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        for s in rep["steps"]:
+            if s["corrupt"]:
+                status = "CORRUPT (%s)" % ", ".join(s["corrupt"])
+            elif s["verified"]:
+                status = "verified"
+            elif s["complete"]:
+                status = "complete, no manifest (legacy, unverifiable)"
+            else:
+                status = "incomplete"
+            print("step %8d: %s" % (s["step"], status))
+        print("%s: %d step(s), %d verified, %d corrupt, %d legacy"
+              % ("OK" if rep["ok"] else "FAILED", rep["n_steps"],
+                 rep["n_verified"], rep["n_corrupt"],
+                 rep["n_unverifiable_legacy"]))
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

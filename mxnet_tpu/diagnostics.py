@@ -455,7 +455,13 @@ class FlightRecorder:
             prev_term = signal.getsignal(signal.SIGTERM)
 
             def _term(signum, frame):
-                self.dump(reason="SIGTERM")                    # 1. dump
+                # n_recorded guard (same contract as the atexit leg): a
+                # process that never issued a collective — a serving
+                # demo, the PS scheduler — has no evidence to dump, and
+                # an empty-ring dump would litter the CWD (or clobber a
+                # worker's real dump) with a useless artifact
+                if self.n_recorded():
+                    self.dump(reason="SIGTERM")                # 1. dump
                 from . import env as _envmod
 
                 try:

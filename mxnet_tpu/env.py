@@ -214,8 +214,9 @@ register("MXNET_PS_RETRY_BACKOFF_S", "float", 0.1,
 register("MXNET_CHAOS", "str", None,
          "Fault-injection spec: semicolon-separated rules "
          "'kind:k=v,k=v' with kinds drop_push / delay_collective / "
-         "kill / nan_grad (see mxnet_tpu/chaos.py).  Unset disables "
-         "all injection.")
+         "kill / nan_grad / slow_request / fail_execute / "
+         "corrupt_shard / bad_version (see mxnet_tpu/chaos.py).  "
+         "Unset disables all injection.")
 
 # module — non-finite gradient guard
 register("MXNET_SKIP_NONFINITE_GRADS", "bool", False,
@@ -241,6 +242,11 @@ register("MXNET_CKPT_ASYNC", "bool", True,
 register("MXNET_CKPT_DRAIN_S", "float", 5.0,
          "How long the SIGTERM/watchdog preemption path waits for "
          "in-flight collectives to drain before checkpointing.")
+register("MXNET_CKPT_VERIFY", "bool", True,
+         "Verify shard sha256 digests against the per-step "
+         "MANIFEST.json on load; a corrupt newest step falls back to "
+         "the newest VERIFIED step (explicitly requested steps fail "
+         "fast instead).  0 trusts disk blindly.")
 
 # diagnostics.py — flight recorder / recompile tracking / metrics
 register("MXNET_DUMP_DIR", "str", None,
@@ -300,6 +306,21 @@ register("MXNET_SERVE_BREAKER_RESET_S", "float", 5.0,
 register("MXNET_SERVE_PORT", "int", 8000,
          "HTTP front-end port for python -m mxnet_tpu.serving --serve "
          "(predict + healthz/readyz/metrics).")
+register("MXNET_SERVE_CANARY_PCT", "float", 25.0,
+         "During ModelServer.reload, the percentage of dispatched "
+         "batches routed to the NEW version while it is canaried; a "
+         "failed canary batch is transparently re-executed on the "
+         "stable version.  0 skips the canary and swaps as soon as "
+         "the new version is compiled + warm.")
+register("MXNET_SERVE_CANARY_MIN_N", "int", 20,
+         "Canary batches observed before the promote-vs-rollback "
+         "decision is made (too small and one unlucky batch decides; "
+         "too large and a bad version canaries forever).")
+register("MXNET_SERVE_ROLLBACK_ERR_RATIO", "float", 2.0,
+         "Auto-rollback threshold: the canary rolls back when its "
+         "error rate exceeds the stable version's error rate over the "
+         "same window times this ratio (a canary that errors while "
+         "stable is clean always rolls back).")
 
 # image/image.py — decode pool
 register("MXNET_CPU_WORKER_NTHREADS", "int", 1,

@@ -843,13 +843,48 @@ class KVStoreDist(KVStore):
 
     def set_optimizer_states_bytes(self, states: bytes) -> None:
         payload = pickle.loads(states)
+        if not (isinstance(payload, dict) and "shards" in payload
+                and "num_servers" in payload):
+            # a LOCAL updater blob (flat {key: state} dict, optionally
+            # (states, optimizer)): an elastic resume restoring a
+            # 1-rank checkpoint onto a dist fleet — re-shard the keys
+            # by the same crc32 rule the servers partition with
+            payload = self._reshard_local_states(payload)
         if payload["num_servers"] != len(self._server_clients):
-            raise MXNetError(
-                "optimizer states saved with %d servers, cluster has %d"
-                % (payload["num_servers"], len(self._server_clients)))
+            payload = self._reshard_merged_states(payload)
         for i, c in enumerate(self._server_clients):
             self._req(c, {"op": "load_optimizer_states",
                           "data": payload["shards"][i]})
+
+    def _reshard_local_states(self, data) -> dict:
+        """Flat updater states -> the per-server-shard wrapper, keys
+        partitioned exactly as pushes are (crc32 % num_servers)."""
+        optimizer = None
+        if isinstance(data, tuple):
+            data, optimizer = data
+        n = len(self._server_clients)
+        per: Dict[int, dict] = {i: {} for i in range(n)}
+        for k, v in (data or {}).items():
+            per[self._server_idx(k)][k] = v
+        return {"num_servers": n, "shards": {
+            i: pickle.dumps((per[i], optimizer) if optimizer is not None
+                            else per[i]) for i in range(n)}}
+
+    def _reshard_merged_states(self, payload) -> dict:
+        """A wrapper saved with a DIFFERENT server count: merge every
+        shard's keys and re-partition for this cluster (deterministic —
+        crc32 keys land where pushes will look for them)."""
+        merged: dict = {}
+        optimizer = None
+        for blob in payload["shards"].values():
+            if not blob:
+                continue
+            sub = pickle.loads(blob)
+            if isinstance(sub, tuple):
+                sub, optimizer = sub
+            merged.update(sub)
+        return self._reshard_local_states(
+            (merged, optimizer) if optimizer is not None else merged)
 
     def save_optimizer_states(self, fname: str,
                               dump_optimizer: bool = False) -> None:

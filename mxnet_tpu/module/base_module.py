@@ -203,7 +203,10 @@ class BaseModule:
         if resume_from is True:
             resume_from = ckpt_dir
         if resume_from and not ckpt_dir:
-            ckpt_dir = resume_from
+            # resume_from may name a specific step_NNNNNNNN dir (the
+            # explicit fail-fast spelling) — new checkpoints go to its
+            # PARENT, never nested inside the step
+            ckpt_dir = _ckpt._split_step_dir(resume_from)[0]
         resume_payload = None
         resume_skip = 0
         global_step = 0
@@ -217,6 +220,14 @@ class BaseModule:
             begin_epoch = int(resume_payload["epoch"])
             resume_skip = int(resume_payload["nbatch"])
             global_step = int(resume_payload["step"])
+            if resume_payload.get("elastic"):
+                # W != W' reshard (load_checkpoint already logged the
+                # provenance line): the global sample position is
+                # invariant, so the per-rank fast-forward re-divides it
+                # by THIS fleet's per-rank batch x world size
+                resume_skip = _ckpt.scale_resume_skip(
+                    resume_payload,
+                    getattr(train_data, "batch_size", None))
             self.logger.info(
                 "resuming from checkpoint step %d (%s): epoch %d, "
                 "batch %d", global_step, resume_from, begin_epoch,
@@ -303,8 +314,14 @@ class BaseModule:
                          optimizer_states=st["optimizer_states"],
                          epoch=progress["epoch"],
                          nbatch=progress["nbatch"],
-                         iterator_state={"cursor": getattr(
-                             train_data, "cursor", None)},
+                         iterator_state={
+                             "cursor": getattr(train_data, "cursor",
+                                               None),
+                             # recorded so an elastic resume on a
+                             # different world size can re-derive the
+                             # global sample position exactly
+                             "batch_size": getattr(train_data,
+                                                   "batch_size", None)},
                          blocking=blocking)
 
         hook_key = None

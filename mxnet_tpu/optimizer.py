@@ -576,7 +576,23 @@ class Updater:
 
     def set_states(self, states: bytes) -> None:
         data = pickle.loads(states)
-        if isinstance(data, tuple):
+        if isinstance(data, dict) and "shards" in data \
+                and "num_servers" in data:
+            # the DIST kvstore's gathered-server-shards wrapper
+            # (KVStoreDist.get_optimizer_states_bytes): an elastic
+            # resume may hand a W-rank dist checkpoint's momenta to a
+            # local updater — merge the per-server key shards (keys are
+            # disjoint by crc32 sharding) into one state dict
+            merged = {}
+            for blob in data["shards"].values():
+                if not blob:
+                    continue
+                sub = pickle.loads(blob)
+                if isinstance(sub, tuple):
+                    sub, self.optimizer = sub
+                merged.update(sub)
+            states = merged
+        elif isinstance(data, tuple):
             states, self.optimizer = data
         else:
             states = data

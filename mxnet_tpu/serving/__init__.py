@@ -9,9 +9,13 @@ deadline propagation (expired work is never batched), AOT-compiled
 bf16 executors per batch bucket with a warmup pass (the first request
 never pays compile latency), a per-model circuit breaker, graceful
 SIGTERM drain through the shared preemption-hook path (exit 83 — see
-the README exit-code table), distinct liveness/readiness probes, and
-Prometheus metrics (p50/p99 latency, QPS, queue depth, shed counts)
-through ``diagnostics.metrics``.
+the README exit-code table), distinct liveness/readiness probes,
+zero-downtime model reload with a canary phase and auto-rollback
+(``ModelServer.reload``: digest-verified load -> compile+warm ->
+canary ``MXNET_SERVE_CANARY_PCT``% of traffic -> promote or roll back
+on error-rate regression, zero admitted requests dropped), and
+Prometheus metrics (p50/p99 latency, QPS, queue depth, shed counts,
+per-version outcome counters) through ``diagnostics.metrics``.
 
 Quickstart::
 
@@ -33,13 +37,15 @@ from .errors import (REJECT_REASONS, DeadlineExceeded, ExecutorFailure,
                      Rejected, ServeError)
 from .http import HttpFrontend
 from .loadgen import BackgroundLoad, qps_at_slo, run_load
-from .runtime import ModelRuntime, demo_runtime, plan_batch_buckets
+from .runtime import (ModelRuntime, demo_params, demo_runtime,
+                      plan_batch_buckets)
 from .server import CircuitBreaker, ModelServer
 
 __all__ = [
     "Request", "RequestQueue", "ServeError", "Rejected",
     "DeadlineExceeded", "ExecutorFailure", "REJECT_REASONS",
-    "ModelRuntime", "demo_runtime", "plan_batch_buckets",
+    "ModelRuntime", "demo_runtime", "demo_params",
+    "plan_batch_buckets",
     "CircuitBreaker", "ModelServer", "HttpFrontend",
     "run_load", "qps_at_slo", "BackgroundLoad",
 ]

@@ -12,7 +12,8 @@ __all__ = ["ServeError", "Rejected", "DeadlineExceeded",
 
 #: the closed set of admission-rejection reasons (metric label values)
 REJECT_REASONS = ("queue_full", "breaker_open", "draining", "too_large",
-                  "unknown_model", "bad_input", "deadline")
+                  "unknown_model", "bad_input", "deadline",
+                  "reload_in_progress")
 
 
 class ServeError(RuntimeError):

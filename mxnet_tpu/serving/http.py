@@ -12,7 +12,11 @@ Routes:
     exposition (p50/p99 gauges included);
   * ``POST /v1/models/<name>:predict`` — body
     ``{"instances": [[...], ...], "deadline_ms": 250}``; responds
-    ``{"predictions": ...}``.
+    ``{"predictions": ...}``;
+  * ``POST /v1/models/<name>:reload`` — body ``{"directory": "...",
+    "step": N?, "wait_s": S?}``; kicks the zero-downtime reload
+    (verify -> compile+warm -> canary -> promote/rollback) and
+    responds 202 with the reload state (200 terminal when waited).
 
 Status mapping is the load-shedding contract made visible: 429 +
 ``Retry-After`` for a shed (queue_full), 503 + ``Retry-After`` for an
@@ -37,7 +41,7 @@ _log = logging.getLogger(__name__)
 REASON_STATUS = {
     "queue_full": 429, "breaker_open": 503, "draining": 503,
     "too_large": 413, "unknown_model": 404, "bad_input": 400,
-    "deadline": 504,
+    "deadline": 504, "reload_in_progress": 409,
 }
 
 
@@ -103,9 +107,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": "no route %r" % self.path})
 
     def do_POST(self):
-        model = self._route_model()
+        model, verb = self._route_model()
         if model is None:
             self._reply(404, {"error": "no route %r" % self.path})
+            return
+        if verb == "reload":
+            self._do_reload(model)
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -134,12 +141,54 @@ class _Handler(BaseHTTPRequestHandler):
             _log.exception("http: predict failed")
             self._reply(500, {"error": repr(e)})
 
-    def _route_model(self) -> Optional[str]:
+    def _do_reload(self, model: str) -> None:
+        """``POST /v1/models/<name>:reload`` body ``{"directory":
+        "...", "step": N?, "wait_s": S?}`` — kick the background
+        load+canary; 202 with the reload state (200 with the terminal
+        state when ``wait_s`` is given).  A rollback is a SUCCESSFUL
+        defense, not an error: it still answers 200."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object, got %s"
+                                 % type(payload).__name__)
+            directory = payload["directory"]
+            step = payload.get("step")
+            wait_s = payload.get("wait_s")
+            # validate BEFORE reload(): once the background thread is
+            # kicked, a late float("soon") error would 500 the caller
+            # while the reload keeps running behind the failure
+            if step is not None:
+                step = int(step)
+            if wait_s is not None:
+                wait_s = float(wait_s)
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(400, {"error": "bad reload body: %r" % e})
+            return
+        try:
+            state = self._srv.reload(model, directory, step=step,
+                                     wait_s=wait_s)
+            status = 200 if wait_s is not None else 202
+            if state.get("state") == "failed":
+                status = 500
+            self._reply(status, {"reload": state})
+        except Rejected as e:
+            self._reply(REASON_STATUS.get(e.reason, 503),
+                        {"error": str(e), "reason": e.reason})
+        except Exception as e:
+            _log.exception("http: reload failed")
+            self._reply(500, {"error": repr(e)})
+
+    def _route_model(self) -> Tuple[Optional[str], Optional[str]]:
         prefix = "/v1/models/"
-        if self.path.startswith(prefix) and \
-                self.path.endswith(":predict"):
-            return self.path[len(prefix):-len(":predict")] or None
-        return None
+        for verb in ("predict", "reload"):
+            suffix = ":" + verb
+            if self.path.startswith(prefix) and \
+                    self.path.endswith(suffix):
+                return (self.path[len(prefix):-len(suffix)] or None,
+                        verb)
+        return None, None
 
 
 class HttpFrontend:

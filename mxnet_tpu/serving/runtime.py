@@ -27,7 +27,8 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 from .errors import ExecutorFailure
 
-__all__ = ["plan_batch_buckets", "ModelRuntime", "demo_runtime"]
+__all__ = ["plan_batch_buckets", "ModelRuntime", "demo_runtime",
+           "demo_params"]
 
 _log = logging.getLogger(__name__)
 
@@ -72,12 +73,16 @@ class ModelRuntime:
 
         self.name = str(name)
         self.source = source
+        #: version number within a ModelServer (assigned by add_model/
+        #: reload; labels serving metrics and the canary decision)
+        self.version = 1
         self.sample_shape = tuple(int(d) for d in sample_shape)
         self.compute_dtype = compute_dtype
         self.max_batch = int(max_batch) if max_batch is not None \
             else _env.get_int("MXNET_SERVE_MAX_BATCH")
         self.plan = plan_batch_buckets(self.max_batch, batch_sizes)
         self._apply = apply_fn
+        self._input_dtype_arg = input_dtype
         self._input_dtype = self._resolve_dtype(input_dtype)
         self._params = self._cast_tree(params or {})
         self._aux = self._cast_tree(aux_params or {})
@@ -250,21 +255,44 @@ class ModelRuntime:
                    source="checkpoint:%s@step%s"
                    % (directory, payload.get("step")), **kw)
 
+    def successor_from_checkpoint(self, directory: str,
+                                  step: Optional[int] = None
+                                  ) -> "ModelRuntime":
+        """A NEW version of this model from a (verified) checkpoint:
+        same apply_fn, sample shape, dtypes, and bucket ladder — only
+        the weights change.  What :meth:`ModelServer.reload` builds and
+        canaries; the shared configuration is what makes the hot swap
+        shape-safe."""
+        return type(self).from_checkpoint(
+            self.name, directory, self._apply,
+            sample_shape=self.sample_shape, step=step,
+            input_dtype=self._input_dtype_arg,
+            compute_dtype=self.compute_dtype,
+            max_batch=self.max_batch, batch_sizes=self.plan)
+
+
+def demo_params(dim: int = 16, hidden: int = 32, classes: int = 4,
+                seed: int = 0) -> Dict[str, Any]:
+    """The demo MLP's fixed-seed host params — exposed so tests/bench
+    can checkpoint them (``mx.checkpoint.save_checkpoint``) and drive
+    the reload-from-checkpoint path with a distinguishable version."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return {
+        "w1": rng.randn(dim, hidden).astype("float32") * 0.1,
+        "b1": np.zeros(hidden, dtype="float32"),
+        "w2": rng.randn(hidden, classes).astype("float32") * 0.1,
+        "b2": np.zeros(classes, dtype="float32"),
+    }
+
 
 def demo_runtime(name: str = "demo", dim: int = 16, hidden: int = 32,
                  classes: int = 4, seed: int = 0,
                  **kw) -> ModelRuntime:
     """A tiny fixed-seed MLP — the self-test / load-generator / bench
     model (real enough to compile, pad, and cast like production)."""
-    import numpy as np
-
-    rng = np.random.RandomState(seed)
-    params = {
-        "w1": rng.randn(dim, hidden).astype("float32") * 0.1,
-        "b1": np.zeros(hidden, dtype="float32"),
-        "w2": rng.randn(hidden, classes).astype("float32") * 0.1,
-        "b2": np.zeros(classes, dtype="float32"),
-    }
+    params = demo_params(dim, hidden, classes, seed)
 
     def apply_fn(p, aux, x):
         import jax.numpy as jnp

@@ -129,11 +129,11 @@ class CircuitBreaker:
 
 class _ServedModel:
     """One model's runtime + queue + worker + breaker + throughput
-    estimate (the retry-after hint)."""
+    estimate (the retry-after hint) + the live-reload state machine."""
 
     def __init__(self, runtime, queue_max: int, breaker_n: int,
                  breaker_reset_s: float, on_expired):
-        self.runtime = runtime
+        self.runtime = runtime     # the STABLE version (atomic swap)
         self.queue = RequestQueue(queue_max, on_expired=on_expired)
         self.breaker = CircuitBreaker(breaker_n, breaker_reset_s)
         self.worker: Optional[threading.Thread] = None
@@ -142,6 +142,14 @@ class _ServedModel:
         self.completed = 0
         self.failed = 0
         self._lock = threading.Lock()
+        # -- live reload / canary state (guarded by _lock) ------------
+        self.canary = None               # new runtime while canarying
+        self.reload_state: Dict[str, Any] = {"state": "idle"}
+        self.reload_thread: Optional[threading.Thread] = None
+        self._canary_seq = 0             # deterministic routing counter
+        # per-version {n, errors} since the canary started — the
+        # promote-vs-rollback evidence window
+        self._vstats: Dict[int, Dict[str, int]] = {}
 
 
 class ModelServer:
@@ -155,7 +163,10 @@ class ModelServer:
                  default_deadline_ms: Optional[float] = None,
                  drain_s: Optional[float] = None,
                  breaker_n: Optional[int] = None,
-                 breaker_reset_s: Optional[float] = None):
+                 breaker_reset_s: Optional[float] = None,
+                 canary_pct: Optional[float] = None,
+                 canary_min_n: Optional[int] = None,
+                 rollback_err_ratio: Optional[float] = None):
         from .. import env as _env
 
         def knob(v, name, get=_env.get_float):
@@ -174,6 +185,13 @@ class ModelServer:
                                    _env.get_int))
         self._breaker_reset_s = float(
             knob(breaker_reset_s, "MXNET_SERVE_BREAKER_RESET_S"))
+        self.canary_pct = float(knob(canary_pct,
+                                     "MXNET_SERVE_CANARY_PCT"))
+        self.canary_min_n = int(knob(canary_min_n,
+                                     "MXNET_SERVE_CANARY_MIN_N",
+                                     _env.get_int))
+        self.rollback_err_ratio = float(
+            knob(rollback_err_ratio, "MXNET_SERVE_ROLLBACK_ERR_RATIO"))
         self._models: Dict[str, _ServedModel] = {}
         # reentrant: the SIGTERM preemption hook runs drain() inside a
         # signal handler ON the main thread, which may be interrupted
@@ -192,10 +210,12 @@ class ModelServer:
         model compiled."""
         if runtime.name in self._models:
             raise ValueError("model %r already served" % runtime.name)
+        runtime.version = getattr(runtime, "version", 1) or 1
         sm = _ServedModel(runtime, self.queue_max, self._breaker_n,
                           self._breaker_reset_s,
                           on_expired=lambda r: self._count_outcome(
-                              runtime.name, "expired"))
+                              runtime.name, "expired",
+                              self._version_of(runtime.name)))
         if hasattr(runtime, "compile") and not runtime.compiled:
             runtime.compile(warmup=warmup)
         sm.worker = threading.Thread(
@@ -310,7 +330,8 @@ class ModelServer:
                     r.set_error(DeadlineExceeded(
                         "request %s: deadline expired at dispatch"
                         % r.id))
-                    self._count_outcome(sm.runtime.name, "expired")
+                    self._count_outcome(sm.runtime.name, "expired",
+                                        sm.runtime.version)
                 else:
                     live.append(r)
             if not live:
@@ -322,35 +343,85 @@ class ModelServer:
                 _chaos.maybe_slow_request(sm.runtime.name)
             self._dispatch(sm, live)
 
+    def _route(self, sm: _ServedModel):
+        """Pick the runtime for THIS batch: the stable version, or —
+        while a reload is canarying — the new version for
+        ``canary_pct`` percent of batches (deterministic Bresenham
+        routing on a per-model counter, so tests and rollback evidence
+        are reproducible, not coin-flips)."""
+        with sm._lock:
+            canary = sm.canary
+            if canary is None:
+                return sm.runtime, False
+            sm._canary_seq += 1
+            seq = sm._canary_seq
+            pct = max(min(self.canary_pct, 100.0), 0.0)
+            take = int(seq * pct) // 100 > int((seq - 1) * pct) // 100
+            return (canary, True) if take else (sm.runtime, False)
+
     def _dispatch(self, sm: _ServedModel, live: List[Request]) -> None:
         import numpy as np
+
+        from .. import chaos as _chaos
 
         name = sm.runtime.name
         total = sum(r.n for r in live)
         with sm._lock:
             sm.inflight += total
         self._gauge_inflight(sm)
+        rt, is_canary = self._route(sm)
         t0 = time.monotonic()
         try:
             data = live[0].data if len(live) == 1 else \
                 np.concatenate([r.data for r in live], axis=0)
-            out = sm.runtime.execute(data)
+            if is_canary:
+                try:
+                    if _chaos.enabled() and _chaos.should_fail_version(
+                            name, rt.version):
+                        raise ExecutorFailure(
+                            "chaos bad_version injected for %r v%d"
+                            % (name, rt.version))
+                    out = rt.execute(data)
+                except Exception as ce:
+                    # the canary never hurts callers: record the strike
+                    # against the NEW version, then transparently
+                    # re-execute the batch on the stable version
+                    self._record_version_result(sm, rt.version,
+                                                ok=False)
+                    _log.warning(
+                        "serving: canary v%d batch for %r failed (%r) "
+                        "— re-executing on stable v%d", rt.version,
+                        name, ce, sm.runtime.version)
+                    rt, is_canary = sm.runtime, False
+                    out = rt.execute(data)
+                else:
+                    self._record_version_result(sm, rt.version, ok=True)
+            else:
+                out = rt.execute(data)
+                if sm.canary is not None:
+                    self._record_version_result(sm, rt.version, ok=True)
             batch_s = time.monotonic() - t0
-            self._split_results(live, out)
+            self._split_results(live, out, rt.version)
             sm.ewma_batch_s = 0.8 * sm.ewma_batch_s + 0.2 * batch_s
-            sm.breaker.on_success()
+            if not is_canary:
+                # only stable executions feed the breaker: a canary
+                # success must not reset strikes the stable version
+                # earned, and canary failures roll back, not trip
+                sm.breaker.on_success()
             with sm._lock:
                 sm.completed += len(live)
-            self._observe_batch(sm, live, total, batch_s)
+            self._observe_batch(sm, live, total, batch_s, rt.version)
         except Exception as e:
             err = e if isinstance(e, ExecutorFailure) else \
                 ExecutorFailure("dispatch for %r failed: %r"
                                 % (name, e))
             for r in live:
                 r.set_error(err)
-                self._count_outcome(name, "error")
+                self._count_outcome(name, "error", rt.version)
             with sm._lock:
                 sm.failed += len(live)
+            if sm.canary is not None and not is_canary:
+                self._record_version_result(sm, rt.version, ok=False)
             tripped = sm.breaker.on_failure()
             _log.warning("serving: batch of %d for %r failed: %r",
                          len(live), name, e)
@@ -360,8 +431,10 @@ class ModelServer:
             with sm._lock:
                 sm.inflight -= total
             self._gauge_inflight(sm)
+        self._maybe_decide_canary(sm)
 
-    def _split_results(self, live: List[Request], out) -> None:
+    def _split_results(self, live: List[Request], out,
+                       version: int) -> None:
         """Slice the batch output tree back into per-request results
         (row ranges in ride order)."""
         import jax
@@ -372,7 +445,7 @@ class ModelServer:
             r.set_result(jax.tree_util.tree_map(
                 lambda a: a[lo:hi], out))
             off = hi
-            self._count_outcome(r.model, "ok")
+            self._count_outcome(r.model, "ok", version)
             self._observe_latency(r)
 
     def _on_breaker_trip(self, sm: _ServedModel) -> None:
@@ -393,6 +466,194 @@ class ModelServer:
             self._count_rejected("breaker_open")
         self._gauge_breaker(sm)
         self._gauge_depth(sm)
+
+    # -- live reload: load -> compile+warm -> canary -> promote/rollback
+    def reload(self, model: str, directory: Optional[str] = None, *,
+               step: Optional[int] = None, runtime=None,
+               wait_s: Optional[float] = None) -> Dict[str, Any]:
+        """Zero-downtime model reload: load a NEW version of ``model``
+        from a (digest-verified) checkpoint directory, AOT-compile and
+        warm it in the background, canary ``canary_pct`` percent of
+        traffic through it, then atomically swap it in — or auto-roll-
+        back when its error rate exceeds the stable version's by
+        ``rollback_err_ratio``.  No admitted request is ever dropped:
+        queued and in-flight work is untouched by the swap, and a
+        failed canary batch transparently re-executes on the stable
+        version.
+
+        ``runtime`` bypasses the checkpoint load with a prebuilt
+        runtime (tests / in-process weight pushes).  ``wait_s`` blocks
+        until the reload reaches a terminal state.  Returns the reload
+        state dict (a snapshot; poll :meth:`reload_status`)."""
+        sm = self._get(model)
+        with sm._lock:
+            if sm.reload_state.get("state") in ("loading", "canary"):
+                raise Rejected(
+                    "reload_in_progress",
+                    "model %r is already reloading (%s)"
+                    % (model, sm.reload_state))
+            new_version = sm.runtime.version + 1
+            sm.reload_state = {
+                "state": "loading", "model": model,
+                "from_version": sm.runtime.version,
+                "to_version": new_version,
+                "directory": directory, "started_ts": time.monotonic(),
+            }
+            sm.reload_thread = threading.Thread(
+                target=self._reload_worker,
+                args=(sm, directory, step, runtime, new_version),
+                daemon=True, name="mx-serve-reload-%s" % model)
+            sm.reload_thread.start()
+        if wait_s is not None:
+            return self.wait_reload(model, wait_s)
+        return self.reload_status(model)
+
+    def _reload_worker(self, sm: _ServedModel, directory, step,
+                       runtime, new_version: int) -> None:
+        name = sm.runtime.name
+        try:
+            rt = runtime if runtime is not None else \
+                sm.runtime.successor_from_checkpoint(directory,
+                                                     step=step)
+            if tuple(rt.sample_shape) != tuple(sm.runtime.sample_shape):
+                raise ValueError(
+                    "new version's sample shape %s != serving shape %s"
+                    % (rt.sample_shape, sm.runtime.sample_shape))
+            rt.version = new_version
+            if hasattr(rt, "compile") and not rt.compiled:
+                rt.compile(warmup=True)  # first canary batch pays zero
+        except Exception as e:
+            # fail CLOSED: the stable version keeps serving untouched —
+            # a corrupt checkpoint (CheckpointCorrupt names the shard)
+            # or a compile failure never degrades live traffic
+            with sm._lock:
+                sm.reload_state.update(state="failed", error=repr(e))
+            self._count_reload(name, "failed")
+            _log.error("serving: reload of %r -> v%d FAILED (stable "
+                       "v%d keeps serving): %r", name, new_version,
+                       sm.runtime.version, e)
+            return
+        with sm._lock:
+            sm._vstats = {}
+            sm._canary_seq = 0
+            if self.canary_pct <= 0:
+                self._promote_locked(sm, rt, skipped_canary=True)
+                return
+            sm.canary = rt
+            sm.reload_state.update(state="canary")
+        _log.warning(
+            "serving: reload of %r — v%d compiled + warm, canarying "
+            "%.0f%% of batches (decision after %d canary batches, "
+            "rollback if err rate > stable x %.1f)", name, new_version,
+            self.canary_pct, self.canary_min_n, self.rollback_err_ratio)
+
+    def _record_version_result(self, sm: _ServedModel, version: int,
+                               ok: bool) -> None:
+        with sm._lock:
+            st = sm._vstats.setdefault(version, {"n": 0, "errors": 0})
+            st["n"] += 1
+            if not ok:
+                st["errors"] += 1
+
+    def _maybe_decide_canary(self, sm: _ServedModel) -> None:
+        """Promote or roll back once the canary window holds
+        ``canary_min_n`` batches: roll back when the new version's
+        error rate exceeds the stable version's (over the SAME window)
+        times ``rollback_err_ratio`` — a canary that errors while
+        stable is clean always rolls back."""
+        with sm._lock:
+            rt = sm.canary
+            if rt is None:
+                return
+            cs = dict(sm._vstats.get(rt.version, {"n": 0, "errors": 0}))
+            ss = dict(sm._vstats.get(sm.runtime.version,
+                                     {"n": 0, "errors": 0}))
+            if cs["n"] < self.canary_min_n:
+                return
+            err_new = cs["errors"] / max(cs["n"], 1)
+            err_old = ss["errors"] / max(ss["n"], 1)
+            if err_new > err_old * self.rollback_err_ratio or \
+                    (err_new > 0 and err_old == 0):
+                self._rollback_locked(sm, rt, cs, ss)
+            else:
+                self._promote_locked(sm, rt, canary_stats=cs,
+                                     stable_stats=ss)
+
+    def _promote_locked(self, sm: _ServedModel, rt,
+                        skipped_canary: bool = False,
+                        canary_stats=None, stable_stats=None) -> None:
+        """Atomic swap (caller holds sm._lock): future batches execute
+        on the new version; queued requests and the batch in flight are
+        untouched, so zero admitted requests are dropped."""
+        old_v = sm.runtime.version
+        sm.runtime = rt
+        sm.canary = None
+        sm.reload_state.update(
+            state="promoted", skipped_canary=skipped_canary,
+            canary_stats=canary_stats, stable_stats=stable_stats,
+            swap_s=round(time.monotonic() -
+                         sm.reload_state.get("started_ts", 0.0), 3))
+        self._count_reload(rt.name, "promoted")
+        _log.warning(
+            "serving: PROMOTED %r v%d -> v%d (%s) — hot swap, zero "
+            "admitted requests dropped", rt.name, old_v, rt.version,
+            "canary skipped (pct=0)" if skipped_canary else
+            "canary clean: %s vs stable %s" % (canary_stats,
+                                               stable_stats))
+
+    def _rollback_locked(self, sm: _ServedModel, rt, cs, ss) -> None:
+        sm.canary = None
+        sm.reload_state.update(state="rolled_back", canary_stats=cs,
+                               stable_stats=ss)
+        self._count_reload(rt.name, "rolled_back")
+        try:
+            from .. import diagnostics as _diag
+
+            _diag.metrics.counter(
+                "mxnet_serve_rollbacks_total",
+                help="canaried reloads auto-rolled-back",
+                labels={"model": rt.name}).inc()
+        except Exception:
+            pass
+        _log.error(
+            "serving: ROLLED BACK %r v%d — canary error rate %.3f "
+            "(%d/%d) vs stable v%d %.3f (%d/%d) exceeded ratio %.1f; "
+            "stable keeps serving, zero admitted requests dropped",
+            rt.name, rt.version, cs["errors"] / max(cs["n"], 1),
+            cs["errors"], cs["n"], sm.runtime.version,
+            ss["errors"] / max(ss["n"], 1), ss["errors"], ss["n"],
+            self.rollback_err_ratio)
+
+    def reload_status(self, model: str) -> Dict[str, Any]:
+        sm = self._get(model)
+        with sm._lock:
+            return dict(sm.reload_state)
+
+    def wait_reload(self, model: str,
+                    timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Poll until the reload reaches a terminal state (promoted /
+        rolled_back / failed) or the timeout passes (returns the
+        current state either way — a canary with no traffic flowing
+        stays in 'canary')."""
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            st = self.reload_status(model)
+            if st.get("state") in ("promoted", "rolled_back", "failed",
+                                   "idle"):
+                return st
+            time.sleep(0.01)
+        return self.reload_status(model)
+
+    def _count_reload(self, model: str, outcome: str) -> None:
+        try:
+            from .. import diagnostics as _diag
+
+            _diag.metrics.counter(
+                "mxnet_serve_reloads_total",
+                help="live reload attempts by terminal outcome",
+                labels={"model": model, "outcome": outcome}).inc()
+        except Exception:
+            pass
 
     # -- drain + probes -----------------------------------------------
     def drain(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
@@ -486,16 +747,27 @@ class ModelServer:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             models = dict(self._models)
-        return {name: {
-            "queue_depth": sm.queue.depth(),
-            "inflight": sm.inflight,
-            "completed": sm.completed,
-            "failed": sm.failed,
-            "breaker": sm.breaker.state(),
-            "ewma_batch_ms": round(sm.ewma_batch_s * 1e3, 3),
-            "buckets": list(getattr(sm.runtime, "plan", ())),
-            "compiled": sm.runtime.compiled,
-        } for name, sm in models.items()}
+        out = {}
+        for name, sm in models.items():
+            # snapshot: a canary decision on the worker thread may null
+            # sm.canary between a check and an attribute access
+            canary = sm.canary
+            out[name] = {
+                "queue_depth": sm.queue.depth(),
+                "inflight": sm.inflight,
+                "completed": sm.completed,
+                "failed": sm.failed,
+                "breaker": sm.breaker.state(),
+                "ewma_batch_ms": round(sm.ewma_batch_s * 1e3, 3),
+                "buckets": list(getattr(sm.runtime, "plan", ())),
+                "compiled": sm.runtime.compiled,
+                "version": sm.runtime.version,
+                "source": getattr(sm.runtime, "source", None),
+                "canary_version": canary.version
+                if canary is not None else None,
+                "reload": dict(sm.reload_state),
+            }
+        return out
 
     # -- metrics feeds (all guarded: telemetry never fails serving) ----
     def _count_rejected(self, reason: str) -> None:
@@ -509,14 +781,22 @@ class ModelServer:
         except Exception:
             pass
 
-    def _count_outcome(self, model: str, outcome: str) -> None:
+    def _version_of(self, model: str) -> Optional[int]:
+        with self._lock:
+            sm = self._models.get(model)
+        return sm.runtime.version if sm is not None else None
+
+    def _count_outcome(self, model: str, outcome: str,
+                       version: Optional[int] = None) -> None:
         try:
             from .. import diagnostics as _diag
 
             _diag.metrics.counter(
                 "mxnet_serve_requests_total",
                 help="admitted requests by final outcome",
-                labels={"model": model, "outcome": outcome}).inc()
+                labels={"model": model, "outcome": outcome,
+                        "version": "v%d" % version if version
+                        else "unknown"}).inc()
         except Exception:
             pass
 
@@ -534,7 +814,8 @@ class ModelServer:
             pass
 
     def _observe_batch(self, sm: _ServedModel, live: List[Request],
-                       total: int, batch_s: float) -> None:
+                       total: int, batch_s: float,
+                       version: Optional[int] = None) -> None:
         try:
             from .. import diagnostics as _diag
 
@@ -543,7 +824,10 @@ class ModelServer:
                 if hasattr(sm.runtime, "bucket_for") else total
             _diag.metrics.counter(
                 "mxnet_serve_batches_total",
-                help="dispatched batches", labels={"model": name}).inc()
+                help="dispatched batches",
+                labels={"model": name,
+                        "version": "v%d" % version if version
+                        else "unknown"}).inc()
             _diag.metrics.histogram(
                 "mxnet_serve_batch_size",
                 help="samples per dispatched batch",

@@ -1,0 +1,118 @@
+"""Telemetry-artifact hygiene: dumps land under MXNET_DUMP_DIR (or an
+explicit path), NEVER as repo-root litter.
+
+The stray ``flightrecorder_rank0.json`` this PR deleted came from the
+SIGTERM handler: unlike the atexit leg it dumped UNCONDITIONALLY, so a
+SIGTERM'd process that never issued a collective (a serving demo, the
+PS scheduler) wrote an empty-ring artifact into its CWD.  These tests
+pin the fix (empty rings never dump on SIGTERM) without losing the
+evidence contract (non-empty rings still do), and a repo-root scan
+guards the whole suite against any writer regressing to CWD litter.
+"""
+import json
+import glob
+import os
+import signal
+import subprocess
+import sys
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_ARTIFACT_PATTERNS = ("flightrecorder_rank*", "profile_rank*",
+                      "profile_merged*", "metrics*.prom")
+
+
+def _child_env(extra=None, drop_dump_dir=False):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    if drop_dump_dir:
+        # the litter scenario: a bare process run outside the test
+        # harness, where nothing routed relative dumps away from CWD
+        env.pop("MXNET_DUMP_DIR", None)
+    env.update(extra or {})
+    return env
+
+
+_SIGTERM_WORKER = r"""
+import os, signal, sys
+from mxnet_tpu import diagnostics as diag
+
+diag.register_preemption_hook(lambda: None, key="hygiene-test")
+if len(sys.argv) > 1 and sys.argv[1] == "record":
+    s = diag.recorder.start("allreduce", keys=["w0"], nbytes=64)
+    diag.recorder.complete(s)
+print("READY", flush=True)
+os.kill(os.getpid(), signal.SIGTERM)
+"""
+
+
+def _scan(directory):
+    found = []
+    for pat in _ARTIFACT_PATTERNS:
+        found.extend(glob.glob(os.path.join(directory, pat)))
+    return found
+
+
+def test_repo_root_has_no_telemetry_artifacts():
+    """Tier-1 guard: whenever this runs, the repo root must hold no
+    flightrecorder/profile debris — any hit means some writer bypassed
+    the MXNET_DUMP_DIR routing (the bug behind the deleted stray
+    flightrecorder_rank0.json)."""
+    found = _scan(ROOT)
+    assert not found, (
+        "telemetry artifacts littered the repo root (a writer bypassed "
+        "MXNET_DUMP_DIR): %s" % found)
+
+
+def test_sigterm_with_empty_ring_leaves_no_cwd_artifact(tmp_path):
+    """A SIGTERM'd process that never recorded a collective must NOT
+    dump an empty flight ring into its CWD (the empty-ring guard the
+    atexit leg always had, now shared by the signal path)."""
+    cwd = str(tmp_path / "workdir")
+    os.makedirs(cwd)
+    res = subprocess.run(
+        [sys.executable, "-c", _SIGTERM_WORKER],
+        capture_output=True, text=True, timeout=120,
+        env=_child_env(drop_dump_dir=True), cwd=cwd)
+    assert res.returncode == 83, (res.returncode, res.stderr)
+    assert _scan(cwd) == [], os.listdir(cwd)
+
+
+def test_sigterm_with_recorded_collective_still_dumps(tmp_path):
+    """The evidence contract survives the guard: a ring that DID
+    record dumps on SIGTERM — into MXNET_DUMP_DIR, not the CWD."""
+    cwd = str(tmp_path / "workdir")
+    dumps = str(tmp_path / "dumps")
+    os.makedirs(cwd)
+    res = subprocess.run(
+        [sys.executable, "-c", _SIGTERM_WORKER, "record"],
+        capture_output=True, text=True, timeout=120,
+        env=_child_env({"MXNET_DUMP_DIR": dumps}), cwd=cwd)
+    assert res.returncode == 83, (res.returncode, res.stderr)
+    assert _scan(cwd) == [], os.listdir(cwd)
+    dumped = glob.glob(os.path.join(dumps, "flightrecorder_rank*"))
+    assert len(dumped) == 1, dumped
+    with open(dumped[0]) as f:
+        payload = json.load(f)
+    assert payload["header"]["reason"] == "SIGTERM"
+    assert len(payload["entries"]) == 1
+
+
+def test_sigterm_empty_ring_unrouted_cwd_stays_clean_even_with_dir_unset(
+        tmp_path):
+    """Belt and braces for the exact stray-file scenario: no
+    MXNET_DUMP_DIR, no collectives, SIGTERM — the CWD (stand-in for
+    the repo root) stays clean AND the process still exits 83 through
+    the preemption hooks."""
+    cwd = str(tmp_path / "repo_root_standin")
+    os.makedirs(cwd)
+    res = subprocess.run(
+        [sys.executable, "-c", _SIGTERM_WORKER],
+        capture_output=True, text=True, timeout=120,
+        env=_child_env(drop_dump_dir=True), cwd=cwd)
+    assert res.returncode == 83, (res.returncode, res.stderr)
+    assert os.listdir(cwd) == [], os.listdir(cwd)

@@ -254,6 +254,313 @@ def test_ckpt_gc_janitor_vs_async_writer_stress(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# checkpoint integrity: manifests, digests, verified fallback, CLI
+# ---------------------------------------------------------------------
+def _corrupt(path, offset=40, junk=b"\xde\xad\xbe\xef"):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        f.write(junk)
+
+
+def test_manifest_written_with_digests_and_tree(tmp_path):
+    d = str(tmp_path / "ck")
+    params = {"w": np.arange(6, dtype="f4").reshape(2, 3)}
+    ckpt.save_checkpoint(d, 2, params=params)
+    man = ckpt.read_manifest(d, 2)
+    assert man is not None and man["manifest_version"] >= 1
+    assert man["num_ranks"] == 1 and man["step"] == 2
+    sh = man["shards"]["0"]
+    assert sh["path"] == "rank0.ckpt" and sh["bytes"] > 0
+    assert len(sh["sha256"]) == 64
+    assert man["tree"]["params"]["w"]["shape"] == [2, 3]
+    assert man["tree"]["params"]["w"]["dtype"] == "float32"
+    rep = ckpt.verify_step(d, 2)
+    assert rep["verified"] and not rep["corrupt"]
+
+
+def test_verify_cli_audits_directory(tmp_path):
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, 2, params={"w": np.ones(32, "f4")})
+    ckpt.save_checkpoint(d, 4, params={"w": np.ones(32, "f4") * 2})
+    res = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.checkpoint", "--verify", d,
+         "--json"],
+        capture_output=True, text=True, env=_child_env(), cwd=ROOT)
+    assert res.returncode == 0, res.stdout + res.stderr
+    rep = json.loads(res.stdout.splitlines()[-1])
+    assert rep["ok"] and rep["n_verified"] == 2
+    # a flipped byte fails the audit NAMING the corrupt shard
+    _corrupt(ckpt.shard_path(d, 4, 0))
+    res = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.checkpoint", "--verify", d,
+         "--json"],
+        capture_output=True, text=True, env=_child_env(), cwd=ROOT)
+    assert res.returncode == 1, res.stdout + res.stderr
+    rep = json.loads(res.stdout.splitlines()[-1])
+    assert not rep["ok"] and rep["n_corrupt"] == 1
+    bad = [s for s in rep["steps"] if s["step"] == 4][0]
+    assert bad["corrupt"] == ["rank0.ckpt"], bad
+
+
+def test_load_falls_back_to_newest_verified_step(tmp_path, caplog):
+    """Tentpole: a corrupt newest step is named and skipped; the load
+    returns the newest VERIFIED step, bit-identical to loading that
+    step explicitly (the fallback substitutes nothing else)."""
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, 2, params={"w": np.arange(16, dtype="f4")})
+    ckpt.save_checkpoint(d, 4, params={"w": np.arange(16, dtype="f4") * 3})
+    _corrupt(ckpt.shard_path(d, 4, 0))
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.checkpoint"):
+        payload = ckpt.load_checkpoint(d, rank=0, num_ranks=1)
+    assert payload["step"] == 2
+    control = ckpt.load_checkpoint(d, step=2, rank=0, num_ranks=1)
+    np.testing.assert_array_equal(payload["params"]["w"],
+                                  control["params"]["w"])
+    text = " ".join(r.getMessage() for r in caplog.records)
+    assert "rank0.ckpt" in text and "falling back" in text, text
+
+
+def test_explicit_step_corrupt_fails_fast(tmp_path):
+    """Satellite: an explicitly requested step (resume_from pointing at
+    a step dir included) NEVER silently substitutes another one."""
+    d = str(tmp_path / "ck")
+    ckpt.save_checkpoint(d, 2, params={"w": np.ones(8, "f4")})
+    ckpt.save_checkpoint(d, 4, params={"w": np.ones(8, "f4")})
+    _corrupt(ckpt.shard_path(d, 4, 0))
+    with pytest.raises(ckpt.CheckpointCorrupt) as ei:
+        ckpt.load_checkpoint(d, step=4, rank=0, num_ranks=1)
+    assert "rank0.ckpt" in str(ei.value)
+    # the step-dir spelling of resume_from is the same explicit path
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.load_checkpoint(ckpt.step_dir(d, 4), rank=0, num_ranks=1)
+    # and Module.fit(resume_from=<step dir>) surfaces it, not a resume
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        _fit(resume_from=ckpt.step_dir(d, 4))
+    # verify=False opts out (documented escape hatch)
+    payload = ckpt.load_checkpoint(d, step=2, rank=0, num_ranks=1,
+                                   verify=False)
+    assert payload["step"] == 2
+
+
+def test_keep1_newest_corrupt_names_shard_clearly(tmp_path):
+    """Satellite edge case: MXNET_CKPT_KEEP=1 leaves ONE step; when it
+    is corrupt the fallback has nothing verified — the error must name
+    the corrupt shard, not claim the checkpoint is missing."""
+    d = str(tmp_path / "ck")
+    mgr = ckpt.CheckpointManager(d, keep=1, async_write=False, rank=0,
+                                 num_ranks=1)
+    mgr.save(2, params={"w": np.ones(8, "f4")})
+    mgr.save(4, params={"w": np.ones(8, "f4")})
+    assert ckpt.list_steps(d) == [4]  # keep=1 dropped step 2
+    _corrupt(ckpt.shard_path(d, 4, 0))
+    with pytest.raises(ckpt.CheckpointCorrupt) as ei:
+        ckpt.load_checkpoint(d, rank=0, num_ranks=1)
+    msg = str(ei.value)
+    assert "rank0.ckpt" in msg and "no verified checkpoint" in msg, msg
+
+
+def test_chaos_corrupt_shard_fallback_e2e(tmp_path, monkeypatch):
+    """Acceptance e2e: chaos 'corrupt_shard' flips bytes in the newest
+    step's landed shard during a checkpointed fit; the resume falls
+    back to the previous VERIFIED step and bitwise-matches a control
+    resumed from that step explicitly."""
+    from mxnet_tpu import chaos
+
+    d = str(tmp_path / "ck")
+    # steps 2,4,6 land; the step-6 shard is corrupted ON DISK by chaos
+    # right after its (true) digest went into the manifest
+    monkeypatch.setenv("MXNET_CHAOS", "corrupt_shard:step=6,rank=0")
+    chaos.reset()
+    try:
+        _fit(checkpoint_every_n=2, checkpoint_dir=d)
+        assert chaos.injected_total("corrupt_shard") == 1, \
+            "the corruption never fired"
+    finally:
+        monkeypatch.delenv("MXNET_CHAOS")
+        chaos.reset()
+    assert ckpt.list_steps(d) == [2, 4, 6]
+    assert not ckpt.verify_step(d, 6)["verified"]
+    assert ckpt.verify_step(d, 4)["verified"]
+    # resume (newest): silently skips corrupt step 6, resumes from 4
+    resumed = _fit(resume_from=d)
+    # control: resume explicitly from the verified step 4
+    control = _fit(resume_from=ckpt.step_dir(d, 4))
+    assert sorted(resumed) == sorted(control)
+    for k in control:
+        np.testing.assert_array_equal(resumed[k].asnumpy(),
+                                      control[k].asnumpy())
+
+
+def test_janitor_never_deletes_step_being_verified(tmp_path):
+    """Satellite stress: the retention janitor (keep=1) races readers
+    that digest-verify every load.  The manifest/tombstone/pin barrier
+    must guarantee a reader NEVER sees a half-deleted step as corrupt
+    — every load either verifies clean or reports the step gone."""
+    d = str(tmp_path / "race")
+    m0 = ckpt.CheckpointManager(d, keep=1, async_write=False, rank=0,
+                                num_ranks=1)
+    params = {"w": np.arange(512, dtype="f4")}
+    stop = threading.Event()
+    problems = []
+    n_loads = [0]
+
+    def reader():
+        while not stop.is_set():
+            try:
+                payload = ckpt.load_checkpoint(d, rank=0, num_ranks=1)
+                n_loads[0] += 1
+                if payload["params"]["w"].shape != (512,):
+                    problems.append("bad payload at step %r"
+                                    % payload["step"])
+            except FileNotFoundError:
+                pass  # GC advanced past us: legitimate
+            except ckpt.CheckpointCorrupt as e:
+                # the bug this test exists to catch: a half-deleted
+                # step misreported as corruption
+                problems.append("spurious corruption: %s" % e)
+            except Exception as e:
+                problems.append(repr(e))
+
+    threads = [threading.Thread(target=reader, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for step in range(1, 40):
+            m0.save(step, params=params)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+    assert not problems, problems[:5]
+    assert n_loads[0] > 0, "the readers never overlapped the janitor"
+    assert ckpt.latest_step(d, num_ranks=1) == 39
+
+
+# ---------------------------------------------------------------------
+# elastic resume: W-rank checkpoints load on W'-rank fleets
+# ---------------------------------------------------------------------
+def test_elastic_load_reshards_deterministically(tmp_path):
+    d = str(tmp_path / "ck2")
+    for r in (0, 1):
+        ckpt.CheckpointManager(d, rank=r, num_ranks=2,
+                               async_write=False).save(
+            4, params={"w": np.full(4, r, "f4")}, epoch=1, nbatch=1,
+            optimizer_states=b"momenta" if r == 0 else None,
+            iterator_state={"cursor": 4, "batch_size": 4})
+    # W=2 -> W'=1: rank 0 reads source shard 0 (momenta included)
+    p = ckpt.load_checkpoint(d, rank=0, num_ranks=1)
+    el = p["elastic"]
+    assert (el["from_num_ranks"], el["to_num_ranks"]) == (2, 1)
+    assert el["source_rank"] == 0 and p["optimizer_states"] == b"momenta"
+    # global sample position invariant: 1 batch x 4/rank x 2 ranks = 8
+    # samples -> 1 global batch of 8, or 2 of 4, on the single rank
+    assert ckpt.scale_resume_skip(p, 8) == 1
+    assert ckpt.scale_resume_skip(p, 4) == 2
+    # W=2 -> W'=3: ranks wrap deterministically (r % W)
+    p2 = ckpt.load_checkpoint(d, rank=2, num_ranks=3)
+    assert p2["elastic"]["source_rank"] == 0
+    np.testing.assert_array_equal(p2["params"]["w"], 0)
+    # W == W': no elastic marker, the bitwise contract path
+    same = ckpt.load_checkpoint(d, rank=1, num_ranks=2)
+    assert "elastic" not in same
+    np.testing.assert_array_equal(same["params"]["w"], 1)
+
+
+def _combined_iter(batch_size=8):
+    """The two ft_worker ranks' per-rank streams interleaved per step:
+    global batch i = rank0's batch i ++ rank1's batch i — what a
+    single-rank fleet must consume to replay the SAME global batch
+    sequence the 2-rank fleet trained on."""
+    streams = []
+    for rank in (0, 1):
+        rng = np.random.RandomState(100 + rank)
+        x = rng.randn(12, 6).astype(np.float32)
+        y = rng.randint(0, 4, (12,)).astype(np.float32)
+        streams.append((x, y))
+    xs, ys = [], []
+    for i in range(3):
+        for rank in (0, 1):
+            xs.append(streams[rank][0][i * 4:(i + 1) * 4])
+            ys.append(streams[rank][1][i * 4:(i + 1) * 4])
+    return mx.io.NDArrayIter(np.concatenate(xs), np.concatenate(ys),
+                             batch_size=batch_size, shuffle=False)
+
+
+def _ft_mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=8)
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=4)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_elastic_resume_across_world_sizes_e2e(tmp_path):
+    """Acceptance: a 2-rank checkpoint resumes on 1 rank (and a 1-rank
+    checkpoint resumes on 2 ranks) with final params matching the
+    2-rank control at ~1e-7 on the CPU mesh — the global batch
+    sequence is preserved (per-rank batch x world size invariant), so
+    only summation order differs."""
+    import launch as _launch
+
+    base_env = {"MXNET_CKPT_ASYNC": "0", "MXNET_CKPT_KEEP": "0",
+                "MXNET_DUMP_DIR": str(tmp_path / "dumps")}
+    ck2 = str(tmp_path / "ck2rank")
+
+    # 2-rank control: uninterrupted, checkpoints every 2 steps (kept)
+    codes = _launch.launch_local(
+        2, 1, [sys.executable, _FT_WORKER, "control", ck2,
+               str(tmp_path / "control")],
+        env=_child_env(base_env))
+    assert codes == [0, 0], codes
+    control = {r: np.load(str(tmp_path / ("control_rank%d.npz" % r)))
+               for r in (0, 1)}
+    assert ckpt.read_manifest(ck2, 4) is not None \
+        and ckpt.read_manifest(ck2, 4)["num_ranks"] == 2
+
+    def _fit_combined(**kw):
+        np.random.seed(0)
+        mx.random.seed(0)
+        mod = mx.mod.Module(symbol=_ft_mlp(), context=mx.cpu())
+        mod.fit(_combined_iter(), optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                  "rescale_grad": 1.0, "wd": 0.0},
+                num_epoch=2, **kw)
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    # (a) 2 -> 1: resume in-process from the 2-rank step-4 shard with
+    # the combined global-batch stream; finals match the 2-rank control
+    resumed = _fit_combined(resume_from=ckpt.step_dir(ck2, 4))
+    for k in control[0].files:
+        np.testing.assert_allclose(
+            resumed[k], control[0][k], rtol=2e-6, atol=1e-7,
+            err_msg="2->1 elastic resume diverged on %s" % k)
+
+    # (b) 1 -> 2: a 1-rank run checkpoints the same global stream;
+    # a 2-worker fleet elastically resumes its step-4 and must also
+    # match the 2-rank control
+    ck1 = str(tmp_path / "ck1rank")
+    _fit_combined(checkpoint_every_n=2, checkpoint_dir=ck1)
+    import shutil
+
+    shutil.rmtree(ckpt.step_dir(ck1, 6))  # pretend it died after step 4
+    codes = _launch.launch_local(
+        2, 1, [sys.executable, _FT_WORKER, "resume", ck1,
+               str(tmp_path / "elastic2")],
+        env=_child_env(base_env))
+    assert codes == [0, 0], codes
+    for r in (0, 1):
+        resumed2 = np.load(str(tmp_path / ("elastic2_rank%d.npz" % r)))
+        for k in control[r].files:
+            np.testing.assert_allclose(
+                resumed2[k], control[r][k], rtol=2e-6, atol=1e-7,
+                err_msg="1->2 elastic resume diverged on rank %d %s"
+                        % (r, k))
+
+
+# ---------------------------------------------------------------------
 # exact resume (single process; the dist version is the e2e below)
 # ---------------------------------------------------------------------
 def _mlp():

@@ -485,5 +485,187 @@ def test_serving_latency_quantiles_in_prom():
     assert not diag.validate_prom_text(text)
     assert "mxnet_serve_latency_seconds_p50" in text
     assert "mxnet_serve_latency_seconds_p99" in text
-    assert 'mxnet_serve_requests_total{model="demo",outcome="ok"}' \
-        in text
+    # outcome counters carry the serving VERSION label (the reload
+    # tentpole: a scraper can split error rates per model version)
+    assert ('mxnet_serve_requests_total{model="demo",outcome="ok",'
+            'version="v1"}') in text
+
+
+# ---------------------------------------------------------------------
+# live reload: hot swap, canary rollback, fail-closed (the tentpole)
+# ---------------------------------------------------------------------
+def _drive_until_terminal(srv, model, x, timeout_s=30.0):
+    """Keep traffic flowing until the reload decision lands; returns
+    (terminal_state, n_ok, n_failed) — the zero-drop accounting."""
+    n_ok = n_failed = 0
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            srv.predict(model, x)
+            n_ok += 1
+        except Exception:
+            n_failed += 1
+        st = srv.reload_status(model)
+        if st["state"] in ("promoted", "rolled_back", "failed"):
+            return st, n_ok, n_failed
+    return srv.reload_status(model), n_ok, n_failed
+
+
+def test_reload_hot_swap_promotes_with_zero_drop(tmp_path):
+    """A new version loads from a digest-verified checkpoint, warms in
+    the background, canaries, promotes — and every request submitted
+    during the swap is answered (zero admitted dropped)."""
+    d = str(tmp_path / "v2ckpt")
+    ckpt.save_checkpoint(d, 3, params=serving.demo_params(seed=9))
+    rt = serving.demo_runtime(max_batch=4, seed=0)
+    srv = serving.ModelServer(max_batch=4, queue_max=64,
+                              batch_deadline_ms=1, canary_pct=50,
+                              canary_min_n=4)
+    srv.add_model(rt)
+    x = np.random.RandomState(0).randn(1, 16).astype("float32")
+    before = srv.predict("demo", x)[1]
+    srv.reload("demo", d)
+    st, n_ok, n_failed = _drive_until_terminal(srv, "demo", x)
+    assert st["state"] == "promoted", st
+    assert n_failed == 0 and n_ok > 0, (n_ok, n_failed)
+    assert st["canary_stats"]["errors"] == 0
+    # the server now answers from the NEW weights
+    v2 = serving.demo_runtime(max_batch=4, seed=9)
+    v2.compile(warmup=False)
+    want = np.float64(np.asarray(v2.execute(x)[1]))
+    got = np.float64(np.asarray(srv.predict("demo", x)[1]))
+    assert np.allclose(got, want), "post-swap output is not v2's"
+    assert not np.allclose(got, np.float64(np.asarray(before)))
+    assert srv.stats()["demo"]["version"] == 2
+    # reloads are counted by terminal outcome
+    assert diag.metrics.counter(
+        "mxnet_serve_reloads_total",
+        labels={"model": "demo", "outcome": "promoted"}).value >= 1
+    srv.drain(timeout_s=5.0)
+
+
+def test_reload_bad_version_rolls_back_e2e(tmp_path, monkeypatch):
+    """Acceptance e2e: chaos 'bad_version' makes every canary batch of
+    the new version fail — the server auto-rolls-back with ZERO
+    admitted requests dropped (failed canary batches re-execute on the
+    stable version) and mxnet_serve_rollbacks_total increments."""
+    d = str(tmp_path / "v2ckpt")
+    ckpt.save_checkpoint(d, 3, params=serving.demo_params(seed=9))
+    rt = serving.demo_runtime(max_batch=4, seed=0)
+    srv = serving.ModelServer(max_batch=4, queue_max=64,
+                              batch_deadline_ms=1, canary_pct=50,
+                              canary_min_n=4)
+    srv.add_model(rt)
+    x = np.random.RandomState(1).randn(1, 16).astype("float32")
+    stable_out = np.float64(np.asarray(srv.predict("demo", x)[1]))
+    rb_before = diag.metrics.counter(
+        "mxnet_serve_rollbacks_total", labels={"model": "demo"}).value
+    monkeypatch.setenv("MXNET_CHAOS",
+                       "bad_version:model=demo,count=100000")
+    chaos.reset()
+    try:
+        srv.reload("demo", d)
+        st, n_ok, n_failed = _drive_until_terminal(srv, "demo", x)
+        injected = chaos.injected_total("bad_version")
+    finally:
+        monkeypatch.delenv("MXNET_CHAOS")
+        chaos.reset()
+    assert st["state"] == "rolled_back", st
+    assert injected > 0, "the bad_version fault never fired"
+    # zero admitted dropped: every request during the canary answered OK
+    assert n_failed == 0 and n_ok > 0, (n_ok, n_failed)
+    assert st["canary_stats"]["errors"] >= 4
+    assert diag.metrics.counter(
+        "mxnet_serve_rollbacks_total",
+        labels={"model": "demo"}).value == rb_before + 1
+    # stable version keeps serving, bit-identical to before the canary
+    after = np.float64(np.asarray(srv.predict("demo", x)[1]))
+    assert np.allclose(after, stable_out)
+    assert srv.stats()["demo"]["version"] == 1
+    assert srv.stats()["demo"]["canary_version"] is None
+    srv.drain(timeout_s=5.0)
+
+
+def test_reload_corrupt_checkpoint_fails_closed(tmp_path):
+    """Integrity meets serving: a reload pointed at a corrupt
+    checkpoint FAILS (naming the shard) and the stable version keeps
+    serving untouched — the bad bytes never reach traffic."""
+    d = str(tmp_path / "badckpt")
+    ckpt.save_checkpoint(d, 3, params=serving.demo_params(seed=9))
+    with open(ckpt.shard_path(d, 3, 0), "r+b") as f:
+        f.seek(50)
+        f.write(b"\x00\x01\x02\x03")
+    rt = serving.demo_runtime(max_batch=4, seed=0)
+    srv = serving.ModelServer(max_batch=4, queue_max=16,
+                              batch_deadline_ms=1)
+    srv.add_model(rt)
+    x = np.zeros((1, 16), dtype="float32")
+    st = srv.reload("demo", d, wait_s=30.0)
+    assert st["state"] == "failed", st
+    assert "rank0.ckpt" in str(st.get("error", "")), st
+    assert srv.predict("demo", x)[0].shape == (1,)
+    assert srv.stats()["demo"]["version"] == 1
+    # a second reload attempt is allowed after a failed one
+    assert srv.reload_status("demo")["state"] == "failed"
+    srv.drain(timeout_s=5.0)
+
+
+def test_reload_in_progress_rejected(tmp_path):
+    d = str(tmp_path / "v2ckpt")
+    ckpt.save_checkpoint(d, 3, params=serving.demo_params(seed=9))
+    rt = serving.demo_runtime(max_batch=4, seed=0)
+    srv = serving.ModelServer(max_batch=4, queue_max=16,
+                              batch_deadline_ms=1, canary_pct=50,
+                              canary_min_n=4)
+    srv.add_model(rt)
+    srv.reload("demo", d)  # no traffic -> sits in loading/canary
+    with pytest.raises(serving.Rejected) as ei:
+        srv.reload("demo", d)
+    assert ei.value.reason == "reload_in_progress"
+    # finish it so drain is clean
+    x = np.zeros((1, 16), dtype="float32")
+    st, _, _ = _drive_until_terminal(srv, "demo", x)
+    assert st["state"] == "promoted"
+    srv.drain(timeout_s=5.0)
+
+
+def test_http_reload_route(tmp_path):
+    """POST /v1/models/<name>:reload kicks the zero-downtime reload;
+    the stats route exposes the reload state machine."""
+    d = str(tmp_path / "v2ckpt")
+    ckpt.save_checkpoint(d, 3, params=serving.demo_params(seed=9))
+    rt = serving.demo_runtime(max_batch=4, seed=0)
+    srv = serving.ModelServer(max_batch=4, queue_max=16,
+                              batch_deadline_ms=1, canary_pct=0)
+    srv.add_model(rt)
+    fe = serving.HttpFrontend(srv, port=0)
+    host, port = fe.start()
+    base = "http://%s:%d" % (host, port)
+    try:
+        req = urllib.request.Request(
+            base + "/v1/models/demo:reload",
+            data=json.dumps({"directory": d, "wait_s": 30}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req)
+        body = json.loads(resp.read())
+        # canary_pct=0: promoted as soon as compiled+warm (no traffic
+        # needed), waited to terminal -> 200
+        assert resp.status == 200, body
+        assert body["reload"]["state"] == "promoted", body
+        stats = json.loads(urllib.request.urlopen(
+            base + "/stats").read())
+        assert stats["demo"]["version"] == 2
+        assert stats["demo"]["reload"]["state"] == "promoted"
+        # bad body -> 400; unknown model -> 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/models/demo:reload", data=b'{}'))
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/models/ghost:reload",
+                data=json.dumps({"directory": d}).encode()))
+        assert ei.value.code == 404
+    finally:
+        srv.drain(timeout_s=5.0)
+        fe.stop()
