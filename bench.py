@@ -619,21 +619,129 @@ def bench_recordio_input(compute_ips=None, compute_dtype="bfloat16",
 
     run_epochs(1)  # warmup/compile
     e2e = max(run_epochs(2), run_epochs(2))
-    row["images_per_sec"] = round(e2e, 2)
+    row["images_per_sec_prefetch_thread"] = e2e_thread = round(e2e, 2)
+
+    # stage 4: sharded multi-process decode pool — on-host decode
+    # throughput MEASURED at 1 and N workers (io_pipeline.py), where
+    # earlier rounds could only project single-core decode x cores
+    from mxnet_tpu import io_pipeline as iop
+
+    ncpu = os.cpu_count() or 1
+    pool_workers = max(1, min(4, ncpu))
+
+    def _pool_iter_fn():
+        return iop.make_record_iter_fn(
+            path_imgrec=rec_path, path_imgidx=idx_path,
+            data_shape=(3, 224, 224), batch_size=batch,
+            shuffle=True, rand_crop=True, rand_mirror=True,
+            preprocess_threads=1, dtype="uint8")
+
+    def _pool_decode_ips(nw, epochs=2):
+        pipe = iop.InputPipeline(_pool_iter_fn(), num_workers=nw,
+                                 device=False)
+        try:
+            pipe.next()  # workers up, first batch decoded
+            seen = 0
+            t0 = time.time()
+            for _ in range(epochs):
+                while True:
+                    try:
+                        pipe.next()
+                    except StopIteration:
+                        break
+                    seen += batch
+                pipe.reset()
+            return seen / (time.time() - t0)
+        finally:
+            pipe.close()
+
+    try:
+        d1 = _pool_decode_ips(1)
+        row["pool_decode_ips_1w"] = round(d1, 1)
+        pool_decode = d1
+        if pool_workers > 1:
+            dn = _pool_decode_ips(pool_workers)
+            row["pool_decode_ips_%dw" % pool_workers] = round(dn, 1)
+            row["decode_scaling_1_to_%d" % pool_workers] = \
+                round(dn / d1, 2)
+            pool_decode = dn
+        else:
+            row["pool_note"] = ("single-cpu host: decode scaling "
+                                "needs >= 2 cores")
+        row["pool_workers"] = pool_workers
+    except Exception as exc:
+        row["pool_error"] = repr(exc)
+        pool_decode = None
+
+    # stage 5: the overlapped pipeline MEASURED end-to-end — decode
+    # pool -> async device prefetch (double-buffered device_put) ->
+    # donated fused train steps.  This is the row's on-host number.
+    def _pool_e2e(epochs=2, stack=4):
+        import jax.numpy as jnp
+
+        pipe = iop.InputPipeline(_pool_iter_fn(),
+                                 num_workers=pool_workers, device=True)
+        try:
+            seen = 0
+            losses = None
+            t0 = time.time()
+            for _ in range(epochs):
+                buf_d, buf_l = [], []
+                while True:
+                    try:
+                        b = pipe.next()
+                    except StopIteration:
+                        break
+                    buf_d.append(b.data[0]._data)
+                    buf_l.append(b.label[0]._data)
+                    if len(buf_d) == stack:
+                        sd, sl = jnp.stack(buf_d), jnp.stack(buf_l)
+                        # bench owns these stacks and never rereads
+                        # them: hand ownership to the donated dispatch
+                        iop.mark_disposable(sd)
+                        iop.mark_disposable(sl)
+                        losses = step.run_steps(sd, sl)
+                        seen += batch * stack
+                        buf_d, buf_l = [], []
+                if buf_d:
+                    losses = step.run_steps(jnp.stack(buf_d),
+                                            jnp.stack(buf_l))
+                    seen += batch * len(buf_d)
+                pipe.reset()
+            _drain(losses)
+            return seen / (time.time() - t0)
+        finally:
+            pipe.close()
+
+    try:
+        e2e_pool = _pool_e2e()
+        row["pool_images_per_sec"] = round(e2e_pool, 2)
+    except Exception as exc:
+        row["pool_e2e_error"] = repr(exc)
+        e2e_pool = None
+    # the on-host number is the best MEASURED pipeline on this host: on
+    # multi-core hosts that is the pool; on a 1-cpu box the process
+    # round-trips can lose to the in-process thread — report whichever
+    # actually won, labeled
+    if e2e_pool and e2e_pool >= e2e:
+        row["images_per_sec"] = round(e2e_pool, 2)
+        row["onhost_source"] = ("measured: %d-worker decode pool + "
+                                "async device prefetch" % pool_workers)
+    else:
+        row["images_per_sec"] = e2e_thread
+        row["onhost_source"] = "measured: single prefetch thread"
+
     if compute_ips:
-        ceiling = min(decode_ips, link_cap, compute_ips)
-        row["overlap_eff"] = round(e2e / ceiling, 3)
-        row["io_vs_compute"] = round(e2e / compute_ips, 3)
+        best = max(e2e_pool or 0.0, e2e)
+        decode_cap = max(pool_decode or 0.0, decode_ips)
+        ceiling = min(decode_cap, link_cap, compute_ips)
+        row["overlap_eff"] = round(best / ceiling, 3)
+        # MEASURED (not projected): the overlapped pool pipeline vs the
+        # bf16 headline compute rate on this host
+        row["io_vs_compute"] = round(best / compute_ips, 3)
         row["bottleneck"] = ("h2d_link" if link_cap == ceiling else
-                             "decode" if decode_ips == ceiling else
+                             "decode" if decode_cap == ceiling else
                              "compute")
-        # host-attached projection: PCIe/DMA link >= 1 GB/s => link cap
-        # >= 6.6k img/s, far above compute; decode parallelizes across
-        # host cores (atomic work-stealing over records, no shared
-        # state) -- 8 cores assumed, real v5e hosts have 100+
-        onhost = min(decode_ips * 8, compute_ips)
-        row["projected_onhost_ips_8core"] = round(onhost, 1)
-        row["projected_onhost_io_vs_compute"] = round(onhost / compute_ips, 3)
     return row
 
 
@@ -1053,9 +1161,16 @@ def _setup_compile_cache():
     """Persistent XLA compilation cache, shared with probe subprocesses
     via the environment: a probe killed after its compile finished
     retries at near-zero compile cost, and the fit row's program is
-    reused across the 224 attempt and its retry."""
-    cache_dir = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                                      "/tmp/bench_xla_cache")
+    reused across the 224 attempt and its retry.  The wiring itself is
+    the shared mxnet_tpu.compile_cache helper (MXNET_COMPILE_CACHE_DIR)
+    — the same knob serving and FusedTrainStep builds honor; the JAX_*
+    envs stay set so probe children that import jax before mxnet pick
+    the cache up too."""
+    cache_dir = os.environ.setdefault("MXNET_COMPILE_CACHE_DIR",
+                                      os.environ.get(
+                                          "JAX_COMPILATION_CACHE_DIR",
+                                          "/tmp/bench_xla_cache"))
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     # telemetry dumps (flightrecorder_rank*.json, profile_rank*.json)
@@ -1064,12 +1179,9 @@ def _setup_compile_cache():
     # explicit MXNET_DUMP_DIR from the caller wins via setdefault)
     os.environ.setdefault("MXNET_DUMP_DIR", "/tmp/bench_artifacts")
     try:
-        os.makedirs(cache_dir, exist_ok=True)
-        import jax
+        from mxnet_tpu import compile_cache as _cc
 
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _cc.enable(cache_dir)
     except Exception:
         pass  # cache is an optimization, never a failure mode
 

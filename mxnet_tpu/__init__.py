@@ -37,6 +37,7 @@ from . import optimizer
 from . import metric
 from . import lr_scheduler
 from . import io
+from . import io_pipeline
 from . import recordio
 from . import image
 from . import profiler

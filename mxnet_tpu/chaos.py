@@ -51,6 +51,12 @@ hold; ``nth`` skips the first nth-1 candidate events.  Kinds:
     drive the auto-rollback with zero admitted requests dropped (the
     failed canary batch re-executes on the stable version).  Match
     keys: ``model``, ``version``, ``nth``, ``count``.
+  * ``slow_decode``    — sleep ``ms`` (default 100) in the matching
+    decode-pool worker after it decodes a batch (io_pipeline.py) — a
+    seeded straggler worker the sharded pipeline must absorb as
+    degraded throughput, never a deadlock (the round-robin consumer
+    just waits on that worker's turn).  Match keys: ``worker``,
+    ``nth``, ``count``, ``ms``.
 
 Injected faults count into ``mxnet_chaos_injected_total{kind=...}``
 (diagnostics.metrics) so a test can assert the fault actually fired —
@@ -73,6 +79,7 @@ from typing import Any, Dict, List, Optional
 __all__ = ["Rule", "rules", "enabled", "fault", "should_kill",
            "maybe_slow_request", "should_fail_execute",
            "maybe_corrupt_shard", "should_fail_version",
+           "maybe_slow_decode",
            "injected_total", "reset", "KILL_EXIT_CODE"]
 
 _log = logging.getLogger(__name__)
@@ -81,7 +88,8 @@ _log = logging.getLogger(__name__)
 #: worker reports through the launcher
 KILL_EXIT_CODE = 137
 
-_INT_KEYS = ("rank", "nth", "count", "step", "version", "nbytes")
+_INT_KEYS = ("rank", "nth", "count", "step", "version", "nbytes",
+             "worker")
 _FLOAT_KEYS = ("ms",)
 
 
@@ -311,6 +319,17 @@ def maybe_corrupt_shard(path: str, step: int, **ctx) -> bool:
         return False
 
 
+def maybe_slow_decode(worker: int, **ctx) -> None:
+    """slow_decode hook (io_pipeline decode worker, AFTER one batch
+    decoded): sleep ms when a rule matches this worker — the seeded
+    straggler the sharded pipeline must degrade around, not hang on.
+    Runs INSIDE the worker process (rules parsed there from the
+    inherited MXNET_CHAOS)."""
+    r = fault("slow_decode", worker=worker, **ctx)
+    if r is not None:
+        time.sleep(float(r.params.get("ms", 100.0)) / 1e3)
+
+
 def should_fail_version(model: str, version: int, **ctx) -> bool:
     """bad_version hook (ModelServer canary dispatch): True when the
     matching model's NEW version must fail its canary batch — what
@@ -435,7 +454,24 @@ def _self_test() -> tuple:
         del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
         reset()
 
-    # 7) disabled == inert (and never raises)
+    # 7) the io-pipeline kind: slow_decode sleeps on the matching
+    # worker only, with the usual count window
+    os.environ["MXNET_CHAOS"] = "slow_decode:worker=1,ms=1,count=2"  # mxlint: disable=MXL002
+    reset()
+    try:
+        t0 = time.time()
+        maybe_slow_decode(worker=0)
+        checks["slow_decode_worker_scoped"] = time.time() - t0 < 0.5 \
+            and injected_total("slow_decode") == 0
+        maybe_slow_decode(worker=1)
+        maybe_slow_decode(worker=1)
+        maybe_slow_decode(worker=1)
+        checks["slow_decode_count"] = injected_total("slow_decode") == 2
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        reset()
+
+    # 8) disabled == inert (and never raises)
     checks["disabled_inert"] = not enabled() and \
         fault("kill", step=1) is None
 

@@ -274,12 +274,36 @@ def latest_step(directory: str,
     """The newest step every expected rank finished writing (None when
     the directory holds no complete checkpoint).  Manifested steps are
     self-describing about their world size; for legacy steps
-    ``num_ranks`` defaults to this process's fleet size."""
+    ``num_ranks`` defaults to this process's fleet size.
+
+    The walk re-runs when it raced the retention janitor: the walk is
+    newest-to-oldest over a one-shot snapshot, while a concurrent
+    writer+janitor move the newest-complete frontier UP and delete
+    below it — so a single walk can visit the newly-completing step
+    too early (still missing a shard) and reach the previously-newest
+    step only after its tombstone landed, reporting "no checkpoint"
+    for a directory that held a complete step at every instant.  The
+    janitor only deletes below a step it judged complete, so whenever
+    a failed walk saw deletion in progress (a tombstone) or the step
+    listing shifted underneath it, a re-walk converges on the new
+    frontier; a genuinely checkpoint-less directory reads stable and
+    returns None after one confirming pass."""
     if num_ranks is None:
         num_ranks = max(_rank_info()[1], 1)
-    for step in reversed(list_steps(directory)):
-        if _is_complete(directory, step, num_ranks):
-            return step
+    prev_snapshot = None
+    for _attempt in range(8):
+        steps = list_steps(directory)
+        saw_tombstone = False
+        for step in reversed(steps):
+            if _is_complete(directory, step, num_ranks):
+                return step
+            if _tombstoned(directory, step):
+                saw_tombstone = True
+        snapshot = (tuple(steps), saw_tombstone)
+        if not saw_tombstone and snapshot == prev_snapshot:
+            return None  # stable: nothing complete, nobody deleting
+        prev_snapshot = snapshot
+        time.sleep(0.0005)
     return None
 
 

@@ -1,0 +1,71 @@
+"""mx.compile_cache — persistent XLA compilation cache as a knob.
+
+Round 5's bench found a ~320-program bind cost on every restart;
+bench.py grew an ad-hoc ``jax_compilation_cache_dir`` setup and the
+serving tier paid the full AOT compile on every boot.  This is the ONE
+shared helper: ``MXNET_COMPILE_CACHE_DIR`` (env.py) names an on-disk
+cache, :func:`enable` wires it into jax (idempotently, with the
+min-entry/min-compile-time thresholds zeroed so every program is
+eligible), and every compiled-path build site calls it:
+
+  * ``FusedTrainStep._build`` / ``BulkTrainLoop._build`` (training),
+  * ``ModelRuntime.compile`` (serving AOT executors),
+  * ``bench._setup_compile_cache`` (the bench harness + its probe
+    children, via the env so subprocesses inherit it).
+
+A warm restart then loads executables from disk instead of recompiling
+— ``diagnostics.recompile_stats()``'s per-compile timings are the
+before/after evidence.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+__all__ = ["enable", "enabled_dir"]
+
+_log = logging.getLogger(__name__)
+_lock = threading.Lock()
+_enabled_dir: Optional[str] = None
+
+
+def enable(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache at ``cache_dir`` (or
+    ``MXNET_COMPILE_CACHE_DIR``).  Returns the active directory, or
+    None when no directory is configured.  Idempotent and guarded —
+    the cache is an optimization, never a failure mode."""
+    global _enabled_dir
+    from . import env as _env
+
+    d = cache_dir or _env.get_str("MXNET_COMPILE_CACHE_DIR")
+    if not d:
+        return None
+    d = os.path.abspath(d)
+    with _lock:
+        if _enabled_dir == d:
+            return d
+        try:
+            os.makedirs(d, exist_ok=True)
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", d)
+            # every program is cache-eligible: the ~320 bound programs
+            # r05 found are individually small/fast, exactly the ones
+            # the default thresholds would exclude
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              0)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+        except Exception as exc:
+            _log.warning("compile cache disabled (%r)", exc)
+            return None
+        _enabled_dir = d
+        _log.info("persistent XLA compilation cache: %s", d)
+        return d
+
+
+def enabled_dir() -> Optional[str]:
+    """The directory :func:`enable` last activated (None if never)."""
+    return _enabled_dir

@@ -1266,6 +1266,44 @@ def feed_io_bytes(nbytes: int) -> None:
         pass
 
 
+def feed_io_queue_depth(depth: int) -> None:
+    """``mxnet_io_queue_depth`` gauge: decoded/placed batches waiting
+    ahead of the consumer (io_pipeline's prefetch queue).  Persistently
+    0 while step time is io-bound = the decode pool is the bottleneck;
+    persistently full = the chip is."""
+    try:
+        metrics.gauge("mxnet_io_queue_depth",
+                      help="input-pipeline prefetch queue depth "
+                           "(batches ready ahead of the consumer)"
+                      ).set(int(depth))
+    except Exception:
+        pass
+
+
+def feed_io_decode_seconds(seconds: float) -> None:
+    """``mxnet_io_decode_seconds`` histogram: one decode-pool worker's
+    wall time for one batch (shipped with the batch's slot message)."""
+    try:
+        metrics.histogram("mxnet_io_decode_seconds",
+                          help="per-batch decode wall time in the "
+                               "input-pipeline worker pool"
+                          ).observe(float(seconds))
+    except Exception:
+        pass
+
+
+def feed_io_worker_death() -> None:
+    """``mxnet_io_worker_deaths_total``: decode workers that died and
+    whose shard the parent adopted inline (degraded, not hung)."""
+    try:
+        metrics.counter("mxnet_io_worker_deaths_total",
+                        help="decode-pool workers that died "
+                             "(shard adopted inline by the parent)"
+                        ).inc()
+    except Exception:
+        pass
+
+
 def samples_per_second() -> Optional[float]:
     """The registry's current samples/s gauge (Speedometer's fallback
     when its own wall-clock interval is below clock resolution)."""

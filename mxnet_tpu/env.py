@@ -325,3 +325,30 @@ register("MXNET_SERVE_ROLLBACK_ERR_RATIO", "float", 2.0,
 # image/image.py — decode pool
 register("MXNET_CPU_WORKER_NTHREADS", "int", 1,
          "Decode worker threads for ImageIter augmentation.")
+
+# io_pipeline.py — sharded multi-process decode pool + async device
+# prefetch (the input-pipeline rearchitecture)
+register("MXNET_IO_WORKERS", "int", 0,
+         "Decode-pool worker processes for io_pipeline.InputPipeline; "
+         "0 means cpu_count-1 (min 1).  Each worker owns a disjoint "
+         "num_parts/part_index record slice.")
+register("MXNET_IO_PREFETCH_DEPTH", "int", 2,
+         "Device-prefetch depth: how many batches the async device "
+         "stage keeps placed ahead of the consumer (2 = classic "
+         "double buffering: batch k+1 transfers while k computes).")
+register("MXNET_IO_POOL_SLOTS", "int", 4,
+         "Shared-memory batch slots per decode worker; bounds how far "
+         "a worker can run ahead of the consumer (backpressure).")
+register("MXNET_IO_START_METHOD", "str", None,
+         "Decode-pool start method: 'fork' or 'spawn'.  Unset picks "
+         "fork when the backing iterator supports the jax-free "
+         "next_raw contract (workers never touch jax, so forking a "
+         "jax-initialized parent is safe), spawn otherwise.")
+
+# compile_cache.py — persistent XLA compilation cache
+register("MXNET_COMPILE_CACHE_DIR", "str", None,
+         "Persistent on-disk XLA compilation cache directory, wired "
+         "into FusedTrainStep/bulk-fit builds, serving AOT compiles "
+         "and bench: restarts skip the multi-hundred-program bind "
+         "cost (recompile_stats() shows the warm-start reduction).  "
+         "Unset disables.")

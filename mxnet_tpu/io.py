@@ -182,16 +182,40 @@ def _init_data(data, allow_empty, default_name):
     return out
 
 
+def _shard_arrays(pairs, num_parts, part_index):
+    """The ``num_parts``/``part_index`` idiom (ref: iter_mnist.cc
+    part_index): strided row slice ``[part_index::num_parts]`` — parts
+    are disjoint and exhaustive, and composing two levels of sharding
+    (rank slice, then decode-pool worker slice) stays a single strided
+    slice of the original data."""
+    num_parts, part_index = int(num_parts), int(part_index)
+    if num_parts <= 1:
+        return pairs
+    if not 0 <= part_index < num_parts:
+        raise ValueError("part_index %d outside [0, %d)"
+                         % (part_index, num_parts))
+    return [(k, v[part_index::num_parts]) for k, v in pairs]
+
+
 class NDArrayIter(DataIter):
     """In-memory iterator (ref: python/mxnet/io.py NDArrayIter): dict/list of
-    arrays, shuffle, pad/discard/roll_over last batch."""
+    arrays, shuffle, pad/discard/roll_over last batch.
+
+    ``num_parts``/``part_index`` shard the rows per rank (and per
+    decode-pool worker) exactly like ``MNISTIter`` — disjoint strided
+    slices covering every sample once.
+    """
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", num_parts=1, part_index=0):
         super().__init__(batch_size)
-        self.data = _init_data(data, allow_empty=False, default_name=data_name)
-        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.data = _shard_arrays(
+            _init_data(data, allow_empty=False, default_name=data_name),
+            num_parts, part_index)
+        self.label = _shard_arrays(
+            _init_data(label, allow_empty=True, default_name=label_name),
+            num_parts, part_index)
         # the raw backing arrays, mutable in place (ref io.py:663 —
         # self-training loops overwrite labels between epochs through
         # it, e.g. deep-embedded-clustering's refresh)
@@ -237,15 +261,18 @@ class NDArrayIter(DataIter):
             return self.cursor + self.batch_size <= self.num_data
         return self.cursor < self.num_data
 
-    def _take(self, arrays):
+    def _batch_idx(self):
         end = self.cursor + self.batch_size
         if end <= self.num_data:
-            idx = self._shuffled_idx[self.cursor : end]
-        else:  # pad by wrapping (ref: io.py _getdata concat pad)
-            idx = _np.concatenate([
-                self._shuffled_idx[self.cursor :],
-                self._shuffled_idx[: end - self.num_data],
-            ])
+            return self._shuffled_idx[self.cursor : end]
+        # pad by wrapping (ref: io.py _getdata concat pad)
+        return _np.concatenate([
+            self._shuffled_idx[self.cursor :],
+            self._shuffled_idx[: end - self.num_data],
+        ])
+
+    def _take(self, arrays):
+        idx = self._batch_idx()
         return [array(v[idx]) for _, v in arrays]
 
     def getdata(self):
@@ -253,6 +280,19 @@ class NDArrayIter(DataIter):
 
     def getlabel(self):
         return self._take(self.label)
+
+    def next_raw(self):
+        """Host-only batch: ``(data_np_list, label_np_list, pad)`` with
+        plain numpy arrays (no NDArray, no device placement).  The
+        decode-pool worker contract (io_pipeline.py) — workers must
+        never touch jax, so they fetch through this instead of
+        ``next()``."""
+        if not self.iter_next():
+            raise StopIteration
+        idx = self._batch_idx()
+        data = [_np.ascontiguousarray(v[idx]) for _, v in self.data]
+        label = [_np.ascontiguousarray(v[idx]) for _, v in self.label]
+        return data, label, self.getpad()
 
     def getpad(self) -> int:
         if self.last_batch_handle == "pad" and self.cursor + self.batch_size > self.num_data:
@@ -331,15 +371,21 @@ class MNISTIter(DataIter):
     def next(self):
         return self._inner.next()
 
+    def next_raw(self):
+        return self._inner.next_raw()
+
     def iter_next(self):
         return self._inner.iter_next()
 
 
 class CSVIter(DataIter):
-    """ref: src/io/iter_csv.cc."""
+    """ref: src/io/iter_csv.cc.  ``num_parts``/``part_index`` shard rows
+    per rank/worker like the other iterators (strided, disjoint,
+    exhaustive)."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
-                 batch_size=1, round_batch=True, **kwargs):
+                 batch_size=1, round_batch=True, num_parts=1, part_index=0,
+                 **kwargs):
         super().__init__(batch_size)
         data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
         data = data.reshape((-1,) + tuple(data_shape))
@@ -350,7 +396,7 @@ class CSVIter(DataIter):
         self._inner = NDArrayIter(
             data, label, batch_size,
             last_batch_handle="pad" if round_batch else "discard",
-            label_name="label",
+            label_name="label", num_parts=num_parts, part_index=part_index,
         )
 
     @property
@@ -366,6 +412,9 @@ class CSVIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+    def next_raw(self):
+        return self._inner.next_raw()
 
 
 class LibSVMIter(DataIter):
@@ -647,11 +696,24 @@ class ImageRecordIter(DataIter):
                  resize=0, label_width=1, preprocess_threads=4,
                  round_batch=True, seed=0, prefetch_buffer=4,
                  data_name="data", label_name="softmax_label", ctx=None,
-                 dtype="float32", **kwargs):
+                 dtype="float32", num_parts=1, part_index=0, **kwargs):
         super().__init__(batch_size)
         import ctypes as _ct
 
         from . import _native
+
+        # distributed/per-worker sharding (ref: dmlc InputSplit over
+        # .rec shards, iter_image_recordio_2.cc part_index/num_parts):
+        # the native pipeline reads one file start-to-end, so part
+        # slicing materializes records [part_index::num_parts] into a
+        # private temp .rec/.idx (compressed bytes copied, nothing
+        # decoded) and opens THAT — disjoint and exhaustive across
+        # parts, and what decode-pool workers shard on.
+        self._shard_tmp = None
+        if int(num_parts) > 1:
+            path_imgrec, path_imgidx, self._shard_tmp = self._make_shard(
+                str(path_imgrec), path_imgidx, int(num_parts),
+                int(part_index))
 
         self._L = _native.lib()
         c, h, w = (int(s) for s in data_shape)
@@ -685,6 +747,64 @@ class ImageRecordIter(DataIter):
         self._first_batch = None
         self._views = {}
 
+    @staticmethod
+    def _make_shard(path_imgrec, path_imgidx, num_parts, part_index):
+        """Copy records [part_index::num_parts] into a temp .rec/.idx
+        pair (selective indexed reads when an .idx exists, sequential
+        filter otherwise).  Bytes only — no decode."""
+        import tempfile
+
+        from . import recordio as _rio
+
+        if not 0 <= part_index < num_parts:
+            raise ValueError("part_index %d outside [0, %d)"
+                             % (part_index, num_parts))
+        import shutil
+
+        tmpdir = tempfile.mkdtemp(prefix="mxrec_part%d_of%d_"
+                                  % (part_index, num_parts))
+        out_rec = os.path.join(tmpdir, "part.rec")
+        out_idx = os.path.join(tmpdir, "part.idx")
+        reader = writer = None
+        try:
+            writer = _rio.MXIndexedRecordIO(out_idx, out_rec, "w")
+            n_out = 0
+            if path_imgidx and os.path.exists(str(path_imgidx)):
+                reader = _rio.MXIndexedRecordIO(str(path_imgidx),
+                                                path_imgrec, "r")
+                for key in reader.keys[part_index::num_parts]:
+                    writer.write_idx(key, reader.read_idx(key))
+                    n_out += 1
+            else:
+                reader = _rio.MXRecordIO(path_imgrec, "r")
+                i = 0
+                while True:
+                    s = reader.read()
+                    if s is None:
+                        break
+                    if i % num_parts == part_index:
+                        writer.write_idx(i, s)
+                        n_out += 1
+                    i += 1
+            if n_out == 0:
+                raise MXNetError(
+                    "ImageRecordIter: part %d/%d of %r holds zero "
+                    "records" % (part_index, num_parts, path_imgrec))
+        except BaseException:
+            # nothing owns tmpdir yet (self._shard_tmp is assigned by
+            # the caller only on success) — clean it here or it leaks
+            for h in (reader, writer):
+                try:
+                    if h is not None:
+                        h.close()
+                except Exception:
+                    pass
+            shutil.rmtree(tmpdir, ignore_errors=True)
+            raise
+        reader.close()
+        writer.close()
+        return out_rec, out_idx, tmpdir
+
     @property
     def provide_data(self):
         return [DataDesc(self._data_name, (self.batch_size,) + self._shape,
@@ -715,7 +835,10 @@ class ImageRecordIter(DataIter):
     def next(self) -> DataBatch:
         return _instrumented_fetch(self, self._next_batch)
 
-    def _next_batch(self) -> DataBatch:
+    def _next_arrays(self):
+        """One decoded batch as host numpy: ``(data, label, pad)`` —
+        the jax-free core shared by :meth:`next` and :meth:`next_raw`
+        (decode-pool workers use the latter)."""
         import ctypes as _ct
 
         data_p = (_ct.POINTER(_ct.c_uint8)() if self._native_u8
@@ -746,7 +869,17 @@ class ImageRecordIter(DataIter):
                 # labels stay float for integer data dtypes (a uint8
                 # image pipeline must not truncate class ids > 255)
                 label = label.astype(self._dtype)
-        return DataBatch([array(data)], [array(label)], pad=pad.value)
+        return data, label, pad.value
+
+    def _next_batch(self) -> DataBatch:
+        data, label, pad = self._next_arrays()
+        return DataBatch([array(data)], [array(label)], pad=pad)
+
+    def next_raw(self):
+        """Host-only batch ``([data_np], [label_np], pad)`` — no
+        NDArray, no device placement (the decode-pool worker path)."""
+        data, label, pad = self._next_arrays()
+        return [data], [label], pad
 
     def iter_next(self):
         raise NotImplementedError("ImageRecordIter uses next() directly")
@@ -758,6 +891,12 @@ class ImageRecordIter(DataIter):
             except Exception:
                 pass
             self._handle = None
+        tmp = getattr(self, "_shard_tmp", None)
+        if tmp:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+            self._shard_tmp = None
 
 
 class ImageDetRecordIter(DataIter):

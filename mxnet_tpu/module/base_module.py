@@ -379,12 +379,19 @@ class BaseModule:
             if resume_skip and epoch == begin_epoch:
                 # exact-resume fast-forward: replay the iterator to the
                 # checkpointed position (deterministic iterators only —
-                # the exact-resume contract requires one)
-                for _ in range(resume_skip):
-                    try:
-                        next(data_iter)
-                    except StopIteration:
-                        break
+                # the exact-resume contract requires one).  An
+                # io_pipeline InputPipeline skips on the host side
+                # (decode-and-drop) so the replayed batches never cross
+                # the H2D link.
+                skipper = getattr(train_data, "skip_batches", None)
+                if skipper is not None:
+                    skipper(resume_skip)
+                else:
+                    for _ in range(resume_skip):
+                        try:
+                            next(data_iter)
+                        except StopIteration:
+                            break
                 nbatch = resume_skip
             progress["nbatch"] = nbatch
             start_nbatch = nbatch

@@ -155,6 +155,12 @@ class BulkTrainLoop:
         import jax.numpy as jnp
         from jax import lax
 
+        # persistent XLA compilation cache (MXNET_COMPILE_CACHE_DIR):
+        # the bulk scan is the big program a restarted fit re-pays
+        from ..compile_cache import enable as _cc_enable
+
+        _cc_enable()
+
         mod = self._mod
         ex = mod._exec
         updater = mod._active_updater()
@@ -367,18 +373,25 @@ class BulkTrainLoop:
                 for b in batches:
                     src = (b.data[pos] if pos < n_data
                            else b.label[pos - n_data])
+                    # async-prefetched batches (io_pipeline) arrive as
+                    # device-committed jax arrays: jnp.stack runs on
+                    # device, so the K-batch stack never round-trips
+                    # through the host — the zero-copy handoff into the
+                    # bulk scan
                     arrs.append(src._data if isinstance(src, NDArray)
                                 else jnp.asarray(src))
                 stacked.append(jnp.stack(arrs))
             if self._bucketed:
                 # batches arrive committed to one device; the shard_map
                 # scan wants them batch-sharded over dp (leading dim is
-                # the scan's K)
+                # the scan's K).  Skip the put when the stack already
+                # landed with that sharding (prefetched dp batches).
                 import jax as _jx
                 from jax.sharding import NamedSharding, PartitionSpec as _P
 
                 ksh = NamedSharding(self._mesh, _P(None, "dp"))
-                stacked = [_jx.device_put(s, ksh) for s in stacked]
+                stacked = [s if getattr(s, "sharding", None) == ksh
+                           else _jx.device_put(s, ksh) for s in stacked]
             # COMMIT every carried buffer to the device before the first
             # dispatch: jit keys include placement, so uncommitted
             # first-call inputs vs committed (donated-output) later ones

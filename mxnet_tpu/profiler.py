@@ -232,6 +232,16 @@ def _tid() -> int:
     return tid
 
 
+def register_tid_name(tid: int, name: str) -> None:
+    """Claim a trace lane for an EXTERNAL actor (a decode-pool worker
+    process stamping through the parent, io_pipeline.py): the lane gets
+    thread_name metadata in the dump without a backing Python thread.
+    Callers should pick tids >= io_pipeline.IO_WORKER_TID_BASE so the
+    sequential thread ids never collide with them."""
+    with _lock:
+        _tid_names.setdefault(int(tid), str(name))
+
+
 def _fold(stats: Dict[Tuple[str, str], List[float]], key: Tuple[str, str],
           value: float) -> None:
     st = stats.get(key)

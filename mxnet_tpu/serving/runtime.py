@@ -137,6 +137,12 @@ class ModelRuntime:
         import numpy as np
 
         from .. import diagnostics as _diag
+        from ..compile_cache import enable as _cc_enable
+
+        # MXNET_COMPILE_CACHE_DIR: a restarted server loads its AOT
+        # executors from the persistent cache instead of re-binding
+        # every (model, bucket) program
+        _cc_enable()
 
         jfn = jax.jit(self._apply)
         for b in self.plan:

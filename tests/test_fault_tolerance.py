@@ -612,6 +612,66 @@ def test_fit_resume_bitwise(tmp_path):
                                       resumed[k].asnumpy())
 
 
+def _fit_pipe(bulk=0, **kw):
+    """Module.fit driven by the sharded decode pool + async device
+    prefetch (io_pipeline.InputPipeline) instead of a plain iterator."""
+    from mxnet_tpu import engine
+    from mxnet_tpu import io_pipeline as iop
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(24, 6).astype(np.float32)
+    y = rng.randint(0, 4, (24,)).astype(np.float32)
+    np.random.seed(0)
+    mx.random.seed(0)
+    pipe = iop.InputPipeline(
+        iop.make_ndarray_iter_fn(x, y, batch_size=8), num_workers=2,
+        device=True)
+    # restore the engine's full bulk state (value AND explicitness) —
+    # set_bulk_size(prev) alone would leave the default 15 EXPLICIT,
+    # flipping every later per-batch fit in the session into bulk mode
+    prev_state = (engine._bulk_size, engine._bulk_explicit)
+    if bulk:
+        engine.set_bulk_size(bulk)
+    try:
+        mod = mx.mod.Module(symbol=_mlp(), context=mx.cpu())
+        mod.fit(pipe, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                num_epoch=2, **kw)
+    finally:
+        engine._bulk_size, engine._bulk_explicit = prev_state
+        pipe.close()
+    return mod.get_params()[0]
+
+
+@pytest.mark.parametrize("bulk", [0, 2], ids=["per_batch", "bulk"])
+def test_fit_resume_bitwise_with_io_pipeline(tmp_path, bulk):
+    """The exact-resume contract THROUGH the new input pipeline: the
+    decode pool + async device prefetch active on both the per-batch
+    and bulk-scan fit paths, checkpoint mid-epoch, resume in a fresh
+    module over a fresh pool — bitwise parity with the uninterrupted
+    control (the pool's round-robin stream is deterministic, and
+    skip_batches fast-forwards to the exact position)."""
+    d = str(tmp_path)
+    control = _fit_pipe(bulk=bulk)
+    with_ckpt = _fit_pipe(bulk=bulk, checkpoint_every_n=2,
+                          checkpoint_dir=d)
+    for k in control:  # checkpointing through the pool is invisible
+        np.testing.assert_array_equal(control[k].asnumpy(),
+                                      with_ckpt[k].asnumpy())
+    steps = ckpt.list_steps(d)
+    assert steps, "no checkpoints landed"
+    # pretend the run died: drop the newest step and resume mid-epoch
+    import shutil
+
+    shutil.rmtree(ckpt.step_dir(d, steps[-1]))
+    assert ckpt.list_steps(d), "need a mid-run step to resume from"
+    resumed = _fit_pipe(bulk=bulk, resume_from=d)
+    assert sorted(control) == sorted(resumed)
+    for k in control:
+        np.testing.assert_array_equal(control[k].asnumpy(),
+                                      resumed[k].asnumpy())
+
+
 def test_fit_nan_guard_skips_step(monkeypatch):
     """chaos nan_grad at step 3 + MXNET_SKIP_NONFINITE_GRADS: the step
     is skipped/neutralized (no NaN reaches the params), the skip

@@ -265,6 +265,85 @@ def analyze_stragglers(traces, slow_factor: float = 1.25,
             "per_rank": per_rank}
 
 
+def _merge_intervals(intervals):
+    """Sorted union of (start, end) spans as a list of [start, end]."""
+    merged = []
+    for s, e in sorted(intervals):
+        if merged and s <= merged[-1][1]:
+            if e > merged[-1][1]:
+                merged[-1][1] = e
+        else:
+            merged.append([s, e])
+    return merged
+
+
+def _union_us(intervals):
+    """Total covered microseconds of a list of (start, end) spans."""
+    return sum(e - s for s, e in _merge_intervals(intervals))
+
+
+def _overlap_us(ios, steps):
+    """Microseconds of wall time covered by BOTH io and step spans —
+    union-vs-union intersection, so concurrent io spans (two decode
+    workers active at once) never double-count: the fraction of io
+    time hidden behind the step stays <= 1."""
+    a, b = _merge_intervals(ios), _merge_intervals(steps)
+    total = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def analyze_io_overlap(traces):
+    """Input-pipeline lanes vs the compiled step, per rank: how much of
+    the ``io:*`` span time (decode-worker lanes, device_put, wait)
+    coincides with compiled-step spans.  ``prefetch_overlap_frac`` near
+    1.0 = the async device stage genuinely hides H2D behind compute;
+    large ``io:wait`` time = the consumer is input-bound (grow
+    MXNET_IO_WORKERS)."""
+    if not traces:
+        return None
+    out = {}
+    for rank, payload in sorted(traces.items()):
+        ios, steps = [], []
+        by_name = {}
+        for ev in payload.get("traceEvents", []):
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            name = str(ev.get("name", ""))
+            iv = (float(ev["ts"]), float(ev["ts"]) + float(ev["dur"]))
+            if name.startswith("io:") or ev.get("cat") == "io":
+                ios.append(iv)
+                by_name.setdefault(name, 0.0)
+                by_name[name] += iv[1] - iv[0]
+            elif ev.get("cat") == "step" or "run_steps" in name:
+                steps.append(iv)
+        if not ios:
+            continue
+        io_us = _union_us(ios)
+        step_us = _union_us(steps)
+        ov = _overlap_us(ios, steps) if steps else 0.0
+        out[rank] = {
+            "n_io_spans": len(ios),
+            "n_step_spans": len(steps),
+            "io_ms": io_us / 1e3,
+            "step_ms": step_us / 1e3,
+            "io_overlap_ms": ov / 1e3,
+            "prefetch_overlap_frac": round(ov / io_us, 3) if io_us else 0.0,
+            "by_lane_ms": {n: round(v / 1e3, 3)
+                           for n, v in sorted(by_name.items())},
+        }
+    return out or None
+
+
 def health_report(flight, traces):
     report = {"n_flight_dumps": len(flight), "n_trace_dumps": len(traces),
               "desync": analyze_desync(flight)}
@@ -274,6 +353,9 @@ def health_report(flight, traces):
     stragglers = analyze_stragglers(traces)
     if stragglers is not None:
         report["stragglers"] = stragglers
+    io = analyze_io_overlap(traces)
+    if io is not None:
+        report["io_overlap"] = io
     return report
 
 
@@ -337,6 +419,15 @@ def format_health(report):
                    r["p99_ms"], r["max_ms"],
                    (" [" + ",".join(flags) + "]") if flags else ""))
         lines.append("slowest rank: %d" % st["slowest_rank"])
+    io = report.get("io_overlap")
+    if io:
+        for rank, r in sorted(io.items()):
+            lines.append(
+                "  rank %d io lanes: %d span(s), %.3f ms io, %.3f ms "
+                "overlapping the compiled step (prefetch overlap %.1f%%)"
+                % (rank, r["n_io_spans"], r["io_ms"],
+                   r["io_overlap_ms"],
+                   100.0 * r["prefetch_overlap_frac"]))
     return lines
 
 
@@ -374,6 +465,14 @@ def self_test() -> int:
                 {"name": "kvstore:push_bytes", "cat": "comms", "ph": "C",
                  "ts": 3.0, "pid": 0, "tid": 0,
                  "args": {"kvstore:push_bytes": 64}},
+                # io-pipeline lanes: a decode span on a worker lane and
+                # a device_put half-overlapping the compiled step
+                {"name": "io:decode", "cat": "io", "ph": "X", "ts": 10.0,
+                 "dur": 0.5, "pid": 0, "tid": 100},
+                {"name": "io:device_put", "cat": "io", "ph": "X",
+                 "ts": 10.5, "dur": 0.5, "pid": 0, "tid": 1},
+                {"name": "FusedTrainStep.run_steps[k=1]", "cat": "step",
+                 "ph": "X", "ts": 10.75, "dur": 1.0, "pid": 0, "tid": 0},
             ], "displayTimeUnit": "ms"}
             p = os.path.join(d, "profile_rank%d.json" % rank)
             with open(p, "w") as f:
@@ -385,7 +484,7 @@ def self_test() -> int:
             on_disk = json.load(f)
         assert on_disk == result
         events = result["traceEvents"]
-        assert len(events) == 6, events
+        assert len(events) == 12, events
         pids = sorted({e["pid"] for e in events})
         assert pids == [0, 1], "pid remapping failed: %s" % pids
         for rank in (0, 1):
@@ -449,6 +548,23 @@ def self_test() -> int:
         # both ranks -> nobody flagged
         st = report["stragglers"]
         assert st["step_span"] == "dot" and st["flagged_ranks"] == [], st
+        # io lanes: 1.0 ms of io spans, of which the device_put's
+        # second half (0.25 ms) coincides with the compiled-step span
+        io = report["io_overlap"]
+        assert set(io) == {0, 1}, io
+        r0 = io[0]
+        assert r0["n_io_spans"] == 2 and r0["n_step_spans"] == 1, r0
+        assert abs(r0["io_ms"] - 1.0e-3) < 1e-9, r0
+        assert abs(r0["io_overlap_ms"] - 0.25e-3) < 1e-9, r0
+        assert r0["prefetch_overlap_frac"] == 0.25, r0
+        assert "io:decode" in r0["by_lane_ms"], r0
+        text = "\n".join(format_health(report))
+        assert "prefetch overlap 25.0%" in text, text
+        # concurrent io lanes (two decode workers at once) must not
+        # double-count: both fully inside one step span = 100%, not 200%
+        assert _overlap_us([(0.0, 10.0), (2.0, 8.0)],
+                           [(0.0, 10.0)]) == 10.0
+        assert _union_us([(0.0, 10.0), (2.0, 8.0)]) == 10.0
     print("merge_traces self-test OK")
     return 0
 

@@ -31,6 +31,19 @@ the fact (recompile storms, config typos, hot-loop host syncs):
                                site: swallows the desync/timeout the
                                flight recorder needs to see (also
                                catches KeyboardInterrupt/SystemExit)
+  MXL007 jax-in-decode-worker  jax/device call (``device_put``,
+                               ``block_until_ready``, any ``jax.*``)
+                               inside a decode-worker function: pool
+                               workers are HOST-ONLY — under the
+                               default fork start method a worker
+                               touching the parent's initialized jax
+                               runtime deadlocks, and device placement
+                               belongs to the async device stage
+                               (io_pipeline.py).  Worker functions are
+                               those named ``*_worker_main`` /
+                               ``*decode_worker*`` / ``*io_worker*``
+                               and functions passed as ``iter_fn`` to
+                               InputPipeline/ShardedDecodePool.
 
 Pure-AST: imports NOTHING from the package (the env registry is read
 by parsing mxnet_tpu/env.py's ``register(...)`` calls), so it lints a
@@ -68,7 +81,17 @@ CODES = {
     "MXL004": "host sync inside a loop body",
     "MXL005": "import-time env read (launcher env injection ignored)",
     "MXL006": "bare except around a collective call site",
+    "MXL007": "jax/device call inside a decode-worker function "
+              "(workers are host-only; the device stage owns placement)",
 }
+
+# decode-worker entry points by naming convention
+WORKER_NAME_RE = re.compile(r"(_worker_main$|decode_worker|io_worker)")
+# pool constructors whose iter_fn argument runs inside workers
+WORKER_POOL_CTORS = {"InputPipeline", "ShardedDecodePool"}
+# calls that flag MXL007 inside a worker function
+WORKER_FORBIDDEN_ATTRS = {"device_put", "block_until_ready"}
+WORKER_FORBIDDEN_ROOTS = {"jax", "jnp"}
 
 # functions whose callable argument is traced by jax
 TRACE_ENTRY_ATTRS = {
@@ -169,6 +192,7 @@ class ModuleLinter:
         self.findings: List[LintFinding] = []
         self.tree = ast.parse(source, path)
         self.traced_fns = self._collect_traced_fns()
+        self.worker_fns = self._collect_worker_fns()
 
     # -- pass 1: which local functions get traced by jax? --------------
     def _collect_traced_fns(self) -> Set[str]:
@@ -194,6 +218,24 @@ class ModuleLinter:
                     if tokens & TRACE_ENTRY_ATTRS:
                         traced.add(node.name)
         return traced
+
+    # -- pass 1b: which local functions run inside decode workers? -----
+    def _collect_worker_fns(self) -> Set[str]:
+        defined = {n.name for n in ast.walk(self.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        workers = {n for n in defined if WORKER_NAME_RE.search(n)}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func)
+            if not chain or chain[-1] not in WORKER_POOL_CTORS:
+                continue
+            cands = list(node.args[:1]) + \
+                [kw.value for kw in node.keywords if kw.arg == "iter_fn"]
+            for arg in cands:
+                workers |= _name_nodes(arg) & defined
+        return workers
 
     # -- helpers -------------------------------------------------------
     def _suppressed(self, line: int, code: str) -> bool:
@@ -300,6 +342,21 @@ class ModuleLinter:
                       "np.%s inside a loop: device->host transfer per "
                       "iteration" % chain[-1], scope)
 
+    def _check_worker_call(self, node: ast.Call, fn_stack: List[str]
+                           ) -> None:
+        """MXL007: jax/device calls under a decode-worker function."""
+        chain = _dotted(node.func)
+        if not chain:
+            return
+        if chain[-1] in WORKER_FORBIDDEN_ATTRS \
+                or chain[0] in WORKER_FORBIDDEN_ROOTS:
+            self._add(node, "MXL007",
+                      "%s inside decode-worker function %r — workers "
+                      "are host-only (fork-safety + the device stage "
+                      "owns placement)"
+                      % (".".join(chain), ".".join(fn_stack)),
+                      ".".join(fn_stack))
+
     def _check_bare_except(self, node: ast.Try, fn_stack: List[str]
                            ) -> None:
         scope = ".".join(fn_stack) or "<module>"
@@ -321,12 +378,15 @@ class ModuleLinter:
                       % sorted(tokens & COLLECTIVE_TOKENS), scope)
 
     def _walk(self, node: ast.AST, fn_stack: List[str], traced: bool,
-              loop_depth: int) -> None:
+              loop_depth: int, worker: bool = False) -> None:
         for child in ast.iter_child_nodes(node):
             c_stack, c_traced, c_loop = fn_stack, traced, loop_depth
+            c_worker = worker
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 c_stack = fn_stack + [child.name]
                 c_traced = traced or child.name in self.traced_fns
+                # nested defs inherit worker scope: they run in-process
+                c_worker = worker or child.name in self.worker_fns
                 c_loop = 0  # a new function body is a new loop scope
             elif isinstance(child, (ast.For, ast.While)):
                 c_loop = loop_depth + 1
@@ -337,9 +397,11 @@ class ModuleLinter:
                     self._check_traced_call(child, fn_stack)
                 if loop_depth > 0 and not traced:
                     self._check_host_sync(child, fn_stack)
+                if worker:
+                    self._check_worker_call(child, fn_stack)
             if isinstance(child, ast.Try):
                 self._check_bare_except(child, fn_stack)
-            self._walk(child, c_stack, c_traced, c_loop)
+            self._walk(child, c_stack, c_traced, c_loop, c_worker)
 
 
 def lint_paths(paths: Sequence[str], registered: Set[str],
@@ -413,10 +475,22 @@ def reduce_all(x):
         return jax.lax.psum(x, "dp")
     except:                                                # 006
         return x
+
+def _decode_worker_main(q):
+    x = q.get()
+    jax.device_put(x)                                      # 007
+    x.block_until_ready()                                  # 007
+
+def my_iter_factory(num_parts=1, part_index=0):
+    import jax.numpy as jnp
+    return jnp.zeros(())                                   # 007 (iter_fn)
+
+def start_pool():
+    return InputPipeline(my_iter_factory, num_workers=2)
 '''
 
 EXPECT_SELF_TEST = {"MXL001": 1, "MXL002": 2, "MXL003": 2, "MXL004": 2,
-                    "MXL005": 1, "MXL006": 1}
+                    "MXL005": 1, "MXL006": 1, "MXL007": 3}
 
 
 def self_test() -> int:
