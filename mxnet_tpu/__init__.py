@@ -45,6 +45,7 @@ from . import diagnostics
 from . import checkpoint
 from . import chaos
 from . import analysis
+from . import autotune
 from . import monitor
 from . import monitor as mon  # ref: python/mxnet/__init__.py:63 alias
 from .monitor import Monitor

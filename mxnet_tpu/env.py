@@ -165,6 +165,31 @@ register("MXNET_KVSTORE_BUCKET_IMPL", "str", "psum",
          "Bucket reduction implementation: 'psum' or 'ring' "
          "(manual ppermute reduce-scatter/all-gather).")
 
+# autotune/ — self-tuning collectives (flight recorder -> bucket plan)
+register("MXNET_AUTOTUNE_PLAN", "str", None,
+         "Explicit tuned-plan JSON (python -m mxnet_tpu.autotune "
+         "--tune ... --apply) applied to every bucketed gradient "
+         "exchange in place of MXNET_KVSTORE_BUCKET_BYTES; an "
+         "unreadable or invalid file raises (a typo'd plan silently "
+         "falling back to the 4 MiB guess is a config bug).")
+register("MXNET_AUTOTUNE_DIR", "str", None,
+         "Directory of tuned-plan JSONs scanned at step build; a plan "
+         "whose fingerprint (total gradient bytes + leaf count) "
+         "matches the exchange being built supplies the bucket caps.  "
+         "--apply writes here by default.")
+
+# kvstore.py — gradient compression on the dist wire
+register("MXNET_GRADIENT_COMPRESSION", "str", None,
+         "Enable worker-side gradient compression on dist kvstores at "
+         "create ('2bit' is the supported type): pushes travel as "
+         "packed 2-bit codes with per-key error feedback, "
+         "mxnet_kvstore_bytes_total{op=push} counts the compressed "
+         "wire bytes.  Unset disables.")
+register("MXNET_GRADIENT_COMPRESSION_THRESHOLD", "float", 0.5,
+         "2-bit compression threshold: values >= t encode +t, <= -t "
+         "encode -t, the rest 0 with the residual carried locally "
+         "(ref: gradient_compression.h threshold param).")
+
 # kvstore_server.py — parameter-server sync mode
 register("MXNET_KVSTORE_SYNC_TIMEOUT", "float", 600.0,
          "Sync-pull progress deadline (seconds, resets on every applied "

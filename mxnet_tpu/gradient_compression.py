@@ -35,6 +35,14 @@ class GradientCompression:
     def get_params(self) -> Dict[str, str]:
         return {"type": self.type, "threshold": str(self.threshold)}
 
+    @staticmethod
+    def wire_nbytes(n_elements: int) -> int:
+        """On-wire payload of one compressed gradient: 2-bit codes pack
+        4 per byte (the reference packs 16 per float32 word — same
+        16x ratio vs the dense fp32 payload).  Deterministic, so byte
+        counters can account a push before encoding it."""
+        return (int(n_elements) + 3) // 4
+
     def compress(self, key, grad: np.ndarray) -> Tuple[bytes, tuple]:
         """grad (+ carried residual) → packed 2-bit codes. Returns
         (codes_bytes, shape)."""

@@ -157,9 +157,9 @@ class KVStore:
             # the byte counter is independent of profiler/flight state:
             # a scraped MXNET_METRICS_FILE must still see comms traffic
             self._do_push(key, value, priority)
-            _feed_bytes_metric("push", _payload_nbytes(value))
+            _feed_bytes_metric("push", self._push_wire_nbytes(key, value))
             return
-        nbytes = _payload_nbytes(value)
+        nbytes = self._push_wire_nbytes(key, value)
         with _diag.record_collective("push", keys=key, nbytes=nbytes,
                                      dtype=_payload_dtype(value),
                                      args={"type": self._kind}), \
@@ -169,6 +169,15 @@ class KVStore:
         if prof:
             _profiler.record_bytes("kvstore:push_bytes", nbytes)
         _feed_bytes_metric("push", nbytes)
+
+    def _push_wire_nbytes(self, key, value) -> int:
+        """Bytes one push puts on the wire — the figure
+        ``mxnet_kvstore_bytes_total{op=push}`` accumulates.  In-process
+        stores move device buffers, so the payload size IS the wire
+        size; the dist store overrides this to account the 2-bit codes
+        when compression is on (deterministic, so the counter and the
+        flight entry can record it before the encode happens)."""
+        return _payload_nbytes(value)
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True) -> None:
@@ -315,7 +324,35 @@ class KVStore:
                     pulled.todense().copyto(o)
 
     def set_gradient_compression(self, compression_params) -> None:
-        self._compression_params = dict(compression_params or {})
+        """Validate the params, then refuse for in-process stores —
+        silently storing them (the pre-round-13 behavior) made callers
+        believe their gradients were compressed when NOTHING was: only
+        dist stores put bytes on a wire to compress (the reference's
+        own type check, python/mxnet/kvstore.py set_gradient_compression
+        raises for local stores).  The launcher-less ``dist_*``
+        fallback (single process, no wire) validates and warns instead:
+        the degrade-to-local contract keeps launcher scripts runnable,
+        and compression there is semantically a no-op, not a lie."""
+        from .gradient_compression import GradientCompression
+
+        params = dict(compression_params or {})
+        # invalid type/threshold raise HERE, for every store kind
+        GradientCompression(type=params.get("type", "2bit"),
+                            threshold=float(params.get("threshold", 0.5)))
+        if "dist" not in self._kind:
+            raise MXNetError(
+                "gradient compression is not supported for %r kvstore: "
+                "only dist stores compress pushes on the wire (in-"
+                "process reduces never serialize a payload).  Create a "
+                "dist_sync/dist_async store under a PS launcher to "
+                "compress for real." % self._kind)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "set_gradient_compression on a launcher-less %r store: "
+            "single process, no wire — params validated and ignored",
+            self._kind)
+        self._compression_params = params
 
     # -- updater / optimizer (ref: kvstore.h set_updater) --------------
     def set_updater(self, updater: Callable) -> None:
@@ -375,6 +412,7 @@ class KVStoreTPU(KVStore):
     def __init__(self):
         super().__init__("tpu")
         self._fused_cache: Dict = {}
+        self._plan_cache: Dict = {}
 
     def _do_push(self, key, value, priority: int = 0) -> None:
         from .ndarray import sparse as _sp
@@ -415,9 +453,23 @@ class KVStoreTPU(KVStore):
 
         from .parallel import buckets as _buckets
 
-        plan = _buckets.partition(
-            [(pos, tuple(vs[0].shape), vs[0].dtype)
-             for pos, (_k, vs) in enumerate(items)], None)
+        from . import env as _envmod
+
+        entries = [(pos, tuple(vs[0].shape), vs[0].dtype)
+                   for pos, (_k, vs) in enumerate(items)]
+        # cache the resolved plan per (entries, tuning-env) state: a
+        # tuned-plan file must not be re-read on EVERY push, but env
+        # changes between pushes still take effect (same reactivity the
+        # bucket_cap_bytes() read always had)
+        plan_key = (tuple((p, s, str(d)) for p, s, d in entries),
+                    _envmod.get_str("MXNET_AUTOTUNE_PLAN"),
+                    _envmod.get_str("MXNET_AUTOTUNE_DIR"),
+                    _buckets.bucket_cap_bytes())
+        cached = self._plan_cache.get(plan_key)
+        if cached is None:
+            cached = _buckets.plan_with_tuning(entries, None)
+            self._plan_cache[plan_key] = cached
+        plan, _tuning = cached
         sig = (tuple((len(vs), tuple(vs[0].shape), str(vs[0].dtype))
                      for _k, vs in items),
                tuple((b.keys, b.dtype) for b in plan))
@@ -550,6 +602,17 @@ class KVStoreDist(KVStore):
             # every rank reaches this barrier => servers switched mode
             # before any worker's first push can race the set_sync
             self.barrier()
+        # env-toggled wire compression: every worker takes the same
+        # path (rank 0 configures the servers, the barrier inside
+        # set_gradient_compression syncs the fleet before any push)
+        from . import env as _envmod
+
+        gc_type = _envmod.get_str("MXNET_GRADIENT_COMPRESSION")
+        if gc_type:
+            self.set_gradient_compression({
+                "type": gc_type,
+                "threshold": _envmod.get_float(
+                    "MXNET_GRADIENT_COMPRESSION_THRESHOLD")})
         import atexit
 
         atexit.register(self.close)
@@ -801,6 +864,11 @@ class KVStoreDist(KVStore):
                           "body": str(body)})
 
     def set_gradient_compression(self, compression_params) -> None:
+        """Install worker-side encode (error feedback stays per-key on
+        THIS worker — the residual is local state, never pushed) and
+        ship the config to every server so their decompress matches
+        (ref: kvstore_dist.h SetGradientCompression broadcasting the
+        params via the command channel)."""
         from .gradient_compression import GradientCompression
 
         params = dict(compression_params or {})
@@ -813,6 +881,37 @@ class KVStoreDist(KVStore):
                               "type": self._gc.type,
                               "threshold": self._gc.threshold})
         self.barrier()
+
+    def _push_wire_nbytes(self, key, value) -> int:
+        """With compression on, what travels is the packed 2-bit codes
+        of ONE merged array per key — ceil(n/4) bytes — not the dense
+        float payload; sparse pushes keep the rows+data accounting
+        (they stay uncompressed, matching _do_push).  This is the
+        number mxnet_kvstore_bytes_total{op=push} must report for the
+        wire-pressure claim to be auditable."""
+        if self._gc is None:
+            return _payload_nbytes(value)
+        try:
+            from .gradient_compression import GradientCompression
+            from .ndarray import sparse as _sp
+
+            total = 0
+            _keys, values = _key_value(key, value)
+            for vlist in values:
+                vs = _as_list(vlist)
+                if not vs:
+                    continue
+                merged = vs[0]
+                if isinstance(merged, _sp.RowSparseNDArray):
+                    total += _payload_nbytes(merged)
+                    continue
+                n = 1
+                for d in merged.shape:
+                    n *= int(d)
+                total += GradientCompression.wire_nbytes(n)
+            return total
+        except Exception:
+            return _payload_nbytes(value)
 
     def get_optimizer_states_bytes(self, dump_optimizer: bool = False,
                                    timeout: Optional[float] = None

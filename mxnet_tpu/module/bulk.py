@@ -208,12 +208,17 @@ class BulkTrainLoop:
             for nm in io_names:
                 if ex.arg_dict[nm].shape[0] % n_dp:
                     bucketed = False
-        plan = _buckets.partition(
+        plan, tuning = _buckets.plan_with_tuning(
             [(name, tuple(ex.arg_dict[name].shape),
               ex.arg_dict[name].dtype) for _i, name in trainable]) \
-            if bucketed else None
+            if bucketed else (None, None)
+        # hierarchical impl: per-host grouping along the dp axis
+        hier_local_n = _buckets.host_local_count(mesh) \
+            if bucketed and _buckets.impl_name() == "hierarchical" \
+            else None
         self._bucketed = bucketed
         self._bucket_plan = plan
+        self._bucket_tuning = tuning
 
         def one_step(params, aux_vals, state_leaves, data_parts, key_root,
                      ctr, lr):
@@ -247,9 +252,9 @@ class BulkTrainLoop:
                 # batch-normalized ops already divided by the GLOBAL
                 # count under the cross-device context)
                 grads = {**dict(grads),
-                         **_buckets.bucketed_reduce(dict(grads), plan,
-                                                    "dp", n=n_dp,
-                                                    mean=False)}
+                         **_buckets.bucketed_reduce(
+                             dict(grads), plan, "dp", n=n_dp,
+                             mean=False, local_n=hier_local_n)}
 
             # ---- optimizer via trace adapter ----
             saved = (opt.lr_scheduler, opt.__dict__.get("lr"),
@@ -329,7 +334,9 @@ class BulkTrainLoop:
         # silently doubles epoch time
         from .. import diagnostics as _diag
 
-        plan_meta_v = _buckets.plan_meta(plan) if bucketed else None
+        plan_meta_v = _buckets.plan_meta(
+            plan, tuning["cap_bytes"] if tuning else None,
+            tuning=tuning) if bucketed else None
         if bucketed:
             _diag.set_bucket_plan(plan_meta_v, owner=id(self))
         else:

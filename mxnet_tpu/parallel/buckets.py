@@ -42,8 +42,9 @@ from .. import env as _env
 
 __all__ = [
     "DEFAULT_BUCKET_BYTES", "Bucket", "bucket_cap_bytes", "chain_enabled",
-    "impl_name", "partition", "plan_for_arrays", "bucketed_reduce",
-    "ring_allreduce_flat", "accounting", "plan_meta", "stamp_profiler",
+    "impl_name", "partition", "plan_for_arrays", "plan_with_tuning",
+    "bucketed_reduce", "ring_allreduce_flat", "hierarchical_reduce_flat",
+    "host_local_count", "accounting", "plan_meta", "stamp_profiler",
 ]
 
 DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
@@ -69,9 +70,12 @@ def chain_enabled() -> bool:
 
 
 def impl_name() -> str:
-    """'psum' (default) or 'ring' (manual ppermute reduce-scatter/
+    """'psum' (default), 'ring' (manual ppermute reduce-scatter/
     all-gather — collective-permutes can never be combined into one
-    all-reduce, and are the pattern ring_attention.py already overlaps)."""
+    all-reduce, and are the pattern ring_attention.py already overlaps)
+    or 'hierarchical' (intra-host psum then inter-host ring — the
+    two-tier schedule multi-host meshes want when intra-host ICI is an
+    order of magnitude faster than the host-to-host links)."""
     return _env.get_str("MXNET_KVSTORE_BUCKET_IMPL")
 
 
@@ -90,19 +94,31 @@ def _nbytes(shape, dtype) -> int:
     return n * item
 
 
-def partition(entries: Sequence[Tuple], cap_bytes: Optional[int] = None
-              ) -> List[Bucket]:
+def partition(entries: Sequence[Tuple], cap_bytes: Optional[int] = None,
+              *, first_cap_bytes: Optional[int] = None,
+              last_cap_bytes: Optional[int] = None) -> List[Bucket]:
     """Partition ``entries`` — ``(key, shape, dtype)`` in LAYER ORDER
     (forward execution order) — into reverse-layer-order buckets.
 
     Deterministic greedy fill over ``reversed(entries)``: a bucket
-    closes when adding the next gradient would exceed ``cap_bytes`` or
+    closes when adding the next gradient would exceed its cap or
     change dtype; a single gradient larger than the cap gets a bucket
     of its own.  Every key lands in exactly one bucket.
+
+    First/last asymmetry (the autotuner's knobs, mxnet_tpu/autotune):
+    ``first_cap_bytes`` caps bucket 0 separately — a SMALL first bucket
+    puts the first reduction on the wire while backward has barely
+    started; ``last_cap_bytes`` (> cap) folds trailing buckets together
+    — the tail reductions issue after backward ends, so fewer, larger
+    launches cost nothing in overlap.  Tail folding never touches
+    bucket 0 (that would undo the first-bucket asymmetry) and never
+    mixes dtypes.
     """
     if cap_bytes is None:
         cap_bytes = bucket_cap_bytes()
     cap = max(int(cap_bytes), 1)
+    first_cap = cap if first_cap_bytes is None \
+        else max(int(first_cap_bytes), 1)
     buckets: List[Bucket] = []
     cur_keys: List = []
     cur_bytes = 0
@@ -117,13 +133,51 @@ def partition(entries: Sequence[Tuple], cap_bytes: Optional[int] = None
     for key, shape, dtype in reversed(list(entries)):
         nb = _nbytes(shape, dtype)
         dt = str(dtype)
-        if cur_keys and (cur_dtype != dt or cur_bytes + nb > cap):
+        active = first_cap if not buckets else cap
+        if cur_keys and (cur_dtype != dt or cur_bytes + nb > active):
             flush()
         cur_keys.append(key)
         cur_bytes += nb
         cur_dtype = dt
     flush()
+    if last_cap_bytes is not None and int(last_cap_bytes) > cap:
+        lcap = int(last_cap_bytes)
+        while len(buckets) > 2 and \
+                buckets[-2].dtype == buckets[-1].dtype and \
+                buckets[-2].nbytes + buckets[-1].nbytes <= lcap:
+            tail = buckets.pop()
+            prev = buckets.pop()
+            buckets.append(Bucket(prev.keys + tail.keys,
+                                  prev.nbytes + tail.nbytes, prev.dtype))
     return buckets
+
+
+def plan_with_tuning(entries: Sequence[Tuple],
+                     cap_bytes: Optional[int] = None
+                     ) -> Tuple[List[Bucket], Optional[Dict]]:
+    """Partition under the autotuned caps when a tuned plan applies
+    (MXNET_AUTOTUNE_PLAN / MXNET_AUTOTUNE_DIR — autotune/plan.py),
+    falling back to the MXNET_KVSTORE_BUCKET_BYTES default otherwise.
+
+    Returns ``(plan, tuning_meta)``; ``tuning_meta`` is None on the
+    untuned path and the applied caps + plan provenance otherwise (the
+    meta rides plan_meta into flight-recorder/BENCH/SCALING stamps).
+    An EXPLICIT ``cap_bytes`` bypasses tuning entirely — a caller
+    pinning a cap means it."""
+    if cap_bytes is not None:
+        return partition(entries, cap_bytes), None
+    entry_list = list(entries)
+    total = sum(_nbytes(shape, dtype) for _k, shape, dtype in entry_list)
+    from ..autotune import plan as _aplan  # lazy: no import cycle
+
+    caps, _path = _aplan.resolve_caps(total_bytes=total,
+                                      n_grads=len(entry_list))
+    if caps is None:
+        return partition(entry_list, None), None
+    plan = partition(entry_list, caps["cap_bytes"],
+                     first_cap_bytes=caps.get("first_cap_bytes"),
+                     last_cap_bytes=caps.get("last_cap_bytes"))
+    return plan, dict(caps)
 
 
 def plan_for_arrays(named: Mapping, cap_bytes: Optional[int] = None
@@ -171,16 +225,103 @@ def ring_allreduce_flat(flat, axis_name: str, n: int):
     return full[:size]
 
 
+def hierarchical_reduce_flat(flat, axis_name: str, n: int, local_n: int):
+    """Two-tier all-reduce of a flat buffer for multi-host meshes:
+    intra-host ``lax.psum`` over groups of ``local_n`` consecutive
+    devices on the axis, then an inter-host ppermute ring (reduce-
+    scatter + all-gather over H = n/local_n hops) run in ``local_n``
+    parallel rings — one per local index, so every device participates
+    and the host-to-host traffic is the ring-optimal 2(H-1)/H of the
+    payload per link instead of an n-wide flat ring's mixed-tier hops.
+    This is the NCCL hierarchical/tree schedule the reference's
+    KVStoreNCCL+PS split approximated: fast links absorb the dense
+    intra-host sum, only one tier's worth of aggregate crosses hosts.
+    Must run inside shard_map over ``axis_name``; requires
+    ``n % local_n == 0`` with hosts contiguous on the axis
+    (host_local_count checks that)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    L = int(local_n)
+    H = n // L
+    intra = [[h * L + i for i in range(L)] for h in range(H)]
+    part = lax.psum(flat, axis_name, axis_index_groups=intra)
+    if H == 1:
+        return part
+    size = flat.shape[0]
+    pad = (-size) % H
+    buf = jnp.pad(part, (0, pad)).reshape(H, -1)
+    idx = lax.axis_index(axis_name)
+    h_idx = idx // L
+    # one ring per local index: device (h, i) -> ((h+1) % H, i)
+    perm = [(h * L + i, ((h + 1) % H) * L + i)
+            for h in range(H) for i in range(L)]
+
+    # reduce-scatter over hosts (same schedule as ring_allreduce_flat,
+    # ring position = host index)
+    acc = jnp.take(buf, (h_idx - 1) % H, axis=0)
+    for s in range(1, H):
+        acc = lax.ppermute(acc, axis_name, perm)
+        acc = acc + jnp.take(buf, (h_idx - 1 - s) % H, axis=0)
+
+    # all-gather: rotate the finished chunks around the host ring
+    parts = [acc]
+    cur = acc
+    for _ in range(H - 1):
+        cur = lax.ppermute(cur, axis_name, perm)
+        parts.append(cur)
+    stacked = jnp.stack(parts)
+    order = (h_idx - jnp.arange(H)) % H
+    full = jnp.take(stacked, order, axis=0).reshape(-1)
+    return full[:size]
+
+
+def host_local_count(mesh) -> Optional[int]:
+    """Per-host device count along a mesh's flattened device order,
+    when every host's devices are CONTIGUOUS on the axis and equally
+    sized — the layout hierarchical_reduce_flat's index arithmetic
+    assumes.  None when the topology doesn't qualify (single device,
+    ragged hosts, interleaved placement): callers fall back to the flat
+    psum.  On a single-host mesh this returns n (H=1 — the hierarchical
+    schedule degenerates to one intra-host psum, numerically identical
+    to the flat reduction)."""
+    try:
+        devs = list(mesh.devices.flat)
+        n = len(devs)
+        if n < 2:
+            return None
+        procs = [int(getattr(d, "process_index", 0)) for d in devs]
+        L = 1
+        while L < n and procs[L] == procs[0]:
+            L += 1
+        if n % L:
+            return None
+        block_procs = []
+        for h in range(n // L):
+            block = procs[h * L:(h + 1) * L]
+            if len(set(block)) != 1:
+                return None  # ragged host
+            block_procs.append(block[0])
+        if len(set(block_procs)) != len(block_procs):
+            return None  # a host's devices are split across blocks
+        return L
+    except Exception:
+        return None
+
+
 def bucketed_reduce(grads: Mapping, plan: Sequence[Bucket],
                     axis_name: str, *, n: int, mean: bool = False,
                     chain: Optional[bool] = None,
-                    impl: Optional[str] = None) -> Dict:
+                    impl: Optional[str] = None,
+                    local_n: Optional[int] = None) -> Dict:
     """Reduce ``grads`` (``{key: local array}``) bucket by bucket over
     ``axis_name`` inside shard_map; returns ``{key: reduced array}``.
 
     ``mean`` divides by ``n`` (psum-mean — the data-parallel gradient of
     a global-mean loss); each bucket is one flat concat → one reduction
-    op; consecutive buckets chain via optimization_barrier.
+    op; consecutive buckets chain via optimization_barrier.  ``impl``
+    'hierarchical' needs ``local_n`` (host_local_count(mesh)); an
+    unqualified topology falls back to the flat psum.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -189,6 +330,8 @@ def bucketed_reduce(grads: Mapping, plan: Sequence[Bucket],
         chain = chain_enabled()
     if impl is None:
         impl = impl_name()
+    hier = (impl == "hierarchical" and n > 1 and local_n
+            and 0 < int(local_n) <= n and n % int(local_n) == 0)
     out: Dict = {}
     anchor = None
     inv_n = 1.0 / float(n)
@@ -203,6 +346,9 @@ def bucketed_reduce(grads: Mapping, plan: Sequence[Bucket],
             flat, _ = lax.optimization_barrier((flat, anchor))
         if impl == "ring" and n > 1:
             red = ring_allreduce_flat(flat, axis_name, n)
+        elif hier:
+            red = hierarchical_reduce_flat(flat, axis_name, n,
+                                           int(local_n))
         else:
             red = lax.psum(flat, axis_name)
         if mean and n > 1:
@@ -224,13 +370,16 @@ def accounting(plan: Sequence[Bucket]) -> List[Dict]:
 
 
 def plan_meta(plan: Optional[Sequence[Bucket]],
-              cap_bytes: Optional[int] = None) -> Dict:
+              cap_bytes: Optional[int] = None,
+              tuning: Optional[Dict] = None) -> Dict:
     """Self-describing summary of one reduction schedule — stamped into
     the flight-recorder header (diagnostics.py) and the BENCH_*/
     SCALING_* perf artifacts so every dump records which bucket plan
-    produced it."""
+    produced it.  ``tuning`` (plan_with_tuning's meta) records that —
+    and from which plan file — the caps were autotuned rather than the
+    env default."""
     plan = list(plan or ())
-    return {
+    out = {
         "n_buckets": len(plan),
         "total_bytes": sum(int(b.nbytes) for b in plan),
         "cap_bytes": bucket_cap_bytes() if cap_bytes is None
@@ -239,6 +388,15 @@ def plan_meta(plan: Optional[Sequence[Bucket]],
         "chained": chain_enabled(),
         "buckets": accounting(plan),
     }
+    if tuning is not None:
+        out["autotune"] = {
+            "plan_path": tuning.get("plan_path"),
+            "cap_bytes": tuning.get("cap_bytes"),
+            "first_cap_bytes": tuning.get("first_cap_bytes"),
+            "last_cap_bytes": tuning.get("last_cap_bytes"),
+            "score": tuning.get("score"),
+        }
+    return out
 
 
 def stamp_profiler(plan: Sequence[Bucket], *, impl: Optional[str] = None,

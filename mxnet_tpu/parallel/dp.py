@@ -273,17 +273,33 @@ class FusedTrainStep:
         self._bucketed = bool(
             cap != 0 and tuple(self.mesh.axis_names) == ("dp",)
             and n_dp > 1 and not any_param_spec)
+        self._bucket_tuning = None
         if self._bucketed:
-            self._bucket_plan = _buckets.partition(
-                [(i, tuple(self._cells[i].data()._data.shape),
-                  self._cells[i].data()._data.dtype)
-                 for i in range(n_params) if i not in aux_idx], cap)
+            grad_entries = [
+                (i, tuple(self._cells[i].data()._data.shape),
+                 self._cells[i].data()._data.dtype)
+                for i in range(n_params) if i not in aux_idx]
+            # autotuned caps (MXNET_AUTOTUNE_PLAN / MXNET_AUTOTUNE_DIR)
+            # replace the fixed env cap when a tuned plan matches this
+            # exchange; an explicit bucket_bytes= pins the cap and
+            # bypasses tuning
+            self._bucket_plan, self._bucket_tuning = \
+                _buckets.plan_with_tuning(grad_entries,
+                                          self._bucket_bytes)
+            if self._bucket_tuning is not None:
+                cap = self._bucket_tuning["cap_bytes"]
         plan = self._bucket_plan
         # flight-recorder header: which reduction schedule this process
         # is issuing (diagnostics.py; --health cross-checks it per rank)
         from .. import diagnostics as _diag
 
-        plan_meta_v = _buckets.plan_meta(plan, cap) if self._bucketed \
+        plan_meta_v = _buckets.plan_meta(plan, cap,
+                                         tuning=self._bucket_tuning) \
+            if self._bucketed else None
+        # hierarchical impl: per-host device count along the dp axis
+        # (None on unqualified topologies -> flat psum fallback)
+        hier_local_n = _buckets.host_local_count(self.mesh) \
+            if self._bucketed and _buckets.impl_name() == "hierarchical" \
             else None
         if self._bucketed:
             _diag.set_bucket_plan(plan_meta_v, owner=id(self))
@@ -335,9 +351,14 @@ class FusedTrainStep:
                 # pmean of the per-device grads of the per-device mean
                 # loss = the global-batch gradient; issued per bucket in
                 # reverse layer order so later-layer reductions overlap
-                # earlier-layer backward compute
+                # earlier-layer backward compute.  impl=hierarchical
+                # reduces intra-host first, then rings the host tier
+                # (local_n keyed off the mesh's host topology; an
+                # unqualified topology falls back to the flat psum
+                # inside bucketed_reduce)
                 grads = _buckets.bucketed_reduce(grads, plan, "dp",
-                                                 n=n_dp, mean=True)
+                                                 n=n_dp, mean=True,
+                                                 local_n=hier_local_n)
                 loss_val = _lx.pmean(loss_val, "dp")
 
             new_params = []
@@ -498,6 +519,14 @@ class FusedTrainStep:
         from . import buckets as _buckets
 
         return _buckets.accounting(self._bucket_plan)
+
+    def bucket_tuning(self):
+        """The autotune meta the bucket plan was built under (caps +
+        plan-file provenance; None when the env default applied or the
+        step is monolithic)."""
+        if not (self._built and self._bucketed):
+            return None
+        return self._bucket_tuning
 
     def _stamp_bucket_telemetry(self):
         """Per-bucket comms spans + byte counters (PR-1 telemetry layer)

@@ -297,6 +297,37 @@ def mp_placement_sweep(timeout: int = 1200) -> Dict:
     return out
 
 
+def resnet50_grad_entries(dtype: str = "float32") -> List[tuple]:
+    """The data-parallel resnet50 gradient exchange's raw leaves:
+    ``(name, shape, dtype)`` for every trainable param in LAYER order —
+    exactly what buckets.partition / the autotuner's leaf-granularity
+    timing model consume.  One eager forward settles deferred shapes;
+    no train compile."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    np.random.seed(0)
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    with autograd.pause():
+        net(nd.random.uniform(shape=(1, 3, 224, 224)))
+    return [(name, tuple(p.shape), dtype)
+            for name, p in net.collect_params().items()
+            if p.grad_req != "null"]
+
+
+def resnet50_grad_leaf_bytes(dtype: str = "float32") -> List[int]:
+    """Per-gradient leaf payload bytes in LAYER order — the autotuner's
+    exact-granularity input (autotune.from_leaf_bytes)."""
+    from . import buckets as _buckets
+
+    return [_buckets._nbytes(shape, dt)
+            for _name, shape, dt in resnet50_grad_entries(dtype)]
+
+
 def resnet50_bucket_bytes(dtype: str = "float32",
                           cap_bytes: Optional[int] = None) -> List[int]:
     """Per-bucket payload bytes of the data-parallel resnet50 exchange:
@@ -304,35 +335,33 @@ def resnet50_bucket_bytes(dtype: str = "float32",
     SAME reverse-layer-order partitioner the in-graph exchange uses
     (parallel/buckets.py) — no compile needed, ground truth for the
     bucket-pipeline projection."""
-    import numpy as np
-
-    import mxnet_tpu as mx
-    from mxnet_tpu import autograd, nd
-    from mxnet_tpu.gluon.model_zoo import vision
-
     from . import buckets as _buckets
 
-    np.random.seed(0)
-    net = vision.resnet50_v1(classes=1000)
-    net.initialize(mx.init.Xavier())
-    with autograd.pause():
-        net(nd.random.uniform(shape=(1, 3, 224, 224)))
-    entries = [(name, tuple(p.shape), dtype)
-               for name, p in net.collect_params().items()
-               if p.grad_req != "null"]
-    plan = _buckets.partition(entries, cap_bytes)
+    plan = _buckets.partition(resnet50_grad_entries(dtype), cap_bytes)
     return [int(b.nbytes) for b in plan]
 
 
 def simulate_bucketed_overlap(bucket_bytes: Sequence[int],
                               step_time_s: float, n: int,
                               ici_GBps: float = 45.0,
-                              backward_frac: float = 2.0 / 3.0) -> Dict:
+                              backward_frac: float = 2.0 / 3.0,
+                              coll_latency_s: float = 0.0,
+                              readiness: str = "uniform") -> Dict:
     """DDP pipeline model over a measured bucket plan: bucket k's
-    reduction becomes issueable at (k+1)/B of backward (reverse layer
-    order, uniform-compute assumption) and reductions serialize on the
-    comm stream (the chained-psum / NCCL-stream semantics); whatever
-    comm time runs past the end of backward is exposed.
+    reduction becomes issueable partway through backward (reverse layer
+    order) and reductions serialize on the comm stream (the
+    chained-psum / NCCL-stream semantics); whatever comm time runs past
+    the end of backward is exposed.
+
+    ``readiness`` picks the issueability model: ``'uniform'`` (the r6
+    default — bucket k at (k+1)/B of backward, uniform compute per
+    bucket) or ``'bytes'`` (bucket k when its cumulative byte share of
+    backward has run — the autotuner's model, where a small FIRST
+    bucket genuinely starts comm earlier).  ``coll_latency_s`` adds a
+    per-reduction launch cost (ring setup + dispatch): with it the cap
+    sweep has a real optimum — too-small buckets pay B launches,
+    too-large buckets expose the comm tail.  Defaults reproduce the r6
+    behavior exactly.
 
     A MODEL, not a measured schedule — returned with its assumptions so
     the artifact can never pass it off as a measurement."""
@@ -340,16 +369,21 @@ def simulate_bucketed_overlap(bucket_bytes: Sequence[int],
     ring = 2.0 * (n - 1) / n
     clock, total = 0.0, 0.0
     B = max(len(bucket_bytes), 1)
+    total_bytes = float(sum(bucket_bytes)) or 1.0
+    cum = 0
     for k, nbytes in enumerate(bucket_bytes):
-        ready = (k + 1) / B * t_bwd
-        dur = ring * nbytes / (ici_GBps * 1e9)
+        cum += nbytes
+        ready = (cum / total_bytes if readiness == "bytes"
+                 else (k + 1) / B) * t_bwd
+        dur = coll_latency_s + ring * nbytes / (ici_GBps * 1e9)
         clock = max(clock, ready) + dur
         total += dur
     exposed = max(0.0, clock - t_bwd)
     overlap = 1.0 - exposed / total if total else 1.0
     return {"overlap": round(max(0.0, min(1.0, overlap)), 4),
             "exposed_s": exposed, "t_comm_total_s": total,
-            "t_backward_s": t_bwd, "n_buckets": len(bucket_bytes)}
+            "t_backward_s": t_bwd, "n_buckets": len(bucket_bytes),
+            "coll_latency_s": coll_latency_s, "readiness": readiness}
 
 
 def project_efficiency_bucketed(bucket_bytes: Sequence[int],
@@ -357,27 +391,35 @@ def project_efficiency_bucketed(bucket_bytes: Sequence[int],
                                 chips: Sequence[int] = (8, 16, 32, 64,
                                                         128, 256),
                                 ici_GBps: float = 45.0,
-                                backward_frac: float = 2.0 / 3.0) -> Dict:
+                                backward_frac: float = 2.0 / 3.0,
+                                coll_latency_s: float = 0.0,
+                                readiness: str = "uniform") -> Dict:
     """Scaling projection under the bucket-pipeline model:
-    eff(n) = t_step / (t_step + exposed(n))."""
+    eff(n) = t_step / (t_step + exposed(n)).  ``coll_latency_s`` /
+    ``readiness`` thread through to simulate_bucketed_overlap (the
+    autotuner scores candidates under readiness='bytes' + a stated
+    launch cost; defaults reproduce r6)."""
     table = {}
     detail = {}
     for n in chips:
         sim = simulate_bucketed_overlap(bucket_bytes, step_time_s, n,
-                                        ici_GBps, backward_frac)
+                                        ici_GBps, backward_frac,
+                                        coll_latency_s=coll_latency_s,
+                                        readiness=readiness)
         table[str(n)] = round(
             step_time_s / (step_time_s + sim["exposed_s"]), 4)
         detail[str(n)] = sim["overlap"]
     return {
         "model": "bucket-pipeline: reverse-layer-order buckets become "
-                 "issueable uniformly through backward, serialize on "
-                 "the comm stream; eff = t_step/(t_step + exposed). "
+                 "issueable through backward (%s readiness), serialize "
+                 "on the comm stream; eff = t_step/(t_step + exposed). "
                  "A MODEL over the measured bucket plan and step time, "
-                 "not a measured schedule",
+                 "not a measured schedule" % readiness,
         "bucket_bytes": list(int(b) for b in bucket_bytes),
         "step_time_s": step_time_s,
         "ici_GBps_assumed": ici_GBps,
         "backward_frac_assumed": backward_frac,
+        "coll_latency_s_assumed": coll_latency_s,
         "overlap_by_chips": detail,
         "projected_efficiency": table,
         "reference_resnet152_256gpu": 0.901,

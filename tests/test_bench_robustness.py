@@ -74,6 +74,31 @@ bench._emit_final()  # no-op
     assert out["vs_baseline"] == round(1200.0 / 109.0, 2)
 
 
+def test_final_json_stamps_autotune_and_compression():
+    """ISSUE 12 satellite: the final JSON carries the self-tuning-
+    collectives block — tuned-plan provenance (null when untuned) and
+    the 2-bit wire accounting (uncompressed vs compressed push bytes,
+    the real 16x encode verified inline) next to the bucketing block."""
+    code = """
+import bench
+bench._STATE["table"].append({"model": "resnet50_v1",
+                              "images_per_sec_per_chip": 1200.0})
+bench._emit_final()
+"""
+    proc = _run(code)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    out = json.loads([ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    at = out["autotune"]
+    assert "tuned_plan" in at and "plan_env" in at
+    comp = at["compression"]
+    assert comp["type"] == "2bit"
+    assert comp["push_bytes_uncompressed"] > comp["push_bytes_compressed"]
+    assert comp["wire_ratio"] == 16.0
+    assert "mxnet_kvstore_bytes_total_push" in comp
+    assert "bucketing" in out
+
+
 def test_headline_zero_when_no_resnet50():
     code = """
 import bench

@@ -69,6 +69,42 @@ def test_partition_never_mixes_dtypes():
     assert [b.keys for b in plan] == [("c", "b"), ("a",)]
 
 
+def test_partition_first_last_cap_asymmetry():
+    """The autotuner's knobs: bucket 0 capped separately (small first
+    bucket -> comm starts while backward has barely run) and trailing
+    buckets folded up to the last cap (tail reductions can't overlap
+    anything anyway)."""
+    entries = [("w%d" % i, (256,), "float32") for i in range(10)]  # 1 KB
+    plan = buckets.partition(entries, cap_bytes=3 * 1024,
+                             first_cap_bytes=1024,
+                             last_cap_bytes=6 * 1024)
+    assert plan[0].keys == ("w9",)  # first cap 1 KB
+    # middle bucket(s) at the 3 KB cap, tail folded to <= 6 KB
+    assert plan[1].keys == ("w8", "w7", "w6")
+    assert plan[-1].nbytes <= 6 * 1024
+    seen = [k for b in plan for k in b.keys]
+    assert sorted(seen) == sorted(e[0] for e in entries)
+    assert len(seen) == len(set(seen))
+    # tail folding never merges into bucket 0
+    assert plan[0].keys == ("w9",)
+    # symmetric call unchanged by the new kwargs' defaults
+    assert buckets.partition(entries, cap_bytes=3 * 1024) == \
+        buckets.partition(entries, 3 * 1024)
+
+
+def test_partition_last_cap_never_mixes_dtypes():
+    entries = [("a", (512,), "float32"), ("b", (512,), "float32"),
+               ("c", (512,), "bfloat16"), ("d", (512,), "bfloat16")]
+    plan = buckets.partition(entries, cap_bytes=1024,
+                             last_cap_bytes=1 << 20)
+    for b in plan:
+        assert len({b.dtype}) == 1
+    # folds stay within one dtype: no bucket ever spans the boundary
+    keys = [b.keys for b in plan]
+    assert all(set(k) <= {"a", "b"} or set(k) <= {"c", "d"}
+               for k in keys)
+
+
 def test_bucket_cap_env_knob(monkeypatch):
     monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "123456")
     assert buckets.bucket_cap_bytes() == 123456
@@ -81,7 +117,8 @@ def test_bucket_cap_env_knob(monkeypatch):
 # ---------------------------------------------------------------------
 # reduction equality (shard_map, CPU mesh)
 # ---------------------------------------------------------------------
-def _reduce_on_mesh(grads_np, plan, impl="psum", mean=False):
+def _reduce_on_mesh(grads_np, plan, impl="psum", mean=False,
+                    local_n=None):
     """Run bucketed_reduce under shard_map on the 8-device mesh; device
     d contributes ``value * (d+1)`` per key (leading device axis
     sharded over dp)."""
@@ -96,7 +133,8 @@ def _reduce_on_mesh(grads_np, plan, impl="psum", mean=False):
     def local(args):
         stripped = {k: v.reshape(v.shape[1:]) for k, v in args.items()}
         return buckets.bucketed_reduce(stripped, plan, "dp", n=8,
-                                       mean=mean, impl=impl)
+                                       mean=mean, impl=impl,
+                                       local_n=local_n)
 
     fn = shard_map(local, mesh=mesh,
                    in_specs=(P("dp"),), out_specs=P(),
@@ -137,6 +175,62 @@ def test_ring_impl_matches_psum():
         np.testing.assert_allclose(np.asarray(out_ring[k]),
                                    np.asarray(out_psum[k]),
                                    rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("local_n", [2, 4, 8])
+def test_hierarchical_impl_matches_psum(local_n):
+    """Two-tier reduction (intra-host psum, inter-host ppermute ring):
+    the 8-device mesh split as H=8/local_n virtual hosts x local_n
+    devices must produce the flat psum's sums; local_n=8 is the
+    single-host degenerate case (pure intra psum)."""
+    _need_devices(8)
+    rng = np.random.RandomState(7)
+    grads = {i: rng.randn(*shape).astype("float32")
+             for i, shape in enumerate([(67,), (4, 11), (33,)])}
+    entries = [(i, g.shape, g.dtype) for i, g in grads.items()]
+    plan = buckets.partition(entries, cap_bytes=256)
+    out_psum = _reduce_on_mesh(grads, plan, impl="psum")
+    out_hier = _reduce_on_mesh(grads, plan, impl="hierarchical",
+                               local_n=local_n)
+    for k in grads:
+        np.testing.assert_allclose(np.asarray(out_hier[k]),
+                                   np.asarray(out_psum[k]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_hierarchical_without_local_n_falls_back_to_psum():
+    """An unqualified topology (no local_n) must not break: the
+    hierarchical impl silently reduces with the flat psum."""
+    _need_devices(8)
+    rng = np.random.RandomState(8)
+    grads = {0: rng.randn(16).astype("float32")}
+    plan = buckets.partition([(0, (16,), "float32")], cap_bytes=1 << 20)
+    out = _reduce_on_mesh(grads, plan, impl="hierarchical", local_n=None)
+    expect = grads[0] * sum(range(1, 9))
+    np.testing.assert_allclose(np.asarray(out[0]), expect, rtol=1e-5)
+
+
+def test_host_local_count_topologies():
+    """host_local_count keys the hierarchical grouping off the mesh's
+    process layout: contiguous equal blocks qualify, everything else
+    (single device, ragged, interleaved) falls back."""
+    class _Dev:
+        def __init__(self, p):
+            self.process_index = p
+
+    class _Mesh:
+        def __init__(self, procs):
+            self.devices = np.array([_Dev(p) for p in procs],
+                                    dtype=object)
+
+    assert buckets.host_local_count(_Mesh([0, 0, 1, 1])) == 2
+    assert buckets.host_local_count(_Mesh([0, 0, 0, 0])) == 4
+    assert buckets.host_local_count(_Mesh([0, 0, 0, 1])) is None  # ragged
+    assert buckets.host_local_count(_Mesh([0, 1, 0, 1])) is None  # interleaved
+    assert buckets.host_local_count(_Mesh([0])) is None
+    # the real single-host CPU mesh: every device is process 0
+    mesh = make_mesh((8,), ("dp",))
+    assert buckets.host_local_count(mesh) == 8
 
 
 # ---------------------------------------------------------------------
@@ -226,6 +320,98 @@ def test_fused_step_run_steps_bucketed_equals_monolithic():
     l_m = _bn_step(mesh, bucket_bytes=1 << 40).run_steps(X, y, steps=4)
     np.testing.assert_allclose(l_b.asnumpy(), l_m.asnumpy(),
                                rtol=1e-7, atol=1e-7)
+
+
+# ---------------------------------------------------------------------
+# autotuned plans: numerics regression (ISSUE 12 satellite) — a tuned
+# plan is a different SCHEDULE of the same arithmetic, so trajectories
+# must match the monolithic-psum path at fp tolerance on the dp=2 mesh
+# ---------------------------------------------------------------------
+def _autotune_plan_file(tmp_path, **caps):
+    plan = {"format": "mxnet-tpu-autotune-plan", "version": 1,
+            "cap_bytes": caps.get("cap_bytes", 2048),
+            "first_cap_bytes": caps.get("first_cap_bytes"),
+            "last_cap_bytes": caps.get("last_cap_bytes"),
+            "fingerprint": None}
+    path = str(tmp_path / "plan.json")
+    with open(path, "w") as f:
+        json.dump(plan, f)
+    return path
+
+
+_AT_PREFIX = [0]
+
+
+def _bn_step2(mesh, bucket_bytes, seed=3):
+    """Same net family as _bn_step but prefix-isolated per build so the
+    autotuned steps never share parameter cells."""
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    _AT_PREFIX[0] += 1
+    net = nn.HybridSequential(prefix="at%d_" % _AT_PREFIX[0])
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"))
+        net.add(nn.BatchNorm())
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, learning_rate=0.1, momentum=0.9,
+                          bucket_bytes=bucket_bytes)
+
+
+def test_fused_step_autotuned_plan_equals_monolithic(tmp_path,
+                                                     monkeypatch):
+    """An autotuned plan (caps != 4 MiB, asymmetric first/last) on the
+    CPU dp=2 mesh reproduces the monolithic-psum trajectory at ~1e-7,
+    and the tuning provenance lands in the step's plan stamp."""
+    _need_devices(2)
+    from mxnet_tpu import diagnostics
+
+    mesh = make_mesh((2,), ("dp",))
+    X = nd.array(np.random.RandomState(5).rand(16, 6).astype("float32"))
+    y = nd.array(np.random.RandomState(6).randint(0, 4, 16)
+                 .astype("float32"))
+    path = _autotune_plan_file(tmp_path, cap_bytes=2048,
+                               first_cap_bytes=1024,
+                               last_cap_bytes=8192)
+    monkeypatch.setenv("MXNET_AUTOTUNE_PLAN", path)
+    step_tuned = _bn_step2(mesh, None)  # bucket_bytes=None -> tuned
+    t_tuned = _traj(step_tuned, X, y)
+    assert step_tuned.bucketed
+    tuning = step_tuned.bucket_tuning()
+    assert tuning is not None and tuning["plan_path"] == path
+    assert tuning["cap_bytes"] == 2048
+    # every bucket honors the tuned caps (first bucket the small one)
+    acct = step_tuned.bucket_accounting()
+    assert acct[0]["bytes"] <= 1024
+    assert all(b["bytes"] <= 8192 for b in acct)
+    # the flight-recorder header stamp carries the tuning provenance
+    stamped = diagnostics.bucket_plan()
+    assert stamped and stamped.get("autotune", {}).get("plan_path") == path
+
+    monkeypatch.delenv("MXNET_AUTOTUNE_PLAN")
+    t_mono = _traj(_bn_step2(mesh, 1 << 40), X, y)
+    np.testing.assert_allclose(t_tuned, t_mono, rtol=1e-7, atol=1e-7)
+
+
+def test_fused_step_degenerate_one_bucket_plan_equals_monolithic(
+        tmp_path, monkeypatch):
+    """The degenerate tuned plan (one huge cap -> 1 bucket) is exactly
+    the monolithic concat-psum: trajectories must agree at ~1e-7."""
+    _need_devices(2)
+    mesh = make_mesh((2,), ("dp",))
+    X = nd.array(np.random.RandomState(5).rand(16, 6).astype("float32"))
+    y = nd.array(np.random.RandomState(6).randint(0, 4, 16)
+                 .astype("float32"))
+    path = _autotune_plan_file(tmp_path, cap_bytes=1 << 40)
+    monkeypatch.setenv("MXNET_AUTOTUNE_PLAN", path)
+    step = _bn_step2(mesh, None)
+    t_one = _traj(step, X, y)
+    assert step.bucketed and len(step.bucket_accounting()) == 1
+    monkeypatch.delenv("MXNET_AUTOTUNE_PLAN")
+    t_mono = _traj(_bn_step2(mesh, 1 << 40), X, y)
+    np.testing.assert_allclose(t_one, t_mono, rtol=1e-7, atol=1e-7)
 
 
 # ---------------------------------------------------------------------

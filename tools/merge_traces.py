@@ -344,6 +344,59 @@ def analyze_io_overlap(traces):
     return out or None
 
 
+def bucket_timings(flight):
+    """Per-rank per-bucket enqueue→complete durations from flight
+    dumps — the autotuner's offline input
+    (mxnet_tpu.autotune.from_bucket_timings).  Every collective verb
+    that can carry gradient traffic is exported (bucket_reduce, push,
+    allreduce); ``in_graph`` marks issue-schedule stamps whose
+    durations are NOT wire time (the autotuner excludes them from
+    bandwidth estimation), and each rank's stamped bucket plan rides
+    along so the tuner can reconstruct the payload stream."""
+    out = {"format": "bucket-timings", "version": 1, "ranks": {}}
+    for rank, payload in sorted(flight.items()):
+        rows = []
+        for e in payload.get("entries", []):
+            op = e.get("op")
+            if op not in ("bucket_reduce", "push", "allreduce"):
+                continue
+            enq, comp = e.get("enqueue_ts"), e.get("complete_ts")
+            dur = None
+            if enq is not None and comp is not None:
+                dur = float(comp) - float(enq)
+            rows.append({
+                "seq": e.get("seq"), "op": op,
+                "bucket": e.get("bucket"), "bytes": e.get("bytes"),
+                "dtype": e.get("dtype"), "state": e.get("state"),
+                "enqueue_ts": enq, "complete_ts": comp,
+                "duration_s": dur,
+                "in_graph": bool((e.get("args") or {}).get("in_graph")),
+            })
+        out["ranks"][str(rank)] = {
+            "bucket_plan": payload.get("header", {}).get("bucket_plan"),
+            "timings": rows,
+        }
+    return out
+
+
+def run_bucket_timings(paths, out_path=None) -> int:
+    flight, _traces = load_health_inputs(paths)
+    if not flight:
+        print("no flight-recorder dumps among the inputs", file=sys.stderr)
+        return 1
+    payload = bucket_timings(flight)
+    text = json.dumps(payload, indent=1)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text)
+        n = sum(len(r["timings"]) for r in payload["ranks"].values())
+        print("bucket timings: %d rank(s), %d row(s) -> %s"
+              % (len(payload["ranks"]), n, out_path))
+    else:
+        print(text)
+    return 0
+
+
 def health_report(flight, traces):
     report = {"n_flight_dumps": len(flight), "n_trace_dumps": len(traces),
               "desync": analyze_desync(flight)}
@@ -565,6 +618,35 @@ def self_test() -> int:
         assert _overlap_us([(0.0, 10.0), (2.0, 8.0)],
                            [(0.0, 10.0)]) == 10.0
         assert _union_us([(0.0, 10.0), (2.0, 8.0)]) == 10.0
+
+        # --bucket-timings: the autotuner's offline export — per-rank
+        # rows with enqueue→complete durations + the stamped plan
+        bt_out = os.path.join(d, "bucket_timings.json")
+        rc = run_bucket_timings([f0, f1], bt_out)
+        assert rc == 0
+        with open(bt_out) as f:
+            bt = json.load(f)
+        assert bt["format"] == "bucket-timings" and set(bt["ranks"]) == \
+            {"0", "1"}, bt
+        r0 = bt["ranks"]["0"]
+        assert r0["bucket_plan"]["n_buckets"] == 3
+        assert len(r0["timings"]) == 13, len(r0["timings"])
+        row = r0["timings"][0]
+        assert row["op"] == "bucket_reduce" and row["bucket"] == 0
+        assert abs(row["duration_s"] - 0.5) < 1e-9, row
+        assert row["in_graph"] is False
+        # rank 1's in-flight suspect has no completion: duration None
+        last = bt["ranks"]["1"]["timings"][-1]
+        assert last["state"] == "suspect" and last["duration_s"] is None
+        # the export round-trips into the autotuner's timing model
+        try:
+            from mxnet_tpu.autotune import timing as _at_timing
+        except ImportError:
+            _at_timing = None  # tool usable without the package on path
+        if _at_timing is not None:
+            tm = _at_timing.from_bucket_timings(bt, path=bt_out)
+            assert tm.n_units == 3 and tm.total_bytes == 3072
+            assert tm.recorded_cap_bytes == 4 << 20
     print("merge_traces self-test OK")
     return 0
 
@@ -582,6 +664,10 @@ def main(argv=None) -> int:
                     help="desync + straggler analysis over per-rank "
                          "flight-recorder and trace dumps; exit code 2 "
                          "when a desync is detected")
+    ap.add_argument("--bucket-timings", action="store_true",
+                    help="export per-rank per-bucket enqueue->complete "
+                         "durations as JSON (the input python -m "
+                         "mxnet_tpu.autotune --tune consumes)")
     ap.add_argument("--self-test", action="store_true",
                     help="run the built-in synthetic merge+health check "
                          "and exit")
@@ -592,6 +678,10 @@ def main(argv=None) -> int:
         if not args.inputs:
             ap.error("--health needs at least one rank dump")
         return run_health(args.inputs, args.output)
+    if args.bucket_timings:
+        if not args.inputs:
+            ap.error("--bucket-timings needs at least one flight dump")
+        return run_bucket_timings(args.inputs, args.output)
     if len(args.inputs) < 2:
         ap.error("need at least two rank traces to merge")
     if args.output is None:
