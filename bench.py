@@ -832,6 +832,128 @@ def _bench_serving_reload(srv):
         shutil.rmtree(ckdir, ignore_errors=True)
 
 
+def _transformer_dims():
+    """Transformer bench dims: MXNET_BENCH_TRANSFORMER 'k=v,...' over
+    the defaults — sized (like the fit probe) to land inside the 950 s
+    budget on a congested tunnel, not to flatter tokens/s."""
+    from mxnet_tpu import env as _mxenv
+
+    dims = {"layers": 4, "d_model": 256, "heads": 8, "seq": 256,
+            "batch": 8, "ff": 1024, "vocab": 2048}
+    spec = _mxenv.get_str("MXNET_BENCH_TRANSFORMER")
+    for part in (spec or "").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            if k.strip() in dims:
+                dims[k.strip()] = int(v)
+    return dims
+
+
+def bench_transformer(windows=3, bulk_k=8):
+    """The ROADMAP item-4 acceptance row: transformer-LM training
+    tokens/s (bf16, remat=block, one chip — or every local chip on a
+    dp axis), plus the ZeRO-1 optimizer-state memory block measured on
+    a dp=2 CPU child (per-rank momenta bytes sharded vs replicated,
+    from the LIVE buffers' addressable shards)."""
+    import jax
+
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.transformer import (LMTokenIter, TransformerConfig,
+                                       TransformerTrainStep)
+
+    dims = _transformer_dims()
+    cfg = TransformerConfig(
+        vocab_size=dims["vocab"], n_layers=dims["layers"],
+        d_model=dims["d_model"], n_heads=dims["heads"], d_ff=dims["ff"],
+        dtype="bfloat16")
+    n_dev = len(jax.devices())
+    mesh = make_mesh((n_dev,), ("dp",), jax.devices())
+    step = TransformerTrainStep(cfg, mesh=mesh, remat="block", seed=0)
+    it = LMTokenIter(batch_size=dims["batch"] * n_dev,
+                     seq_len=dims["seq"], vocab_size=dims["vocab"],
+                     num_sequences=max(2 * dims["batch"] * n_dev, 8))
+    batch = it.next()
+    X, y = batch.data[0], batch.label[0]
+    losses = step.run_steps(X, y, bulk_k)  # compile + warm
+    _drain(losses)
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.time()
+        losses = step.run_steps(X, y, bulk_k)
+        _drain(losses)
+        best = min(best, time.time() - t0)
+    toks = dims["batch"] * n_dev * dims["seq"] * bulk_k
+    row = {
+        "model": "transformer_lm",
+        "dims": dims,
+        "dtype": "bfloat16",
+        "remat": "block",
+        "attention_impl": step.attention_impl,
+        "zero_stage": 1 if step.zero1 else 0,
+        "n_chips": n_dev,
+        "bulk_steps": bulk_k,
+        "tokens_per_sec": round(toks / best, 1),
+        "sec_per_step": round(best / bulk_k, 5),
+        "final_loss": float(np.asarray(losses).reshape(-1)[-1]),
+        "bucketing": step.bucket_plan_meta() if n_dev > 1 else None,
+    }
+    row["zero1_memory"] = _transformer_zero1_memory_probe()
+    return row
+
+
+def _transformer_zero1_memory_probe(timeout=240):
+    """dp=2 CPU child: per-rank optimizer-state bytes, ZeRO-1 vs
+    replicated, measured from the live momenta buffers — the
+    acceptance evidence that stage 1 holds ~1/dp per rank."""
+    code = (
+        "import json, os\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from mxnet_tpu.parallel.mesh import make_mesh\n"
+        "from mxnet_tpu.transformer import (LMTokenIter, "
+        "TransformerConfig, TransformerTrainStep)\n"
+        "cfg = TransformerConfig(vocab_size=256, n_layers=2, "
+        "d_model=64, n_heads=4, d_ff=128)\n"
+        "mesh = make_mesh((2,), ('dp',), jax.devices()[:2])\n"
+        "it = LMTokenIter(batch_size=4, seq_len=32, vocab_size=256, "
+        "num_sequences=8)\n"
+        "b = it.next()\n"
+        "out = {}\n"
+        "for stage in (0, 1):\n"
+        "    s = TransformerTrainStep(cfg, mesh=mesh, seed=0, "
+        "zero_stage=stage)\n"
+        "    np.asarray(s.step(b.data[0], b.label[0]))\n"
+        "    out['stage%d_bytes_per_rank' % stage] = "
+        "s.optimizer_state_bytes_per_rank()\n"
+        "out['ratio'] = round(out['stage1_bytes_per_rank'] / "
+        "out['stage0_bytes_per_rank'], 4)\n"
+        "print('ZERO1MEM ' + json.dumps(out))\n")
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (flags +
+                        " --xla_force_host_platform_device_count=2"
+                        ).strip()
+    try:
+        proc = _tracked_run([sys.executable, "-c", code], text=True,
+                            timeout=timeout, env=env,
+                            cwd=os.path.dirname(os.path.abspath(
+                                __file__)))
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("ZERO1MEM "):
+                rec = json.loads(ln[len("ZERO1MEM "):])
+                rec["note"] = ("per-rank momenta bytes from live "
+                               "addressable shards on the dp=2 CPU "
+                               "mesh; stage1/stage0 ~ 1/dp")
+                return rec
+        return {"error": (proc.stdout + proc.stderr)[-300:]}
+    except Exception as exc:
+        return {"error": repr(exc)}
+
+
 def _sym_resnet50(num_classes=1000):
     """Symbolic ResNet-50 v1 (bottleneck 3-4-6-3, He et al. 2015 table 1)
     for the Module.fit path — built on mx.sym so the fit-loop bench
@@ -1041,6 +1163,7 @@ def _memory_probe(batch=16, bulk_k=2, img=128):
 _STATE = {
     "table": [], "io": None, "fit_loop": None, "bare_jax": [],
     "memory": None, "mfu_attribution": None, "serving": None,
+    "transformer": None,
     "headline": None, "peak": None, "kind": None, "emitted": False,
 }
 
@@ -1073,6 +1196,7 @@ def _emit_final(reason=None):
         "memory": _STATE["memory"],
         "mfu_attribution": _STATE["mfu_attribution"],
         "serving": _STATE["serving"],
+        "transformer": _STATE["transformer"],
     }
     # which reduction schedule produced these numbers: the bucketing
     # config + the last bucket plan the FusedTrainStep runs stamped into
@@ -1488,6 +1612,19 @@ def main():
     except Exception as exc:
         _STATE["serving"] = {"pipeline": "serving", "error": repr(exc)}
     _progress({"serving": _STATE["serving"]})
+
+    # ---- phase 3d: transformer-LM row (ROADMAP item 4 — tokens/s at
+    # downsized dims + the ZeRO-1 per-rank memory block) --------------
+    try:
+        if left() < 120:
+            raise RuntimeError("time budget spent before transformer "
+                               "row (elapsed %.0fs)" % elapsed())
+        _STATE["transformer"] = bench_transformer(
+            windows=2 if left() < 300 else 3)
+    except Exception as exc:
+        _STATE["transformer"] = {"pipeline": "transformer_lm",
+                                 "error": repr(exc)}
+    _progress({"transformer": _STATE["transformer"]})
 
     # io comparator: the bf16@32 headline row
     io_compute_ref, io_ref_label = None, None

@@ -199,6 +199,25 @@ register("MXNET_KVSTORE_SYNC_TIMEOUT", "float", 600.0,
 register("MXNET_BACKWARD_DO_MIRROR", "bool", False,
          "Keep only conv/matmul residuals and rematerialize cheap "
          "activations in backward (jax.checkpoint mirror policy).")
+register("MXNET_REMAT_POLICY", "str", "none",
+         "Per-block rematerialization for the transformer workload "
+         "tier: 'none', 'block' (keep only block-boundary residuals) "
+         "or 'attention' (recompute just the attention sub-graph).")
+
+# transformer/ — decoder-only LM workload tier
+register("MXNET_ATTENTION_IMPL", "str", "flash",
+         "Transformer attention implementation: 'flash' (single-chip "
+         "fused scan), 'ring' (KV rotation over the mesh's sp axis) "
+         "or 'ulysses' (all-to-all head resharding over sp).")
+register("MXNET_ZERO_STAGE", "int", 0,
+         "Optimizer-state sharding: 0 replicates momenta on every dp "
+         "rank (default); 1 = ZeRO-1 (each dp rank owns a 1/dp shard "
+         "of every bucket's momenta; grads reduce-scatter, the update "
+         "runs on the shard, params all-gather).")
+register("MXNET_BENCH_TRANSFORMER", "str", None,
+         "Transformer bench row dims as 'k=v,k=v' over layers/d_model/"
+         "heads/seq/batch/ff/vocab (bench.bench_transformer); unset "
+         "uses the budget-sized defaults.")
 
 # profiler.py — trace autostart (worker subprocess contract)
 register("MXNET_PROFILER_AUTOSTART", "bool", False,

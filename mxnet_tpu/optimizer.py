@@ -23,7 +23,9 @@ from .ndarray import ndarray as _nd
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "AdaDelta", "RMSProp",
            "Ftrl", "Adamax", "Nadam", "Signum", "SGLD", "DCASGD", "FTML",
-           "LBSGD", "Updater", "get_updater", "create", "register", "Test"]
+           "LBSGD", "Updater", "get_updater", "create", "register", "Test",
+           "fused_sgd_mom_flat", "fused_sgd_mom_grouped", "pack_flat",
+           "unpack_flat"]
 
 _REGISTRY: Dict[str, type] = {}
 
@@ -539,6 +541,70 @@ class Test(Optimizer):
 
     def update(self, index, weight, grad, state):
         weight._assign(weight + grad * self.rescale_grad)
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-tensor update (ROADMAP item 5): ONE elementwise update
+# over a flat concatenation of every parameter instead of a per-key op
+# per parameter.  These are jax-level building blocks consumed inside
+# compiled train steps (parallel/dp.py FusedTrainStep, the transformer
+# tier) — the per-key ``invoke`` path above stays for the Updater /
+# kvstore server-side-update heritage.  The math is elementwise and
+# dtype-preserving, so fused == per-key BITWISE (pinned in tests); the
+# ZeRO-1 sharded update runs the SAME op over each rank's shard.
+# ---------------------------------------------------------------------------
+def pack_flat(arrays):
+    """Concatenate arrays (homogeneous dtype) into one flat buffer."""
+    import jax.numpy as jnp
+
+    if len(arrays) == 1:
+        return arrays[0].ravel()
+    return jnp.concatenate([a.ravel() for a in arrays])
+
+
+def unpack_flat(flat, ref_arrays):
+    """Split a flat buffer back into ``ref_arrays``' shapes, in order."""
+    out = []
+    off = 0
+    for ref in ref_arrays:
+        sz = ref.size
+        out.append(flat[off:off + sz].reshape(ref.shape))
+        off += sz
+    return out
+
+
+def fused_sgd_mom_flat(flat_w, flat_g, flat_m, lr, momentum, wd):
+    """SGD-with-momentum over flat buffers: the one-op multi-tensor
+    update.  Identical elementwise math to the per-key path
+    (``g += wd*w; m = momentum*m - lr*g; w += m``); returns
+    ``(new_w, new_m)``."""
+    g = flat_g + wd * flat_w
+    m = momentum * flat_m - lr * g
+    return flat_w + m, m
+
+
+def fused_sgd_mom_grouped(keys, params, grads, moms, lr, momentum, wd):
+    """ONE fused update per dtype group over ``keys`` (ordered;
+    buckets never mix dtypes and neither may a concat): ``params`` /
+    ``grads`` / ``moms`` are indexables keyed by ``keys`` (dicts keyed
+    by name, or lists keyed by position — both train-step tiers use
+    this one helper, so their numerics can never diverge).  Returns
+    ``({key: new_param}, {key: new_mom})``."""
+    groups = {}
+    for k in keys:
+        groups.setdefault(str(params[k].dtype), []).append(k)
+    new_p, new_m = {}, {}
+    for ks in groups.values():
+        refs = [params[k] for k in ks]
+        w, m = fused_sgd_mom_flat(
+            pack_flat(refs),
+            pack_flat([grads[k] for k in ks]),
+            pack_flat([moms[k] for k in ks]),
+            lr, momentum, wd)
+        for k, wv, mv in zip(ks, unpack_flat(w, refs),
+                             unpack_flat(m, refs)):
+            new_p[k], new_m[k] = wv, mv
+    return new_p, new_m
 
 
 class Updater:

@@ -38,10 +38,13 @@ program.
 from __future__ import annotations
 
 from .. import autograd
+from .. import env as _env
 from ..ndarray import NDArray
 from .mesh import make_mesh
 
-__all__ = ["DataParallelRunner", "FusedTrainStep", "shard_batch", "replicate"]
+__all__ = ["DataParallelRunner", "FusedTrainStep", "shard_batch",
+           "replicate", "zero1_stage", "zero1_momentum_buffers",
+           "zero1_bucketed_update", "momenta_bytes_per_device"]
 
 
 def _jax():
@@ -111,6 +114,141 @@ def replicate(arr, mesh):
     return jax.device_put(arr, rep)
 
 
+# ---------------------------------------------------------------------------
+# ZeRO-1: optimizer-state sharding over the dp axis.  Replicating
+# momenta on every rank (the default, and the reference's kvstore
+# server-side-update layout mirrored onto every worker) wastes
+# (dp-1)/dp of the optimizer-state HBM; ZeRO stage 1 gives each dp
+# rank ownership of a 1/dp shard of every gradient bucket's momenta:
+# the bucket's gradient arrives by REDUCE-SCATTER (each rank receives
+# only its shard of the sum — half the wire bytes of an all-reduce),
+# the momentum + parameter update runs on the shard (the fused
+# multi-tensor op from optimizer.py), and the updated parameter shard
+# is ALL-GATHERED back to the replicated layout.  Composes with the
+# bucketed reverse-layer-order schedule (parallel/buckets.py): bucket
+# k's all-gather has no data dependency on bucket k+1's scatter or
+# update, so XLA overlaps the gather with the next bucket's work.
+# ---------------------------------------------------------------------------
+def zero1_stage(override=None) -> int:
+    """The selected ZeRO stage: explicit argument wins, else
+    ``MXNET_ZERO_STAGE`` (0 = replicated, 1 = sharded momenta)."""
+    stage = override if override is not None \
+        else _env.get_int("MXNET_ZERO_STAGE")
+    if stage not in (0, 1):
+        raise ValueError("MXNET_ZERO_STAGE=%r: only stages 0 "
+                         "(replicated) and 1 (sharded optimizer "
+                         "state) exist" % (stage,))
+    return int(stage)
+
+
+def _dtype_itemsize(dtype) -> int:
+    import numpy as np
+
+    try:
+        return np.dtype(dtype).itemsize
+    except TypeError:
+        return {"bfloat16": 2, "float16": 2}.get(str(dtype), 4)
+
+
+def momenta_bytes_per_device(moms) -> int:
+    """Max per-device resident bytes across a momenta pytree, measured
+    from the LIVE buffers' addressable shards (replicated arrays count
+    full-size per device; zero1 flats count their 1/n shard) — the
+    shared evidence both train-step tiers report."""
+    import jax
+
+    per_device = {}
+    for m in jax.tree_util.tree_leaves(moms):
+        try:
+            for s in m.addressable_shards:
+                key = repr(s.device)
+                per_device[key] = per_device.get(key, 0) + \
+                    int(s.data.nbytes)
+        except Exception:
+            per_device[""] = per_device.get("", 0) + int(m.nbytes)
+    return max(per_device.values()) if per_device else 0
+
+
+def zero1_momentum_buffers(plan, n: int):
+    """GLOBAL flat zero momenta, one buffer per bucket, padded to a
+    multiple of ``n`` — place them with ``P(dp_axis)`` so each device
+    owns exactly its 1/n shard (the only copy anywhere)."""
+    import jax.numpy as jnp
+
+    bufs = []
+    for b in plan:
+        elems = int(b.nbytes) // _dtype_itemsize(b.dtype)
+        padded = elems + ((-elems) % max(int(n), 1))
+        bufs.append(jnp.zeros((padded,), dtype=b.dtype))
+    return bufs
+
+
+def zero1_bucketed_update(grads, params, mom_shards, plan,
+                          axis_name: str, n: int, *, lr, momentum, wd,
+                          mean_n=None, sp_axis=None, chain=None):
+    """One ZeRO-1 step over the bucket plan, inside shard_map.
+
+    ``grads``/``params``: ``{key: local array}`` (grads are this
+    device's UNreduced gradients; params are replicated views);
+    ``mom_shards``: this device's per-bucket momentum shards (the
+    device view of :func:`zero1_momentum_buffers`).  Per bucket, in
+    reverse-layer issue order: flat-concat → (optional ``sp_axis``
+    psum — sequence-parallel replicas contribute partial grads) →
+    ``psum_scatter`` over ``axis_name`` → fused shard update →
+    ``all_gather``.  Scatters are chained (optimization_barrier) like
+    the replicated reduction schedule; gathers ride the dataflow, so
+    bucket k's gather overlaps bucket k+1's scatter+update.  Returns
+    ``({key: updated param}, [new momentum shards])``.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .. import optimizer as _opt
+    from . import buckets as _buckets
+
+    if chain is None:
+        chain = _buckets.chain_enabled()
+    mean_n = n if mean_n is None else int(mean_n)
+    idx = lax.axis_index(axis_name)
+    out = {}
+    new_moms = []
+    anchor = None
+    for bi, bucket in enumerate(plan):
+        leaves = [grads[k] for k in bucket.keys]
+        flat_g = _opt.pack_flat(leaves)
+        size = flat_g.shape[0]
+        pad = (-size) % n
+        if pad:
+            flat_g = jnp.pad(flat_g, (0, pad))
+        if sp_axis is not None:
+            flat_g = lax.psum(flat_g, sp_axis)
+        if chain and anchor is not None:
+            # scatters issue in reverse layer order, NCCL-stream style
+            flat_g, _ = lax.optimization_barrier((flat_g, anchor))
+        gsh = lax.psum_scatter(flat_g, axis_name,
+                               scatter_dimension=0, tiled=True)
+        anchor = lax.slice(gsh, (0,), (1,))
+        if mean_n > 1:
+            gsh = gsh * jnp.asarray(1.0 / mean_n, gsh.dtype)
+        flat_w = _opt.pack_flat([params[k] for k in bucket.keys])
+        if pad:
+            flat_w = jnp.pad(flat_w, (0, pad))
+        shard = flat_w.shape[0] // n
+        wsh = lax.dynamic_slice(flat_w, (idx * shard,), (shard,))
+        w_new, m_new = _opt.fused_sgd_mom_flat(
+            wsh, gsh, mom_shards[bi], lr, momentum, wd)
+        new_moms.append(m_new)
+        full = lax.all_gather(w_new, axis_name, tiled=True)
+        if pad:
+            full = full[:size]
+        off = 0
+        for k, g in zip(bucket.keys, leaves):
+            sz = g.size
+            out[k] = lax.slice(full, (off,), (off + sz,)).reshape(g.shape)
+            off += sz
+    return out, new_moms
+
+
 class DataParallelRunner:
     """Shards an Executor's data/label cells over the dp axis and
     replicates everything else (ref: executor_group.py decide_slices —
@@ -176,7 +314,8 @@ class FusedTrainStep:
 
     def __init__(self, block, loss_fn, mesh=None, learning_rate=0.05,
                  momentum=0.9, weight_decay=0.0, param_spec_fn=None,
-                 dtype=None, bucket_bytes=None):
+                 dtype=None, bucket_bytes=None, fused_update=True,
+                 zero_stage=None):
         jax = _jax()
         self.mesh = mesh if mesh is not None else make_mesh((1,), ("dp",),
                                                             jax.devices()[:1])
@@ -191,6 +330,13 @@ class FusedTrainStep:
         # None = MXNET_KVSTORE_BUCKET_BYTES (default 4 MiB), 0 = force
         # the monolithic SPMD reduction
         self._bucket_bytes = bucket_bytes
+        # one multi-tensor optimizer op over all params (optimizer.py
+        # fused_sgd_mom_flat) — False restores the per-key update loop
+        # (the numerics-pinning control; math is bitwise-identical)
+        self._fused_update = bool(fused_update)
+        # ZeRO stage: None = MXNET_ZERO_STAGE; 1 shards momenta over dp
+        self._zero_stage = zero_stage
+        self._zero1 = False
         self._bucketed = False
         self._bucket_plan = None
         self._built = False
@@ -289,6 +435,19 @@ class FusedTrainStep:
             if self._bucket_tuning is not None:
                 cap = self._bucket_tuning["cap_bytes"]
         plan = self._bucket_plan
+        # ZeRO-1: shard the momenta over dp (zero1_bucketed_update
+        # below).  Needs the bucketed shard_map path — its reduce-
+        # scatter/all-gather ride the bucket schedule; a monolithic or
+        # single-device build keeps the replicated layout.
+        stage = zero1_stage(self._zero_stage)
+        self._zero1 = bool(stage == 1 and self._bucketed)
+        if stage == 1 and not self._bucketed:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "MXNET_ZERO_STAGE=1 requested but this step is not on "
+                "the bucketed multi-device dp path — momenta stay "
+                "replicated")
         # flight-recorder header: which reduction schedule this process
         # is issuing (diagnostics.py; --health cross-checks it per rank)
         from .. import diagnostics as _diag
@@ -301,6 +460,8 @@ class FusedTrainStep:
         hier_local_n = _buckets.host_local_count(self.mesh) \
             if self._bucketed and _buckets.impl_name() == "hierarchical" \
             else None
+        zero1 = self._zero1
+        fused = self._fused_update
         if self._bucketed:
             _diag.set_bucket_plan(plan_meta_v, owner=id(self))
         else:
@@ -348,6 +509,19 @@ class FusedTrainStep:
                 maybe_checkpoint(pure_loss), has_aux=True)(diff)
 
             if sharded:
+                loss_val = _lx.pmean(loss_val, "dp")
+            if sharded and zero1:
+                # ZeRO-1: raw per-device grads go straight into the
+                # reduce-scatter → shard-update → all-gather schedule;
+                # mom_vals is the per-bucket momentum-shard list
+                upd, new_moms = zero1_bucketed_update(
+                    grads, diff, mom_vals, plan, "dp", n_dp,
+                    lr=lr, momentum=mom_c, wd=wd)
+                aux_iter = iter(new_aux)
+                new_params = [next(aux_iter) if i in aux_idx else upd[i]
+                              for i in range(n_params)]
+                return new_params, new_moms, loss_val, logits
+            if sharded:
                 # pmean of the per-device grads of the per-device mean
                 # loss = the global-batch gradient; issued per bucket in
                 # reverse layer order so later-layer reductions overlap
@@ -359,11 +533,28 @@ class FusedTrainStep:
                 grads = _buckets.bucketed_reduce(grads, plan, "dp",
                                                  n=n_dp, mean=True,
                                                  local_n=hier_local_n)
-                loss_val = _lx.pmean(loss_val, "dp")
+
+            aux_iter = iter(new_aux)
+            if fused:
+                # ONE multi-tensor update per dtype group over every
+                # trainable param (optimizer.py; elementwise-identical
+                # to the per-key loop, pinned bitwise in tests) instead
+                # of n_params separate update ops
+                from .. import optimizer as _opt
+
+                diff_keys = [i for i in range(n_params)
+                             if i not in aux_idx]
+                new_p, new_m = _opt.fused_sgd_mom_grouped(
+                    diff_keys, param_vals, grads, mom_vals,
+                    lr, mom_c, wd)
+                new_params = [next(aux_iter) if i in aux_idx
+                              else new_p[i] for i in range(n_params)]
+                new_moms = [mom_vals[i] if i in aux_idx else new_m[i]
+                            for i in range(n_params)]
+                return new_params, new_moms, loss_val, logits
 
             new_params = []
             new_moms = []
-            aux_iter = iter(new_aux)
             for i in range(n_params):
                 if i in aux_idx:
                     new_params.append(next(aux_iter))
@@ -389,16 +580,27 @@ class FusedTrainStep:
                     return step_body(param_vals, mom_vals, data, label,
                                      key_root, ctr, sharded=True)
 
+            # zero1: the momenta list is per-bucket flats SHARDED over
+            # dp (each device's view is its own 1/n shard); replicated
+            # otherwise
+            mom_spec = [P("dp")] * len(plan) if zero1 else P()
             step = shard_map(
                 local_step, mesh=self.mesh,
-                in_specs=(P(), P(), P("dp"), P("dp"), P(), P()),
-                out_specs=(P(), P(), P(), P("dp")),
+                in_specs=(P(), mom_spec, P("dp"), P("dp"), P(), P()),
+                out_specs=(P(), mom_spec, P(), P("dp")),
                 check_rep=False)
         else:
             def step(param_vals, mom_vals, data, label, key_root, ctr):
                 return step_body(param_vals, mom_vals, data, label,
                                  key_root, ctr, sharded=False)
 
+        # momenta shardings: per-bucket flats sharded over dp under
+        # zero1 (the 1/n shard is the only copy), else the param
+        # shardings (replicated / tensor-parallel)
+        from jax.sharding import PartitionSpec as _PS
+
+        self._mom_sh = [NamedSharding(self.mesh, _PS("dp"))
+                        for _ in plan] if self._zero1 else self._param_sh
         donate = (0, 1)  # params + momenta buffers are donated: in-place update
         # the K-step variants additionally donate the batch buffers
         # (argnums 2, 3): run_steps re-places them per dispatch through
@@ -421,9 +623,9 @@ class FusedTrainStep:
             "FusedTrainStep.step",
             jax.jit(
                 step,
-                in_shardings=(self._param_sh, self._param_sh, data_sh,
+                in_shardings=(self._param_sh, self._mom_sh, data_sh,
                               data_sh, rep, rep),
-                out_shardings=(self._param_sh, self._param_sh, rep,
+                out_shardings=(self._param_sh, self._mom_sh, rep,
                                data_sh),
                 donate_argnums=donate,
             ), meta=step_meta)
@@ -454,9 +656,9 @@ class FusedTrainStep:
             "FusedTrainStep.multi_step",
             jax.jit(
                 multi_step,
-                in_shardings=(self._param_sh, self._param_sh, kdata_sh,
+                in_shardings=(self._param_sh, self._mom_sh, kdata_sh,
                               kdata_sh, rep, rep),
-                out_shardings=(self._param_sh, self._param_sh, rep),
+                out_shardings=(self._param_sh, self._mom_sh, rep),
                 donate_argnums=donate_k,
             ), meta=step_meta)
 
@@ -481,9 +683,9 @@ class FusedTrainStep:
                 "FusedTrainStep.multi_step_same[k=%d]" % k,
                 jax.jit(
                     fn,
-                    in_shardings=(self._param_sh, self._param_sh, data_sh,
+                    in_shardings=(self._param_sh, self._mom_sh, data_sh,
                                   data_sh, rep, rep),
-                    out_shardings=(self._param_sh, self._param_sh, rep),
+                    out_shardings=(self._param_sh, self._mom_sh, rep),
                     donate_argnums=donate_k,
                 ), meta=step_meta)
 
@@ -494,7 +696,14 @@ class FusedTrainStep:
 
         from .. import random as _random
 
-        self._moms = [jnp.zeros_like(p.data()._data) for p in self._cells]
+        if self._zero1:
+            # ZeRO-1 momenta: one flat padded buffer per bucket,
+            # sharded over dp at placement (the 1/dp per-rank shard
+            # is the whole point — see optimizer_state_bytes_per_rank)
+            self._moms = zero1_momentum_buffers(plan, n_dp)
+        else:
+            self._moms = [jnp.zeros_like(p.data()._data)
+                          for p in self._cells]
         try:
             self._key_root = jax.device_put(_random._next_key(), rep)
         except Exception:
@@ -510,6 +719,22 @@ class FusedTrainStep:
     def bucketed(self) -> bool:
         """True once built on the bucketed shard_map path."""
         return self._built and self._bucketed
+
+    @property
+    def zero1(self) -> bool:
+        """True once built with ZeRO-1 sharded optimizer state."""
+        return self._built and self._zero1
+
+    def optimizer_state_bytes_per_rank(self):
+        """Momenta bytes RESIDENT on one device, measured from the
+        live buffers' addressable shards (not computed from the plan)
+        — the bench memory block's evidence that ZeRO-1 really holds
+        ~1/dp of the replicated optimizer state per rank."""
+        if not self._built:
+            return None
+        if not self._placed:
+            self._place_params()
+        return momenta_bytes_per_device(self._moms)
 
     def bucket_accounting(self):
         """Per-bucket collective accounting rows ({bucket, n_grads,
@@ -542,7 +767,7 @@ class FusedTrainStep:
         for p, sh in zip(self._cells, self._param_sh):
             p.data()._data = jax.device_put(p.data()._data, sh)
         self._moms = [jax.device_put(m, sh)
-                      for m, sh in zip(self._moms, self._param_sh)]
+                      for m, sh in zip(self._moms, self._mom_sh)]
         self._param_vals = [p.data()._data for p in self._cells]
         self._param_vt = [p.data()._vt for p in self._cells]
         self._placed = True
@@ -656,8 +881,8 @@ class FusedTrainStep:
 
         p_specs = [spec(p.data()._data.shape, p.data()._data.dtype, sh)
                    for p, sh in zip(self._cells, self._param_sh)]
-        m_specs = [spec(p.data()._data.shape, p.data()._data.dtype, sh)
-                   for p, sh in zip(self._cells, self._param_sh)]
+        m_specs = [spec(m.shape, m.dtype, sh)
+                   for m, sh in zip(self._moms, self._mom_sh)]
         d_spec = spec(raw_data.shape, dtype, self._data_sh)
         l_spec = spec(raw_label.shape, raw_label.dtype, self._data_sh)
         from .. import random as _random

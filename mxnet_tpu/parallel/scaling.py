@@ -297,12 +297,49 @@ def mp_placement_sweep(timeout: int = 1200) -> Dict:
     return out
 
 
+def grad_entries(params, dtype: Optional[str] = None) -> List[tuple]:
+    """MODEL-AGNOSTIC gradient-exchange leaves: ``(name, shape, dtype)``
+    for every trainable entry of ``params`` in ITERATION (= layer)
+    order — exactly what ``buckets.partition`` / the autotuner's
+    leaf-granularity timing model consume.
+
+    ``params`` is any ``{name: leaf}`` mapping whose leaves carry
+    ``.shape`` — gluon ``collect_params()``, a transformer param dict
+    (``mxnet_tpu.transformer.init_params``), plain jax/numpy arrays —
+    or an already-built ``(name, shape, dtype)`` entry list (passed
+    through, re-dtyped).  Entries with ``grad_req == 'null'`` are
+    skipped (frozen params don't ride the exchange); ``dtype``
+    overrides each leaf's own dtype (the bf16-wire projection over
+    fp32-held params)."""
+    out: List[tuple] = []
+    items = params.items() if hasattr(params, "items") else None
+    if items is None:
+        # (name, shape, dtype) triples — e.g. transformer.param_shapes
+        for name, shape, dt in params:
+            out.append((name, tuple(shape), dtype or str(dt)))
+        return out
+    for name, p in items:
+        if getattr(p, "grad_req", None) == "null":
+            continue
+        dt = dtype if dtype is not None else \
+            str(getattr(p, "dtype", "float32"))
+        out.append((name, tuple(p.shape), dt))
+    return out
+
+
+def grad_leaf_bytes(entries: Sequence[tuple]) -> List[int]:
+    """Per-gradient payload bytes for ``grad_entries`` output, in the
+    same order — the autotuner's exact-granularity input
+    (``autotune.from_leaf_bytes``)."""
+    from . import buckets as _buckets
+
+    return [_buckets._nbytes(shape, dt) for _name, shape, dt in entries]
+
+
 def resnet50_grad_entries(dtype: str = "float32") -> List[tuple]:
-    """The data-parallel resnet50 gradient exchange's raw leaves:
-    ``(name, shape, dtype)`` for every trainable param in LAYER order —
-    exactly what buckets.partition / the autotuner's leaf-granularity
-    timing model consume.  One eager forward settles deferred shapes;
-    no train compile."""
+    """The data-parallel resnet50 gradient exchange's raw leaves (the
+    zoo workload instance of :func:`grad_entries`).  One eager forward
+    settles deferred shapes; no train compile."""
     import numpy as np
 
     import mxnet_tpu as mx
@@ -314,18 +351,13 @@ def resnet50_grad_entries(dtype: str = "float32") -> List[tuple]:
     net.initialize(mx.init.Xavier())
     with autograd.pause():
         net(nd.random.uniform(shape=(1, 3, 224, 224)))
-    return [(name, tuple(p.shape), dtype)
-            for name, p in net.collect_params().items()
-            if p.grad_req != "null"]
+    return grad_entries(net.collect_params(), dtype=dtype)
 
 
 def resnet50_grad_leaf_bytes(dtype: str = "float32") -> List[int]:
-    """Per-gradient leaf payload bytes in LAYER order — the autotuner's
-    exact-granularity input (autotune.from_leaf_bytes)."""
-    from . import buckets as _buckets
-
-    return [_buckets._nbytes(shape, dt)
-            for _name, shape, dt in resnet50_grad_entries(dtype)]
+    """Per-gradient leaf payload bytes in LAYER order (resnet50
+    instance of :func:`grad_leaf_bytes`)."""
+    return grad_leaf_bytes(resnet50_grad_entries(dtype))
 
 
 def resnet50_bucket_bytes(dtype: str = "float32",

@@ -28,7 +28,8 @@ consults it during graph init.
 """
 from . import env as _env
 
-__all__ = ["mirror_enabled", "mirror_policy", "maybe_checkpoint"]
+__all__ = ["mirror_enabled", "mirror_policy", "maybe_checkpoint",
+           "REMAT_POLICIES", "remat_policy", "checkpoint_scope"]
 
 # ops whose OUTPUTS are kept as backward residuals under the mirror
 # policy: the MXU heavyweights.  Everything else (BN math, relu, adds,
@@ -67,3 +68,41 @@ def maybe_checkpoint(fn):
     import jax
 
     return jax.checkpoint(fn, policy=mirror_policy())
+
+
+# ---------------------------------------------------------------------------
+# Per-block remat policies (the transformer workload tier).  The mirror
+# knob above is a whole-program save-policy; deep homogeneous stacks
+# want SCOPED remat instead: rematerialize each block (keep only
+# block-boundary residuals — activation memory O(L + T) instead of
+# O(L·T)) or just the attention sub-graph (recompute the O(T) score
+# path, keep the cheap MLP residuals).
+# ---------------------------------------------------------------------------
+REMAT_POLICIES = ("none", "block", "attention")
+
+
+def remat_policy(override=None) -> str:
+    """The selected per-block remat policy: explicit argument wins,
+    else ``MXNET_REMAT_POLICY`` (default ``none``).  Unknown names
+    raise — a typo'd policy silently running without remat would OOM
+    exactly the long-context configs the policy exists for."""
+    pol = override if override is not None \
+        else _env.get_str("MXNET_REMAT_POLICY")
+    if pol not in REMAT_POLICIES:
+        raise ValueError(
+            "unknown remat policy %r (MXNET_REMAT_POLICY); pick one "
+            "of %s" % (pol, "/".join(REMAT_POLICIES)))
+    return pol
+
+
+def checkpoint_scope(fn, policy: str, scope: str):
+    """Wrap ``fn`` in ``jax.checkpoint`` when the selected ``policy``
+    names this ``scope`` (``'block'`` / ``'attention'``); identity
+    otherwise.  Remat recomputes the same math; XLA may fuse the
+    recompute differently, so trajectories match the no-remat program
+    to fp round-off (tested ~1e-7), not bitwise."""
+    if policy != scope:
+        return fn
+    import jax
+
+    return jax.checkpoint(fn)

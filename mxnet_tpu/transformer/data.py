@@ -1,0 +1,69 @@
+"""Synthetic tokenized LM stream on the ``io.py`` iterator contract.
+
+The transformer tier needs a deterministic token workload that rides
+the SAME DataIter surface every other workload uses, so the whole
+input/robustness stack applies unchanged: ``next_raw`` (host-only, no
+jax) makes it decode-pool shardable (io_pipeline.py workers),
+``num_parts``/``part_index`` give disjoint per-rank/per-worker slices,
+and the cursor-based position is exactly what the elastic checkpoint's
+iterator state replays for bitwise resume.
+
+The corpus is a seeded offset-chain: token ``t+1 = (t + delta) % V``
+with ``delta`` drawn from a small fixed set — a learnable bigram
+structure (loss drops below ``log(V)`` within a few steps), unlike
+uniform noise, while staying a one-line vectorized generation that
+never touches disk.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..io import NDArrayIter
+
+__all__ = ["LMTokenIter", "make_corpus"]
+
+_DELTAS = _np.array([1, 2, 3, 5, 7], dtype=_np.int64)
+
+
+def make_corpus(num_sequences: int, seq_len: int, vocab_size: int,
+                seed: int = 0) -> _np.ndarray:
+    """``(num_sequences, seq_len + 1)`` int32 token matrix (the +1
+    column provides the shifted next-token labels)."""
+    rng = _np.random.RandomState(seed)
+    start = rng.randint(0, vocab_size, size=(num_sequences, 1))
+    deltas = _DELTAS[rng.randint(0, len(_DELTAS),
+                                 size=(num_sequences, seq_len))]
+    toks = _np.concatenate(
+        [start, start + _np.cumsum(deltas, axis=1)], axis=1)
+    return (toks % vocab_size).astype(_np.int32)
+
+
+class LMTokenIter(NDArrayIter):
+    """Decoder-LM batches: ``data`` (B, T) int32 tokens, ``label``
+    (B, T) int32 next tokens.  Everything else — padding, sharding,
+    ``next_raw``, reset semantics — is inherited from ``NDArrayIter``,
+    which is the point: checkpoint/resume, the decode pool and the
+    flight recorder treat this exactly like any other workload's
+    iterator."""
+
+    def __init__(self, batch_size: int = 8, seq_len: int = 64,
+                 vocab_size: int = 256, num_sequences: int = 64,
+                 seed: int = 0, shuffle: bool = False,
+                 last_batch_handle: str = "discard",
+                 num_parts: int = 1, part_index: int = 0):
+        corpus = make_corpus(num_sequences, seq_len, vocab_size, seed)
+        self.seq_len = int(seq_len)
+        self.vocab_size = int(vocab_size)
+        super().__init__(
+            corpus[:, :-1], label=corpus[:, 1:], batch_size=batch_size,
+            shuffle=shuffle, last_batch_handle=last_batch_handle,
+            data_name="tokens", label_name="next_tokens",
+            num_parts=num_parts, part_index=part_index)
+
+    def skip_batches(self, n: int) -> None:
+        """Fast-forward ``n`` batches (cursor moves, nothing
+        materializes) — the exact-resume replay path."""
+        for _ in range(int(n)):
+            if not self.iter_next():
+                self.reset()
+                self.iter_next()

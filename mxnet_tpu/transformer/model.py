@@ -1,0 +1,268 @@
+"""Decoder-only transformer LM — the long-context workload tier.
+
+The reference (2017 MXNet) tops out at bucketed LSTMs for sequence
+work (SURVEY.md §5 "Long-context"); this is the TPU-first superset the
+rebuild is required to supply: a modern decoder-only LM (RMSNorm, RoPE,
+tied embedding head) whose attention is PLUGGABLE between the
+single-chip fused kernel and the two sequence-parallel formulations
+that already exist in ``parallel/`` but had no end-to-end workload:
+
+  * ``flash``   — parallel/attention.py blockwise online-softmax scan
+                  (single chip / no sp axis);
+  * ``ring``    — parallel/ring_attention.py KV-rotation over the mesh's
+                  ``sp`` axis (contexts that don't fit one chip);
+  * ``ulysses`` — parallel/sequence.py all-to-all head resharding
+                  (small sp relative to head count).
+
+Selection rides ``MXNET_ATTENTION_IMPL`` (env.py) or an explicit
+argument; the model body is identical either way — ring/ulysses run as
+per-shard bodies inside the train step's shard_map, so positions are
+derived from ``lax.axis_index("sp")`` (the ``pos_offset`` argument).
+
+The model is a PURE param-tree function (flat ``{name: array}`` dict in
+forward/layer order — exactly what ``buckets.partition`` and the ZeRO-1
+sharded update consume), not a gluon Block or a Module symbol: the
+forcing-function verdict on which layer carries imperative workloads is
+recorded in SURVEY.md §round-14.
+
+Rematerialization is per-block and policy-selectable
+(``MXNET_REMAT_POLICY`` = ``none`` | ``block`` | ``attention``,
+remat.py): ``block`` keeps only block-boundary residuals (the classic
+trade for deep stacks), ``attention`` rematerializes just the attention
+sub-graph (the O(T) score recompute) and keeps the cheap MLP residuals.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from .. import env as _env
+from ..remat import checkpoint_scope, remat_policy
+
+__all__ = [
+    "TransformerConfig", "ATTENTION_IMPLS", "attention_impl",
+    "make_attn_fn", "param_shapes", "init_params", "apply", "lm_loss",
+]
+
+ATTENTION_IMPLS = ("flash", "ring", "ulysses")
+
+
+class TransformerConfig(NamedTuple):
+    """Decoder-only LM dimensions + dtypes.  ``d_ff`` ``None`` means
+    the conventional ``4*d_model``."""
+    vocab_size: int = 256
+    n_layers: int = 2
+    d_model: int = 64
+    n_heads: int = 4
+    d_ff: Optional[int] = None
+    rope_base: float = 10000.0
+    dtype: str = "float32"        # compute (activation) dtype
+    param_dtype: str = "float32"  # parameter storage dtype
+    eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff if self.d_ff is not None else 4 * self.d_model
+
+
+def attention_impl(override: Optional[str] = None) -> str:
+    """The selected attention implementation: explicit argument wins,
+    else ``MXNET_ATTENTION_IMPL`` (default ``flash``).  Unknown names
+    raise — a typo'd impl silently falling back would bench the wrong
+    kernel."""
+    impl = override if override is not None \
+        else _env.get_str("MXNET_ATTENTION_IMPL")
+    if impl not in ATTENTION_IMPLS:
+        raise ValueError(
+            "unknown attention impl %r (MXNET_ATTENTION_IMPL); pick "
+            "one of %s" % (impl, "/".join(ATTENTION_IMPLS)))
+    return impl
+
+
+def make_attn_fn(impl: str, sp_axis: Optional[str] = None,
+                 causal: bool = True):
+    """Bind an attention impl to a callable ``fn(q, k, v) -> out`` over
+    (B, T_local, H, Dh) activations.
+
+    With ``sp_axis`` the returned fn is a PER-SHARD body (must run
+    inside shard_map over that axis); ``flash`` is rejected there
+    because local-only attention over a sequence shard is silently
+    WRONG math, not a slower variant.  Without an sp axis the
+    sequence-parallel impls are rejected for the symmetric reason
+    (their collectives need the axis)."""
+    impl = attention_impl(impl)
+    if sp_axis is None:
+        if impl != "flash":
+            raise ValueError(
+                "attention impl %r needs a sequence-parallel mesh axis; "
+                "build the step over a mesh with 'sp' (or select "
+                "MXNET_ATTENTION_IMPL=flash)" % impl)
+        from ..parallel.attention import flash_attention
+
+        return functools.partial(flash_attention, causal=causal)
+    if impl == "ring":
+        from ..parallel.ring_attention import ring_attention
+
+        return functools.partial(ring_attention, axis_name=sp_axis,
+                                 causal=causal)
+    if impl == "ulysses":
+        from ..parallel.sequence import ulysses_attention
+
+        return functools.partial(ulysses_attention, axis_name=sp_axis,
+                                 causal=causal)
+    raise ValueError(
+        "attention impl %r cannot run sequence-sharded (sp axis %r); "
+        "pick ring or ulysses" % (impl, sp_axis))
+
+
+# ---------------------------------------------------------------------------
+# parameters: flat dict, FORWARD (layer) order — the bucket partitioner's
+# and the ZeRO-1 shard layout's input contract
+# ---------------------------------------------------------------------------
+def param_shapes(cfg: TransformerConfig) -> List[Tuple[str, tuple, str]]:
+    """``(name, shape, dtype)`` for every trainable param in layer
+    order — shapes only, no arrays: what ``scaling.grad_entries`` /
+    the autotuner's leaf-granularity timing model consume to tune the
+    attention-dominated comm pattern without a compile."""
+    D, F, V = cfg.d_model, cfg.ff_dim, cfg.vocab_size
+    dt = cfg.param_dtype
+    out = [("embed", (V, D), dt)]
+    for i in range(cfg.n_layers):
+        p = "blk%d." % i
+        out += [
+            (p + "attn_norm", (D,), dt),
+            (p + "wqkv", (D, 3 * D), dt),
+            (p + "wo", (D, D), dt),
+            (p + "mlp_norm", (D,), dt),
+            (p + "w1", (D, F), dt),
+            (p + "w2", (F, D), dt),
+        ]
+    out.append(("final_norm", (D,), dt))
+    return out
+
+
+def init_params(key, cfg: TransformerConfig) -> Dict:
+    """Initialize the flat param dict: N(0, 0.02) matrices (wo/w2
+    scaled down by sqrt(2L) — the GPT-2 residual-stream convention),
+    unit norms.  Deterministic per (key, cfg)."""
+    import jax
+    import jax.numpy as jnp
+
+    if cfg.d_model % cfg.n_heads:
+        raise ValueError("d_model %d must divide by n_heads %d"
+                         % (cfg.d_model, cfg.n_heads))
+    resid_scale = (2.0 * max(cfg.n_layers, 1)) ** -0.5
+    params: Dict = {}
+    for idx, (name, shape, dtype) in enumerate(param_shapes(cfg)):
+        sub = jax.random.fold_in(key, idx)
+        if name.endswith("norm"):
+            params[name] = jnp.ones(shape, dtype)
+            continue
+        scale = 0.02
+        if name.endswith(("wo", "w2")):
+            scale *= resid_scale
+        params[name] = (scale * jax.random.normal(
+            sub, shape, jnp.float32)).astype(dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _rmsnorm(x, gain, eps):
+    import jax.numpy as jnp
+
+    # f32 statistics (or wider, for the fp64 control methodology)
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+    scale = jnp.reciprocal(jnp.sqrt(
+        jnp.mean(xf * xf, axis=-1, keepdims=True) + eps))
+    return (xf * scale).astype(x.dtype) * gain.astype(x.dtype)
+
+
+def _rope(x, positions, base):
+    """Rotary position embedding over (B, T, H, Dh) with GLOBAL
+    ``positions`` (T,) — under sequence sharding each shard passes its
+    own global offsets, so rotation angles are placement-invariant."""
+    import jax.numpy as jnp
+
+    Dh = x.shape[-1]
+    half = Dh // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(ang)[None, :, None, :]  # (1, T, 1, half)
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _gelu(x):
+    import jax
+
+    return jax.nn.gelu(x, approximate=True)
+
+
+def apply(params: Dict, tokens, cfg: TransformerConfig, *,
+          attn_fn, pos_offset=0, remat: Optional[str] = None):
+    """Forward pass: ``tokens`` (B, T_local) int -> logits
+    (B, T_local, vocab) float32 (tied embedding head).
+
+    ``pos_offset`` is this shard's global position of token 0 (a traced
+    scalar under shard_map: ``axis_index("sp") * T_local``); ``remat``
+    overrides ``MXNET_REMAT_POLICY``."""
+    import jax.numpy as jnp
+
+    policy = remat_policy(remat)
+    compute = jnp.dtype(cfg.dtype)
+    B, t = tokens.shape
+    positions = pos_offset + jnp.arange(t)
+    embed = params["embed"]
+    h = embed.astype(compute)[tokens]
+
+    def attn_part(h, g, wqkv, wo):
+        a = _rmsnorm(h, g, cfg.eps)
+        qkv = a @ wqkv.astype(a.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, t, cfg.n_heads, cfg.head_dim)
+        q = _rope(q.reshape(shape), positions, cfg.rope_base)
+        k = _rope(k.reshape(shape), positions, cfg.rope_base)
+        o = attn_fn(q, k, v.reshape(shape))
+        return o.reshape(B, t, cfg.d_model) @ wo.astype(o.dtype)
+
+    def block(h, g_attn, wqkv, wo, g_mlp, w1, w2):
+        h = h + checkpoint_scope(attn_part, policy, "attention")(
+            h, g_attn, wqkv, wo)
+        m = _rmsnorm(h, g_mlp, cfg.eps)
+        m = jnp.dot(_gelu(m @ w1.astype(m.dtype)), w2.astype(m.dtype))
+        return h + m
+
+    block = checkpoint_scope(block, policy, "block")
+    for i in range(cfg.n_layers):
+        p = "blk%d." % i
+        h = block(h, params[p + "attn_norm"], params[p + "wqkv"],
+                  params[p + "wo"], params[p + "mlp_norm"],
+                  params[p + "w1"], params[p + "w2"])
+    h = _rmsnorm(h, params["final_norm"], cfg.eps)
+    # tied head; logits accumulate in f32 (f64 under the control
+    # methodology) regardless of the bf16 compute dtype
+    acc = jnp.promote_types(compute, jnp.float32)
+    return jnp.einsum("btd,vd->btv", h.astype(acc), embed.astype(acc))
+
+
+def lm_loss(logits, labels):
+    """Mean next-token cross entropy over this shard's tokens: logits
+    (B, T, V) f32, labels (B, T) int.  Every shard holds the same token
+    count, so ``pmean`` of per-shard means over dp×sp IS the global
+    mean."""
+    import jax
+    import jax.numpy as jnp
+
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

@@ -1,0 +1,517 @@
+"""Transformer-LM training: one compiled step + a checkpointed fit loop.
+
+``TransformerTrainStep`` is the functional-tier sibling of
+``parallel/dp.py``'s FusedTrainStep: forward + loss + backward +
+optimizer in ONE XLA program, compiled through shard_map over a mesh
+with a ``dp`` axis and (for long-context runs) an ``sp`` axis the
+attention impl shards the sequence over.  The gradient exchange rides
+the SAME bucket machinery as the conv workloads
+(``buckets.plan_with_tuning`` — so ``mxnet_tpu.autotune`` plans apply
+to the attention-dominated comm pattern too), and the optimizer update
+is either:
+
+  * replicated (ZeRO stage 0): bucketed all-reduce + ONE fused
+    multi-tensor update over all params (optimizer.py), or
+  * ZeRO-1 (``MXNET_ZERO_STAGE=1``): per-bucket reduce-scatter →
+    fused update on this rank's momentum shard → param all-gather
+    (parallel/dp.py ``zero1_bucketed_update``), so each dp rank holds
+    1/dp of the optimizer state.
+
+``fit`` rides the existing robustness stack unchanged: elastic
+checkpoint shards (checkpoint.py manifest — the sharded momenta travel
+in ``optimizer_states``), chaos kill/delay hooks at the same loop
+points Module.fit exposes, flight-recorder stamping per step, and
+step metrics (tokens/s) through diagnostics.
+"""
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Dict, List, Optional
+
+from .. import env as _env
+from ..remat import remat_policy
+from . import model as _model
+from .model import TransformerConfig
+
+__all__ = ["TransformerTrainStep"]
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class TransformerTrainStep:
+    """One compiled train step over a ``TransformerConfig``.
+
+    Parameters
+    ----------
+    cfg : TransformerConfig (``dtype`` is the compute dtype; params are
+        stored in ``param_dtype``).
+    mesh : jax Mesh with a ``dp`` axis and optionally an ``sp`` axis
+        (sequence parallelism).  Default: one device, dp only.
+    attn_impl / remat / zero_stage : explicit overrides for
+        ``MXNET_ATTENTION_IMPL`` / ``MXNET_REMAT_POLICY`` /
+        ``MXNET_ZERO_STAGE`` (None = read the env knob at build).
+    bucket_bytes : pins the gradient bucket cap (bypasses autotune);
+        None resolves MXNET_AUTOTUNE_PLAN/_DIR then the env default.
+    """
+
+    def __init__(self, cfg: TransformerConfig, mesh=None,
+                 learning_rate: float = 0.01, momentum: float = 0.9,
+                 weight_decay: float = 0.0,
+                 attn_impl: Optional[str] = None,
+                 remat: Optional[str] = None,
+                 zero_stage: Optional[int] = None,
+                 bucket_bytes: Optional[int] = None, seed: int = 0):
+        jax = _jax()
+        from ..parallel.mesh import make_mesh
+
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else \
+            make_mesh((1,), ("dp",), jax.devices()[:1])
+        if "dp" not in self.mesh.axis_names:
+            raise ValueError("transformer mesh needs a 'dp' axis "
+                             "(got %s)" % (self.mesh.axis_names,))
+        self._lr = float(learning_rate)
+        self._momentum = float(momentum)
+        self._wd = float(weight_decay)
+        self._attn_impl = attn_impl
+        self._remat = remat
+        self._zero_stage = zero_stage
+        self._bucket_bytes = bucket_bytes
+        self._seed = int(seed)
+        self._built = False
+
+    # -- mesh geometry --------------------------------------------------
+    @property
+    def n_dp(self) -> int:
+        return int(dict(zip(self.mesh.axis_names,
+                            self.mesh.devices.shape))["dp"])
+
+    @property
+    def n_sp(self) -> int:
+        return int(dict(zip(self.mesh.axis_names,
+                            self.mesh.devices.shape)).get("sp", 1))
+
+    # -- build ----------------------------------------------------------
+    def _build(self):
+        jax = _jax()
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .. import diagnostics as _diag
+        from ..compile_cache import enable as _cc_enable
+        from ..parallel import buckets as _buckets
+        from ..parallel.dp import (zero1_bucketed_update,
+                                   zero1_momentum_buffers, zero1_stage)
+
+        _cc_enable()
+        cfg = self.cfg
+        n_dp, n_sp = self.n_dp, self.n_sp
+        n_total = int(self.mesh.devices.size)
+        sp_axis = "sp" if n_sp > 1 else None
+        self._impl = _model.attention_impl(self._attn_impl)
+        self._policy = remat_policy(self._remat)
+        attn_fn = _model.make_attn_fn(self._impl, sp_axis)
+        if sp_axis and self._impl == "ulysses" and cfg.n_heads % n_sp:
+            raise ValueError(
+                "ulysses attention shards heads over sp: n_heads %d "
+                "must divide by sp axis size %d" % (cfg.n_heads, n_sp))
+
+        key = jax.random.PRNGKey(self._seed)
+        params = _model.init_params(key, cfg)
+        rep = NamedSharding(self.mesh, P())
+        data_spec = P("dp", "sp") if sp_axis else P("dp")
+        data_sh = NamedSharding(self.mesh, data_spec)
+        self._rep, self._data_sh = rep, data_sh
+        self._params = {k: jax.device_put(v, rep)
+                        for k, v in params.items()}
+        self._names = list(self._params)
+
+        # gradient bucket plan over the param leaves (layer order) —
+        # the autotuner's resolution precedence applies, so a tuned
+        # plan for THIS exchange's fingerprint supplies the caps
+        entries = [(k, tuple(v.shape), str(v.dtype))
+                   for k, v in self._params.items()]
+        cap = self._bucket_bytes if self._bucket_bytes is not None \
+            else _buckets.bucket_cap_bytes()
+        if cap == 0:
+            # monolithic request: one bucket per dtype run through the
+            # same code path (the step still compiles via shard_map)
+            plan, tuning = _buckets.partition(entries, 1 << 62), None
+        else:
+            plan, tuning = _buckets.plan_with_tuning(
+                entries, self._bucket_bytes)
+        self._bucket_plan, self._bucket_tuning = plan, tuning
+        sharded = n_total > 1
+
+        stage = zero1_stage(self._zero_stage)
+        self._zero1 = bool(stage == 1 and sharded and n_dp > 1)
+        if stage == 1 and not self._zero1:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "MXNET_ZERO_STAGE=1 needs a multi-device dp axis — "
+                "momenta stay replicated")
+
+        plan_meta_v = _buckets.plan_meta(plan, cap if cap else None,
+                                         tuning=tuning)
+        plan_meta_v["workload"] = "transformer_lm"
+        plan_meta_v["zero_stage"] = 1 if self._zero1 else 0
+        if sharded:
+            _diag.set_bucket_plan(plan_meta_v, owner=id(self))
+        self._plan_meta = plan_meta_v
+
+        lr, mom_c, wd = self._lr, self._momentum, self._wd
+        zero1 = self._zero1
+        names = self._names
+        policy = self._policy
+        reduce_axes = ("dp", "sp") if sp_axis else ("dp",)
+
+        from .. import optimizer as _opt
+
+        def step_body(params_d, moms, tokens, labels):
+            t_local = tokens.shape[1]
+            pos_offset = lax.axis_index("sp") * t_local if sp_axis \
+                else 0
+
+            def pure_loss(p):
+                logits = _model.apply(p, tokens, cfg, attn_fn=attn_fn,
+                                      pos_offset=pos_offset,
+                                      remat=policy)
+                return _model.lm_loss(logits, labels)
+
+            loss, grads = jax.value_and_grad(pure_loss)(params_d)
+            if sharded:
+                loss = lax.pmean(loss, reduce_axes)
+            if zero1:
+                new_p, new_m = zero1_bucketed_update(
+                    grads, params_d, moms, plan, "dp", n_dp,
+                    lr=lr, momentum=mom_c, wd=wd, mean_n=n_total,
+                    sp_axis=sp_axis)
+                return new_p, new_m, loss
+            if sharded:
+                # the replicated exchange: bucketed all-reduce over
+                # every model-replica axis (psum accepts the tuple;
+                # ring/hierarchical impls are dp-only, so force psum
+                # when an sp axis is present)
+                grads = _buckets.bucketed_reduce(
+                    grads, plan, reduce_axes if sp_axis else "dp",
+                    n=n_total, mean=True,
+                    impl="psum" if sp_axis else None)
+            # ONE multi-tensor op per dtype group (optimizer.py; the
+            # same helper FusedTrainStep's replicated path runs)
+            new_p, new_m = _opt.fused_sgd_mom_grouped(
+                names, params_d, grads, moms, lr, mom_c, wd)
+            return new_p, new_m, loss
+
+        if sharded:
+            from jax.experimental.shard_map import shard_map
+
+            mom_spec = [P("dp")] * len(plan) if zero1 else P()
+            step = shard_map(
+                step_body, mesh=self.mesh,
+                in_specs=(P(), mom_spec, data_spec, data_spec),
+                out_specs=(P(), mom_spec, P()),
+                check_rep=False)
+        else:
+            step = step_body
+
+        if zero1:
+            self._moms = [jax.device_put(m, NamedSharding(self.mesh,
+                                                          P("dp")))
+                          for m in zero1_momentum_buffers(plan, n_dp)]
+            mom_sh = [NamedSharding(self.mesh, P("dp"))] * len(plan)
+        else:
+            self._moms = {k: jax.device_put(jnp.zeros_like(v), rep)
+                          for k, v in self._params.items()}
+            mom_sh = {k: rep for k in self._params}
+        self._mom_sh = mom_sh
+
+        step_meta = {"compute_dtype": str(jnp.dtype(cfg.dtype)),
+                     "bucket_plan": plan_meta_v}
+        self._step = _diag.instrument_jit(
+            "TransformerTrainStep.step",
+            jax.jit(step,
+                    in_shardings=({k: rep for k in self._params},
+                                  mom_sh, data_sh, data_sh),
+                    out_shardings=({k: rep for k in self._params},
+                                   mom_sh, rep),
+                    donate_argnums=(0, 1)),
+            meta=step_meta)
+
+        # K steps of the SAME batch in one program (lax.scan) — the
+        # bench/burn-in path, per-dispatch latency amortized like the
+        # conv workloads' multi_step_same
+        def multi_step_same(k):
+            def fn(params_d, moms, tokens, labels):
+                def body(carry, _):
+                    p, m = carry
+                    p2, m2, loss = step(p, m, tokens, labels)
+                    return (p2, m2), loss
+
+                (p2, m2), losses = lax.scan(
+                    body, (params_d, moms), None, length=k)
+                return p2, m2, losses
+
+            return _diag.instrument_jit(
+                "TransformerTrainStep.multi_step_same[k=%d]" % k,
+                jax.jit(fn,
+                        in_shardings=({k2: rep for k2 in self._params},
+                                      mom_sh, data_sh, data_sh),
+                        out_shardings=({k2: rep for k2 in self._params},
+                                       mom_sh, rep),
+                        donate_argnums=(0, 1)),
+                meta=step_meta)
+
+        self._multi_same: Dict[int, object] = {}
+        self._multi_same_fn = multi_step_same
+        self._sharded = sharded
+        self._built = True
+
+    # -- introspection --------------------------------------------------
+    @property
+    def zero1(self) -> bool:
+        return self._built and self._zero1
+
+    @property
+    def attention_impl(self) -> str:
+        if not self._built:
+            self._build()
+        return self._impl
+
+    def bucket_plan_meta(self):
+        if not self._built:
+            self._build()
+        return self._plan_meta
+
+    def bucket_tuning(self):
+        if not self._built:
+            self._build()
+        return self._bucket_tuning
+
+    def optimizer_state_bytes_per_rank(self) -> Optional[int]:
+        """Momenta bytes resident on ONE device, measured from the
+        live buffers (the ZeRO-1 acceptance evidence; the same helper
+        FusedTrainStep reports through)."""
+        if not self._built:
+            return None
+        from ..parallel.dp import momenta_bytes_per_device
+
+        return momenta_bytes_per_device(self._moms)
+
+    def params_numpy(self) -> Dict:
+        """Host copies of the (replicated) parameters."""
+        import numpy as np
+
+        if not self._built:
+            self._build()
+        return {k: np.asarray(v) for k, v in self._params.items()}
+
+    # -- stepping -------------------------------------------------------
+    def _put_batch(self, tokens, labels):
+        jax = _jax()
+        import numpy as np
+
+        from ..ndarray import NDArray
+
+        def raw(x):
+            if isinstance(x, NDArray):
+                return x._data
+            return np.asarray(x)
+
+        return (jax.device_put(raw(tokens), self._data_sh),
+                jax.device_put(raw(labels), self._data_sh))
+
+    def _stamp_telemetry(self):
+        if self._sharded:
+            from ..parallel import buckets as _buckets
+
+            _buckets.stamp_profiler(self._bucket_plan,
+                                    store_type="transformer")
+
+    def step(self, tokens, labels):
+        """One optimizer step; returns the (scalar) loss as a jax
+        array — not blocked on, so steps pipeline."""
+        if not self._built:
+            self._build()
+        tokens, labels = self._put_batch(tokens, labels)
+        self._params, self._moms, loss = self._step(
+            self._params, self._moms, tokens, labels)
+        self._stamp_telemetry()
+        return loss
+
+    def run_steps(self, tokens, labels, steps: int):
+        """K same-batch steps as ONE compiled program; returns the
+        per-step losses (K,)."""
+        if not self._built:
+            self._build()
+        tokens, labels = self._put_batch(tokens, labels)
+        k = int(steps)
+        runner = self._multi_same.get(k)
+        if runner is None:
+            runner = self._multi_same_fn(k)
+            self._multi_same[k] = runner
+        self._params, self._moms, losses = runner(
+            self._params, self._moms, tokens, labels)
+        for _ in range(k):
+            self._stamp_telemetry()
+        return losses
+
+    # -- checkpoint state ----------------------------------------------
+    def optimizer_states_bytes(self) -> bytes:
+        """The momenta as a pickled host blob for the checkpoint
+        shard's ``optimizer_states`` slot — sharded (ZeRO-1) momenta
+        ride the SAME elastic manifest as everything else."""
+        import numpy as np
+
+        if not self._built:
+            self._build()
+        if self._zero1:
+            moms = [np.asarray(m) for m in self._moms]
+        else:
+            moms = {k: np.asarray(v) for k, v in self._moms.items()}
+        return pickle.dumps({
+            "workload": "transformer_lm",
+            "zero_stage": 1 if self._zero1 else 0,
+            "n_buckets": len(self._bucket_plan),
+            "momenta": moms,
+        })
+
+    def load_state(self, payload: dict) -> None:
+        """Restore params + momenta from a checkpoint payload
+        (``checkpoint.load_checkpoint``'s dict)."""
+        jax = _jax()
+        import numpy as np
+
+        if not self._built:
+            self._build()
+        params = payload.get("params") or {}
+        missing = [k for k in self._names if k not in params]
+        if missing:
+            raise KeyError("checkpoint payload is missing transformer "
+                           "params: %s" % missing[:4])
+        self._params = {k: jax.device_put(np.asarray(params[k]),
+                                          self._rep)
+                        for k in self._names}
+        blob = payload.get("optimizer_states")
+        if not blob:
+            return
+        state = pickle.loads(blob) if isinstance(blob, bytes) else blob
+        saved_stage = int(state.get("zero_stage", 0))
+        if saved_stage != (1 if self._zero1 else 0):
+            raise ValueError(
+                "checkpoint momenta were written at ZeRO stage %d but "
+                "this step runs stage %d — resume with the same "
+                "MXNET_ZERO_STAGE (elastic restage is not implemented)"
+                % (saved_stage, 1 if self._zero1 else 0))
+        moms = state["momenta"]
+        if self._zero1:
+            if len(moms) != len(self._moms):
+                raise ValueError(
+                    "checkpoint has %d momentum buckets, this plan has "
+                    "%d — bucket caps changed between runs; pin "
+                    "bucket_bytes (or the same autotune plan) to "
+                    "resume" % (len(moms), len(self._moms)))
+            self._moms = [jax.device_put(np.asarray(m), sh)
+                          for m, sh in zip(moms, self._mom_sh)]
+        else:
+            self._moms = {k: jax.device_put(np.asarray(moms[k]),
+                                            self._rep)
+                          for k in self._names}
+
+    # -- fit loop -------------------------------------------------------
+    def fit(self, train_iter, num_steps: int,
+            checkpoint_every_n: Optional[int] = None,
+            checkpoint_dir: Optional[str] = None,
+            resume_from: Optional[str] = None,
+            log_every: int = 0) -> List[float]:
+        """Train ``num_steps`` batches from ``train_iter`` (any io.py
+        DataIter yielding (tokens, next_tokens) int batches; wraps
+        around epoch ends).  Rides the robustness stack: elastic
+        checkpoints every N steps, exact resume (same world + bucket
+        plan -> bitwise), chaos kill/delay at the loop points the
+        harness expects.  Returns the per-step losses (floats)."""
+        from .. import chaos as _chaos
+        from .. import checkpoint as _ckpt
+        from .. import diagnostics as _diag
+
+        if not self._built:
+            self._build()
+        every = checkpoint_every_n if checkpoint_every_n is not None \
+            else _env.get_int("MXNET_CKPT_EVERY_N")
+        ckpt_dir = checkpoint_dir or _env.get_str("MXNET_CKPT_DIR")
+        mgr = None
+        if every and ckpt_dir:
+            mgr = _ckpt.CheckpointManager(ckpt_dir)
+        start = 0
+        if resume_from:
+            payload = _ckpt.load_checkpoint(resume_from)
+            self.load_state(payload)
+            start = int(payload["step"])
+            train_iter.reset()
+            skip = int((payload.get("iterator") or {})
+                       .get("nbatch", start))
+            if hasattr(train_iter, "skip_batches"):
+                train_iter.skip_batches(skip)
+            else:
+                for _ in range(skip):
+                    if not train_iter.iter_next():
+                        train_iter.reset()
+                        train_iter.iter_next()
+        chaos_on = _chaos.enabled()
+        tps = _diag.metrics.gauge(
+            "mxnet_transformer_tokens_per_second",
+            "transformer fit throughput (tokens/s, this rank)")
+        losses: List[float] = []
+        loss_dev = None
+        t_last = time.monotonic()
+        for step_i in range(start, int(num_steps)):
+            batch = self._next_batch(train_iter)
+            tokens, labels = batch.data[0], batch.label[0]
+            if chaos_on:
+                _chaos.maybe_delay("transformer_step", step=step_i)
+            loss_dev = self.step(tokens, labels)
+            if chaos_on:
+                # mid-run preemption that didn't say goodbye — the
+                # kill/resume harness's injection point
+                _chaos.should_kill(step_i + 1)
+            # block before sampling the clock: an async dispatch
+            # interval is host cost, not step time — same truthful-
+            # metric stance as the bulk fit path's step timing
+            _jax().block_until_ready(loss_dev)  # mxlint: disable=MXL004
+            now = time.monotonic()
+            n_tok = int(tokens.shape[0]) * int(tokens.shape[1])
+            if now > t_last:
+                tps.set(n_tok / (now - t_last))
+            t_last = now
+            losses.append(loss_dev)
+            if log_every and (step_i + 1) % log_every == 0:
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "transformer step %d loss %.5f", step_i + 1,
+                    float(losses[-1]))
+            if mgr is not None and (step_i + 1) % every == 0:
+                # the per-step block above guarantees the snapshot
+                # sees THIS step's params; hand the write to the
+                # manager
+                mgr.save(step_i + 1, params=self._params,
+                         optimizer_states=self.optimizer_states_bytes(),
+                         iterator_state={"nbatch": step_i + 1},
+                         extra={"workload": "transformer_lm"})
+        if mgr is not None:
+            mgr.wait()
+        return [float(v) for v in losses]
+
+    @staticmethod
+    def _next_batch(train_iter):
+        try:
+            return train_iter.next()
+        except StopIteration:
+            train_iter.reset()
+            return train_iter.next()

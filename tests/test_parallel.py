@@ -158,7 +158,7 @@ def test_dryrun_entrypoints(monkeypatch):
 @pytest.mark.slow
 def test_dryrun_scaling_report_full():
     """The full dryrun + scaling report (sweep, controls, bucketing
-    accounting, SCALING_r06.json) — the driver-phase behavior."""
+    accounting, SCALING_r08.json) — the driver-phase behavior."""
     _need_devices(8)
     import __graft_entry__ as ge
 
@@ -167,7 +167,7 @@ def test_dryrun_scaling_report_full():
     import os
 
     path = os.path.join(os.path.dirname(os.path.abspath(ge.__file__)),
-                        "SCALING_r06.json")
+                        "SCALING_r08.json")
     with open(path) as f:
         rep = json.load(f)
     assert rep["bucketing"]["bucketed"] is True

@@ -1,0 +1,45 @@
+"""Transformer-tier chaos worker: the kill/resume harness applied to
+the new workload (test_transformer.py runs it three ways):
+
+  control — uninterrupted 8-step ZeRO-1 fit on the dp=2 CPU mesh;
+            dumps final params.
+  victim  — MXNET_CHAOS kills the process mid-fit (exit 137) after the
+            step-4 checkpoint landed.
+  resume  — fresh process resumes from the newest complete step and
+            finishes; final params must match control BITWISE (same
+            world, same bucket plan, deterministic iterator).
+
+Usage: transformer_worker.py <mode> <ckpt_dir> <out_path>
+"""
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.parallel.mesh import make_mesh  # noqa: E402
+from mxnet_tpu.transformer import (LMTokenIter,  # noqa: E402
+                                   TransformerConfig,
+                                   TransformerTrainStep)
+
+
+def main():
+    mode, ckpt_dir, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
+    cfg = TransformerConfig(vocab_size=64, n_layers=2, d_model=32,
+                            n_heads=4, d_ff=64)
+    mesh = make_mesh((2,), ("dp",), jax.devices()[:2])
+    step = TransformerTrainStep(cfg, mesh=mesh, seed=0, zero_stage=1)
+    it = LMTokenIter(batch_size=4, seq_len=16, vocab_size=64,
+                     num_sequences=32)
+    kw = dict(checkpoint_every_n=2, checkpoint_dir=ckpt_dir)
+    if mode == "resume":
+        kw["resume_from"] = ckpt_dir
+    step.fit(it, 8, **kw)
+    np.savez(out_path, **step.params_numpy())
+    print("transformer worker done (%s)" % mode)
+
+
+if __name__ == "__main__":
+    main()
