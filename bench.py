@@ -1306,6 +1306,18 @@ def _emit_final(reason=None):
             }
         except Exception as exc:
             out["static_analysis"] = {"error": repr(exc)}
+    # elastic provenance: which fleet incarnation produced these
+    # numbers (a supervised bench restarted mid-run must not be
+    # mistaken for generation 0's uninterrupted pass)
+    try:
+        from mxnet_tpu import dist as _dist_mod
+
+        out["elastic"] = {
+            "generation": _dist_mod.generation(),
+            "supervised": _dist_mod.is_supervised(),
+        }
+    except Exception as exc:
+        out["elastic"] = {"error": repr(exc)}
     if reason:
         out["truncated"] = reason
     print(json.dumps(out), flush=True)

@@ -422,6 +422,18 @@ class Heartbeat:
         dead_after = max(3.0 * interval, 1.0)
         client = None
         while not self._stop.wait(interval):
+            if self.role == "worker":
+                # the elastic supervisor's liveness file rides the same
+                # beacon: a worker stuck in a collective still beats
+                # here, so only a truly wedged PROCESS goes stale.
+                # Workers only — a server/scheduler touching the
+                # rank-0 file would mask a hung worker 0.
+                try:
+                    from . import diagnostics as _diag
+
+                    _diag.touch_heartbeat()
+                except Exception:
+                    pass
             try:
                 if client is None:
                     client = connect_scheduler(retries=1)

@@ -57,6 +57,16 @@ hold; ``nth`` skips the first nth-1 candidate events.  Kinds:
     degraded throughput, never a deadlock (the round-robin consumer
     just waits on that worker's turn).  Match keys: ``worker``,
     ``nth``, ``count``, ``ms``.
+  * ``kill_rank``      — SUPERVISOR-level kill: the elastic
+    supervisor (mxnet_tpu.elastic) SIGKILLs its child worker ``rank``
+    mid-run — the machine-went-away failure the automatic
+    detect→reshape→resume loop must absorb with zero operator action.
+    Candidate events are the supervisor's monitor ticks per live
+    worker; ``tick`` and ``ckpt_step`` (the newest COMPLETE checkpoint
+    step at that tick) ride the context, so ``kill_rank:rank=1,
+    ckpt_step=4`` kills rank 1 the moment step 4's checkpoint is
+    resumable — a deterministic "mid-run, after a checkpoint landed".
+    Match keys: ``rank``, ``tick``, ``ckpt_step``, ``nth``, ``count``.
 
 Injected faults count into ``mxnet_chaos_injected_total{kind=...}``
 (diagnostics.metrics) so a test can assert the fault actually fired —
@@ -79,7 +89,7 @@ from typing import Any, Dict, List, Optional
 __all__ = ["Rule", "rules", "enabled", "fault", "should_kill",
            "maybe_slow_request", "should_fail_execute",
            "maybe_corrupt_shard", "should_fail_version",
-           "maybe_slow_decode",
+           "maybe_slow_decode", "should_kill_rank",
            "injected_total", "reset", "KILL_EXIT_CODE"]
 
 _log = logging.getLogger(__name__)
@@ -89,7 +99,7 @@ _log = logging.getLogger(__name__)
 KILL_EXIT_CODE = 137
 
 _INT_KEYS = ("rank", "nth", "count", "step", "version", "nbytes",
-             "worker")
+             "worker", "tick", "ckpt_step")
 _FLOAT_KEYS = ("ms",)
 
 
@@ -330,6 +340,15 @@ def maybe_slow_decode(worker: int, **ctx) -> None:
         time.sleep(float(r.params.get("ms", 100.0)) / 1e3)
 
 
+def should_kill_rank(rank: int, **ctx) -> bool:
+    """kill_rank hook (elastic supervisor's monitor loop, once per
+    tick per LIVE worker): True when the supervisor must SIGKILL child
+    ``rank`` now.  The rank is explicit — it names the victim CHILD,
+    never this (supervisor) process.  ``tick``/``ckpt_step`` ride the
+    context for deterministic mid-run kills."""
+    return fault("kill_rank", rank=rank, **ctx) is not None
+
+
 def should_fail_version(model: str, version: int, **ctx) -> bool:
     """bad_version hook (ModelServer canary dispatch): True when the
     matching model's NEW version must fail its canary batch — what
@@ -471,7 +490,26 @@ def _self_test() -> tuple:
         del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
         reset()
 
-    # 8) disabled == inert (and never raises)
+    # 8) the supervisor kind: kill_rank matches the explicit child
+    # rank + a deterministic ckpt_step gate (no default-rank fill-in
+    # confusion: the ctx rank IS the victim's)
+    os.environ["MXNET_CHAOS"] = "kill_rank:rank=1,ckpt_step=4"  # mxlint: disable=MXL002
+    reset()
+    try:
+        checks["kill_rank_wrong_rank"] = not should_kill_rank(
+            0, tick=3, ckpt_step=4)
+        checks["kill_rank_wrong_ckpt"] = not should_kill_rank(
+            1, tick=3, ckpt_step=3)
+        checks["kill_rank_fires"] = should_kill_rank(
+            1, tick=4, ckpt_step=4)
+        checks["kill_rank_consumed"] = not should_kill_rank(
+            1, tick=5, ckpt_step=4)
+        checks["kill_rank_counted"] = injected_total("kill_rank") == 1
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        reset()
+
+    # 9) disabled == inert (and never raises)
     checks["disabled_inert"] = not enabled() and \
         fault("kill", step=1) is None
 

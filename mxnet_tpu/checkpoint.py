@@ -125,6 +125,17 @@ def _rank_info() -> Tuple[int, int]:
     return _profiler._dist_info()
 
 
+def _generation() -> int:
+    """The writing fleet's incarnation (``dist.generation``, the one
+    reader of MXNET_ELASTIC_GENERATION) — stamped into shards,
+    sidecars and the manifest so ``merge_traces --health`` and the
+    supervisor's restart timeline attribute each checkpoint to the
+    right incarnation."""
+    from . import dist as _dist
+
+    return _dist.generation()
+
+
 def step_dir(directory: str, step: int) -> str:
     return os.path.join(directory, "step_%08d" % int(step))
 
@@ -319,16 +330,22 @@ def _tree_spec(tree: Dict[str, Any]) -> Dict[str, dict]:
 
 
 def _try_assemble_manifest(directory: str, step: int,
-                           num_ranks: int) -> Optional[str]:
+                           num_ranks: int,
+                           force: bool = False) -> Optional[str]:
     """Once every rank's shard + digest sidecar landed, fold them into
     the step's MANIFEST.json (atomic write; racing ranks write
     identical content).  The digests come from the sidecars — computed
     from the in-memory pickle BEFORE the bytes hit disk — so on-disk
-    corruption after the write is always detectable."""
-    if os.path.exists(manifest_path(directory, step)):
+    corruption after the write is always detectable.  ``force``
+    re-assembles over an EXISTING manifest (a shard legitimately
+    re-written for a manifested step — e.g. a preemption save landing
+    on a boundary step — must refresh the recorded digest, or every
+    later load reports phantom corruption)."""
+    if not force and os.path.exists(manifest_path(directory, step)):
         return None
     shards: Dict[str, dict] = {}
     tree: Dict[str, Any] = {}
+    generation = 0
     for r in range(num_ranks):
         if not os.path.exists(shard_path(directory, step, r)):
             return None
@@ -342,11 +359,13 @@ def _try_assemble_manifest(directory: str, step: int,
                           "sha256": meta["sha256"]}
         if meta.get("tree"):
             tree = meta["tree"]
+        generation = max(generation, int(meta.get("generation", 0)))
     manifest = {
         "manifest_version": MANIFEST_VERSION,
         "format_version": FORMAT_VERSION,
         "step": int(step),
         "num_ranks": int(num_ranks),
+        "generation": generation,
         "shards": shards,
         "tree": tree,
     }
@@ -560,6 +579,7 @@ class CheckpointManager:
         payload = {
             "format_version": FORMAT_VERSION,
             "step": int(step), "epoch": int(epoch), "nbatch": int(nbatch),
+            "generation": _generation(),
             "rank": self.rank, "num_ranks": self.num_ranks,
             "params": _snapshot_params(params),
             "aux_params": _snapshot_params(aux_params),
@@ -604,6 +624,7 @@ class CheckpointManager:
         sidecar = {
             "rank": self.rank, "step": int(step),
             "num_ranks": self.num_ranks,
+            "generation": int(payload.get("generation", 0)),
             "bytes": len(blob), "sha256": digest,
             "format_version": FORMAT_VERSION,
             "tree": {"params": _tree_spec(payload.get("params")),
@@ -639,7 +660,13 @@ class CheckpointManager:
             # after its true digest was recorded — the bit-rot the
             # verify/fallback path must catch
             _chaos.maybe_corrupt_shard(path, step=step, rank=self.rank)
-        _try_assemble_manifest(self.directory, step, self.num_ranks)
+        man = read_manifest(self.directory, step)
+        stale = bool(
+            man is not None
+            and man.get("shards", {}).get(str(self.rank), {})
+            .get("sha256") not in (None, digest))
+        _try_assemble_manifest(self.directory, step, self.num_ranks,
+                               force=stale)
         self._gc(keep_at_least=step)
 
     def _gc(self, keep_at_least: int) -> None:

@@ -66,8 +66,11 @@ __all__ = [
     "reset_recompile_stats", "recorded_steps", "Gauge", "Counter",
     "Histogram", "MetricsRegistry", "metrics", "record_step",
     "validate_prom_text", "EXIT_PREEMPTED", "EXIT_WATCHDOG_ABORT",
+    "EXIT_DIVERGED",
     "register_preemption_hook", "unregister_preemption_hook",
     "run_preemption_hooks", "set_dead_peers", "dead_peers",
+    "generation", "touch_heartbeat", "DivergenceError",
+    "DivergenceGuard",
 ]
 
 _log = logging.getLogger(__name__)
@@ -77,6 +80,12 @@ DEFAULT_RING_SIZE = 256
 #: SIGTERM landed, in-flight collectives drained, preemption hooks
 #: (checkpoint) ran — the run is resumable from its checkpoint dir.
 EXIT_PREEMPTED = 83
+#: the divergence guard (MXNET_DIVERGENCE_WINDOW) tripped under the
+#: elastic supervisor: the loss spiked past the windowed threshold (or
+#: went non-finite), evidence dumped, process exited WITHOUT saving the
+#: poisoned state so the supervisor restores the last VERIFIED
+#: checkpoint.
+EXIT_DIVERGED = 84
 #: the collective watchdog's second threshold (MXNET_COLLECTIVE_ABORT_S)
 #: fired: the fleet was permanently desynced, evidence dumped,
 #: checkpoint attempted, process aborted restartably instead of hanging.
@@ -168,6 +177,151 @@ def set_dead_peers(peers) -> None:
 def dead_peers() -> List[str]:
     with _dead_peers_lock:
         return list(_dead_peers)
+
+
+def generation() -> int:
+    """This process's fleet incarnation (``MXNET_ELASTIC_GENERATION``,
+    exported by the elastic supervisor; 0 for unsupervised runs) —
+    stamped into flight-dump headers so post-mortem tooling attributes
+    artifacts to the right incarnation.  One reader for the contract:
+    ``dist.generation``."""
+    from . import dist as _dist
+
+    return _dist.generation()
+
+
+_hb_lock = threading.Lock()
+_hb_last = 0.0
+_hb_path: Optional[str] = None
+
+
+def touch_heartbeat(min_interval_s: float = 0.5) -> Optional[str]:
+    """Liveness beacon for the elastic supervisor: utime/create
+    ``$MXNET_ELASTIC_HEARTBEAT_DIR/hb_rank{K}``.  Called from the fit
+    loops (per step) and the PS heartbeat thread; rate-limited so a
+    fast step loop pays one ``utime`` every ``min_interval_s`` at most.
+    No-op (None) when the env is unset — unsupervised runs pay one env
+    lookup."""
+    global _hb_last, _hb_path
+    from . import env as _envmod
+
+    d = _envmod.get_str("MXNET_ELASTIC_HEARTBEAT_DIR")
+    if not d:
+        return None
+    now = time.monotonic()
+    with _hb_lock:
+        if now - _hb_last < min_interval_s and _hb_path:
+            return _hb_path
+        _hb_last = now
+    path = os.path.join(d, "hb_rank%d" % _rank_info()[0])
+    try:
+        os.makedirs(d, exist_ok=True)
+        if os.path.exists(path):
+            os.utime(path)
+        else:
+            with open(path, "w"):
+                pass
+        _hb_path = path
+        return path
+    except OSError:
+        return None
+
+
+class DivergenceError(RuntimeError):
+    """The loss-spike guard tripped outside supervision: training was
+    stopped rather than continued through garbage.  Under the elastic
+    supervisor the process exits ``EXIT_DIVERGED`` instead so the fleet
+    is restored from the last verified checkpoint automatically."""
+
+
+class DivergenceGuard:
+    """Loss-spike detector (``MXNET_DIVERGENCE_WINDOW`` /
+    ``MXNET_DIVERGENCE_FACTOR``) — the ``MXNET_SKIP_NONFINITE_GRADS``
+    idea extended from "the gradients are NaN" to "the loss exploded":
+    once ``window`` losses are observed, a step whose loss exceeds
+    ``median + factor x |median|`` of the window (or is non-finite)
+    is divergence.
+
+    :meth:`check` feeds one loss and returns True on a trip (counted in
+    ``mxnet_training_divergence_trips_total``).  :meth:`trip` applies
+    the policy: under the elastic supervisor
+    (``MXNET_ELASTIC_SUPERVISED``) dump the flight ring and exit
+    ``EXIT_DIVERGED=84`` WITHOUT checkpointing the poisoned state —
+    the supervisor then restores the last verified checkpoint;
+    unsupervised, raise :class:`DivergenceError`."""
+
+    def __init__(self, window: Optional[int] = None,
+                 factor: Optional[float] = None):
+        from . import env as _envmod
+
+        self.window = int(_envmod.get_int("MXNET_DIVERGENCE_WINDOW")
+                          if window is None else window)
+        self.factor = float(_envmod.get_float("MXNET_DIVERGENCE_FACTOR")
+                            if factor is None else factor)
+        self._history: List[float] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.window > 0
+
+    def check(self, loss: float, step: Optional[int] = None) -> bool:
+        """Feed one step's loss; True when it diverged from the window.
+        The spiking loss is NOT folded into the baseline (one bad step
+        must not drag the median up toward itself)."""
+        if not self.enabled:
+            return False
+        import math
+
+        loss = float(loss)
+        finite = math.isfinite(loss)
+        spiked = not finite
+        if finite and len(self._history) >= self.window:
+            med = sorted(self._history)[len(self._history) // 2]
+            # threshold = median + factor x |median|: scale-relative
+            # above AND below zero (losses can be legitimately
+            # negative — a continuous-density NLL — and a zero/negative
+            # median must not make every positive step a "spike")
+            spiked = loss > med + self.factor * max(abs(med), 1e-8)
+        if spiked:
+            metrics.counter(
+                "mxnet_training_divergence_trips_total",
+                help="steps the loss-spike divergence guard flagged"
+            ).inc()
+            _log.error(
+                "DIVERGENCE: loss %r at step %s tripped the guard "
+                "(window %d, factor %.2f, window median %s)",
+                loss, step, self.window, self.factor,
+                sorted(self._history)[len(self._history) // 2]
+                if self._history else None)
+            return True
+        if finite:
+            self._history.append(loss)
+            if len(self._history) > self.window:
+                del self._history[0]
+        return False
+
+    def trip(self, step: Optional[int] = None) -> None:
+        """Apply the divergence policy (see class docstring)."""
+        from . import env as _envmod
+
+        if recorder.n_recorded():
+            # empty rings never dump (the artifact-hygiene contract:
+            # a collective-less process must not litter evidence files)
+            recorder.dump(reason="divergence")
+        if _envmod.get_bool("MXNET_ELASTIC_SUPERVISED"):
+            _log.error(
+                "divergence at step %s under the elastic supervisor: "
+                "exiting %d so the fleet restores the last VERIFIED "
+                "checkpoint (this state is deliberately NOT saved)",
+                step, EXIT_DIVERGED)
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(EXIT_DIVERGED)
+        raise DivergenceError(
+            "loss diverged at step %s (window %d, factor %.2f); "
+            "restore from the last verified checkpoint — under "
+            "python -m mxnet_tpu.elastic this restore is automatic"
+            % (step, self.window, self.factor))
 
 
 def _dump_env() -> Tuple[bool, Optional[str]]:
@@ -343,6 +497,7 @@ class FlightRecorder:
                 "bucket_plan": dict(self._bucket_plan)
                 if self._bucket_plan else None,
                 "dead_peers": dead_peers(),
+                "generation": generation(),
                 "pid": os.getpid(), "dump_ts": time.time(),
             }
             entries = [dict(e) for e in self._entries]
@@ -1237,6 +1392,10 @@ def record_step(step_time_s: float, samples: Optional[int] = None,
                               labels={"metric": str(name)}).set(value)
             except (TypeError, ValueError):
                 pass  # non-scalar metric values have no gauge form
+        # every workload that records steps is alive by definition —
+        # the supervisor's hung-worker beacon rides the same call
+        # (rate-limited + no-op unless supervised)
+        touch_heartbeat()
         metrics.maybe_flush()
     except Exception:
         pass  # telemetry must never fail the training loop

@@ -23,12 +23,54 @@ meshes built from ``jax.devices()`` are pod-wide.
 from __future__ import annotations
 
 import os
+import socket
 from typing import Optional
 
 __all__ = ["initialize", "is_initialized", "shutdown", "rank",
-           "num_processes", "local_devices", "global_devices"]
+           "num_processes", "local_devices", "global_devices",
+           "free_port", "generation", "is_supervised", "elastic_env"]
 
 _initialized = False
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port — the launcher/supervisor's shared
+    way to pick coordinator and PS root ports."""
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def generation() -> int:
+    """This process's fleet incarnation (``MXNET_ELASTIC_GENERATION``;
+    0 when not running under the elastic supervisor)."""
+    from . import env as _env
+
+    return int(_env.get_int("MXNET_ELASTIC_GENERATION") or 0)
+
+
+def is_supervised() -> bool:
+    """True when an elastic supervisor (mxnet_tpu.elastic) is the
+    parent of this process and will restart/reshape the fleet on
+    failure — failure paths may exit restartably instead of requiring
+    an operator."""
+    from . import env as _env
+
+    return _env.get_bool("MXNET_ELASTIC_SUPERVISED")
+
+
+def elastic_env(generation_n: int, heartbeat_dir: Optional[str] = None
+                ) -> dict:
+    """The env contract the supervisor exports to every child of one
+    fleet incarnation (the elastic sibling of the launch contracts
+    above)."""
+    env = {"MXNET_ELASTIC_GENERATION": str(int(generation_n)),
+           "MXNET_ELASTIC_SUPERVISED": "1"}
+    if heartbeat_dir:
+        env["MXNET_ELASTIC_HEARTBEAT_DIR"] = str(heartbeat_dir)
+    return env
 
 
 def env_spec():

@@ -1,0 +1,13 @@
+"""mx.elastic — the elastic fleet supervisor (see supervisor.py).
+
+``python -m mxnet_tpu.elastic -n 2 -- python train.py`` supervises a
+training fleet: automatic failure detection, drain, mesh reshape to
+the surviving world size, and resume from the newest verified
+checkpoint — zero operator action.  ``--self-test`` runs the no-jax
+state-machine checks (tier-1).
+"""
+from .supervisor import (EXIT_RESTART_BUDGET, FleetSupervisor,
+                         SlotBoard, backoff_delay, classify_exit)
+
+__all__ = ["EXIT_RESTART_BUDGET", "FleetSupervisor", "SlotBoard",
+           "backoff_delay", "classify_exit"]

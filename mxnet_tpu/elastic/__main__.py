@@ -1,0 +1,207 @@
+"""CLI: ``python -m mxnet_tpu.elastic``.
+
+  --self-test       no-jax supervisor state-machine checks (tier-1):
+                    exit-code classification, deterministic backoff
+                    schedule, slot board rejoin semantics, and four
+                    mini supervised fleets of dummy children proving
+                    clean completion, reshape W→W-1 after a kill,
+                    restart-budget exhaustion (exit 86), divergence
+                    restart at full W, and the rejoin window
+                    restoring W.
+  -n/-s/--mode ...  supervise a real fleet:
+                    python -m mxnet_tpu.elastic -n 2 -s 1 \\
+                        --state-dir sup --ckpt-dir ckpt -- \\
+                        python train.py --kv-store dist_sync
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+from .supervisor import (EXIT_RESTART_BUDGET, FleetSupervisor,
+                         SlotBoard, backoff_delay, classify_exit)
+
+#: dummy worker bodies for the self-test fleets: pure python -c
+#: children keyed off the elastic env contract — no jax, fast.
+_EXIT_BY_GEN = (
+    "import os,sys;"
+    "g=int(os.environ['MXNET_ELASTIC_GENERATION']);"
+    "r=int(os.environ['DMLC_WORKER_ID']);"
+    "sys.exit(int(os.environ.get('ELASTIC_TEST_EXIT_G%d_R%d'"
+    " % (g, r), '0')))"
+)
+
+
+def _mini_fleet(tmp, name, n, plan, **kw):
+    """A supervised exec-mode fleet of _EXIT_BY_GEN children; ``plan``
+    maps (gen, rank) -> exit code (default 0)."""
+    env = {"ELASTIC_TEST_EXIT_G%d_R%d" % k: str(v)
+           for k, v in plan.items()}
+    sup = FleetSupervisor(
+        [sys.executable, "-c", _EXIT_BY_GEN], num_workers=n,
+        mode="exec", state_dir=os.path.join(tmp, name),
+        backoff_s=0.01, jitter=False, monitor_interval_s=0.02,
+        drain_s=2.0, env=env, **kw)
+    return sup
+
+
+def _self_test() -> tuple:
+    checks = {}
+
+    # 1) exit-code classification: the README table, one label each
+    checks["classify"] = (
+        classify_exit(0) == "ok"
+        and classify_exit(83) == "preempted"
+        and classify_exit(84) == "diverged"
+        and classify_exit(85) == "watchdog_abort"
+        and classify_exit(137) == "killed"
+        and classify_exit(-9) == "killed"        # Popen signal form
+        and classify_exit(-15) == "terminated"
+        and classify_exit(1) == "crashed")
+
+    # 2) backoff schedule: deterministic doubling without jitter,
+    # jittered within +-50% with
+    sched = [backoff_delay(i, 1.0, jitter=False) for i in range(4)]
+    checks["backoff_doubles"] = sched == [1.0, 2.0, 4.0, 8.0]
+    j = [backoff_delay(2, 1.0, jitter=True) for _ in range(16)]
+    checks["backoff_jitter_bounded"] = all(2.0 <= v <= 6.0 for v in j)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # 3) slot board: failure, stale-marker rejection, fresh rejoin
+        board = SlotBoard(2, tmp)
+        stale = board.rejoin_path(1)
+        with open(stale, "w"):
+            pass
+        os.utime(stale, (time.time() - 3600, time.time() - 3600))
+        board.mark_failed(1)
+        checks["slot_failed"] = board.healthy() == [0]
+        checks["stale_marker_ignored"] = board.poll_rejoin() == [] \
+            and board.healthy() == [0]
+        os.utime(stale)  # a FRESH touch answers the failure
+        checks["fresh_marker_rejoins"] = board.poll_rejoin() == [1] \
+            and board.healthy() == [0, 1] \
+            and not os.path.exists(stale)
+
+        # 4) clean fleet: every worker exits 0 -> rc 0, one generation
+        sup = _mini_fleet(tmp, "clean", 2, {})
+        checks["clean_rc0"] = sup.run() == 0
+        checks["clean_one_gen"] = sup.generation == 0 \
+            and sup.restarts == 0
+        with open(sup.events_path) as f:
+            ev = json.load(f)
+        checks["journal_classified"] = ev.get("elastic_supervisor") \
+            is True
+        kinds = [e["kind"] for e in ev["events"]]
+        checks["journal_clean_kinds"] = kinds[0] == "launch" \
+            and kinds[-1] == "fleet_done"
+
+        # 5) kill -> reshape W=2 -> W'=1, resume, finish
+        sup = _mini_fleet(tmp, "reshape", 2, {(0, 1): 137})
+        checks["reshape_rc0"] = sup.run() == 0
+        checks["reshape_gen1"] = sup.generation == 1
+        launches = [e for e in sup.events if e["kind"] == "launch"]
+        checks["reshape_w_shrinks"] = \
+            [e["world_size"] for e in launches] == [2, 1]
+        checks["reshape_reason"] = any(
+            e["kind"] == "fleet_down" and e["reason"] == "killed"
+            for e in sup.events)
+
+        # 6) restart budget exhaustion exits nonzero (86): rank 0
+        # crashes every generation, budget 1
+        plan = {(g, 0): 1 for g in range(4)}
+        sup = _mini_fleet(tmp, "budget", 1, plan, max_restarts=1)
+        checks["budget_rc"] = sup.run() == EXIT_RESTART_BUDGET
+        checks["budget_restarts"] = sup.restarts == 2
+        checks["budget_event"] = any(
+            e["kind"] == "budget_exhausted" for e in sup.events)
+        # a single-slot fleet restarts its only slot (there is no W'
+        # to shrink to)
+        checks["budget_restores_only_slot"] = any(
+            e["kind"] == "all_slots_failed_restoring"
+            for e in sup.events)
+
+        # 7) divergence (84) restarts at FULL W — a training failure,
+        # not a node failure
+        sup = _mini_fleet(tmp, "diverged", 2, {(0, 1): 84})
+        checks["diverged_rc0"] = sup.run() == 0
+        launches = [e for e in sup.events if e["kind"] == "launch"]
+        checks["diverged_w_kept"] = \
+            [e["world_size"] for e in launches] == [2, 2]
+        checks["diverged_reason"] = any(
+            e["kind"] == "fleet_down" and e["reason"] == "diverged"
+            for e in sup.events)
+
+        # 8) the rejoin window restores W: rank 1 is killed in gen 0;
+        # its slot's rejoin marker lands inside the window, so gen 1
+        # launches at the FULL world size
+        sup = _mini_fleet(tmp, "rejoin", 2, {(0, 1): 137},
+                          rejoin_s=5.0)
+
+        def _rejoin_soon():
+            time.sleep(0.3)
+            with open(sup.slots.rejoin_path(1), "w"):
+                pass
+
+        t = threading.Thread(target=_rejoin_soon, daemon=True)
+        t.start()
+        checks["rejoin_rc0"] = sup.run() == 0
+        t.join()
+        launches = [e for e in sup.events if e["kind"] == "launch"]
+        checks["rejoin_w_restored"] = \
+            [e["world_size"] for e in launches] == [2, 2]
+        checks["rejoin_event"] = any(
+            e["kind"] == "slots_rejoined" and e["slots"] == [1]
+            for e in sup.events)
+
+    return all(checks.values()), checks
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.elastic",
+        description="elastic fleet supervisor: failure detection -> "
+                    "mesh reshape -> resume at the new world size")
+    ap.add_argument("--self-test", action="store_true",
+                    help="no-jax state-machine checks (tier-1)")
+    ap.add_argument("-n", "--num-workers", type=int, default=None)
+    ap.add_argument("-s", "--num-servers", type=int, default=1)
+    ap.add_argument("--mode", choices=["ps", "exec"], default="ps")
+    ap.add_argument("--state-dir", default="elastic_state",
+                    help="supervisor scratch: heartbeat files, rejoin "
+                         "markers, per-generation logs, events journal")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="the fleet's shared checkpoint dir (exported "
+                         "as MXNET_CKPT_DIR; resume point)")
+    ap.add_argument("--max-restarts", type=int, default=None)
+    ap.add_argument("--backoff-s", type=float, default=None)
+    ap.add_argument("--rejoin-s", type=float, default=None)
+    ap.add_argument("--heartbeat-timeout-s", type=float, default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="-- worker argv")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        ok, checks = _self_test()
+        print(json.dumps({"self_test_ok": ok, "checks": checks}))
+        return 0 if ok else 1
+    if not args.num_workers or not args.command:
+        ap.print_help()
+        return 0
+    cmd = args.command[1:] if args.command[:1] == ["--"] \
+        else args.command
+    sup = FleetSupervisor(
+        cmd, num_workers=args.num_workers,
+        num_servers=args.num_servers, mode=args.mode,
+        state_dir=args.state_dir, ckpt_dir=args.ckpt_dir,
+        max_restarts=args.max_restarts, backoff_s=args.backoff_s,
+        rejoin_s=args.rejoin_s,
+        heartbeat_timeout_s=args.heartbeat_timeout_s)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

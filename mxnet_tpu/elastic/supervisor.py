@@ -1,0 +1,603 @@
+"""Elastic fleet supervisor: automatic failure detection → mesh
+reshape → resume at the new world size.
+
+The fault-tolerance stack below this module can *survive* a failure —
+exit-code contract (83 preempted / 84 diverged / 85 watchdog-abort /
+137 killed), heartbeat dead-peer detection, W→W' elastic checkpoints —
+but recovery used to need an operator: a killed rank left the fleet
+dead until somebody relaunched it by hand, exactly the external
+supervisor the reference's ps-lite heritage always assumed
+(Scheduler/Postoffice node management, src/kvstore/kvstore_dist.h).
+This module IS that supervisor, TPU-native: a parent process that
+
+  1. **launches** the training fleet (the ``tools/launch.py`` local-PS
+     plumbing, or plain rank processes in ``exec`` mode), exporting the
+     elastic env contract (``dist.elastic_env``: generation counter,
+     supervised flag, heartbeat dir) to every child;
+  2. **watches** liveness: child exit codes every monitor tick, plus
+     per-rank heartbeat files (``diagnostics.touch_heartbeat``, fed by
+     the fit loops and the PS heartbeat thread) so a *hung* worker —
+     alive but wedged — is detected and SIGKILLed
+     (``MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S``);
+  3. on failure **drains** survivors (SIGTERM → they dump, checkpoint,
+     exit 83), recomputes the world plan at ``W' = surviving slots``
+     — with a bounded **rejoin window** (``MXNET_ELASTIC_REJOIN_S``):
+     a failed slot whose ``slot{K}.rejoin`` marker appears in the
+     supervisor state dir before the window closes is restored, so a
+     rebooted node rejoins at full W instead of forcing a shrink;
+  4. **relaunches** from the newest *verified* checkpoint (the children
+     resume via ``MXNET_CKPT_DIR`` + the elastic W→W' resume contract
+     in checkpoint.py) under a restart budget with exponential backoff
+     (``MXNET_ELASTIC_MAX_RESTARTS`` / ``MXNET_ELASTIC_BACKOFF_S``,
+     the ``_ps.backoff_delays`` discipline applied to whole-fleet
+     relaunches); budget exhaustion exits ``EXIT_RESTART_BUDGET=86``.
+
+Every incarnation gets a **generation** counter
+(``MXNET_ELASTIC_GENERATION``) stamped into flight-recorder headers
+and checkpoint sidecars/manifests, and every transition is journaled
+to ``supervisor_events.json`` — ``tools/merge_traces.py --health``
+ingests both and prints the restart timeline ("gen 0 died at seq 12
+(rank 1 killed); gen 1 resumed at W=1 from step 4").
+
+Chaos: the ``kill_rank`` kind (``MXNET_CHAOS=kill_rank:rank=1,
+ckpt_step=4``) is evaluated INSIDE the monitor loop — the supervisor
+SIGKILLs its own child mid-run, which is how the detect→reshape→resume
+loop is proven end-to-end with zero operator action.
+
+No jax anywhere in this module: the supervisor is a pure-host parent
+(it must outlive any backend crash its children suffer).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .. import dist as _dist
+from .. import env as _env
+from ..diagnostics import (EXIT_DIVERGED, EXIT_PREEMPTED,
+                           EXIT_WATCHDOG_ABORT)
+
+__all__ = [
+    "EXIT_RESTART_BUDGET", "classify_exit", "backoff_delay",
+    "SlotBoard", "FleetSupervisor",
+]
+
+_log = logging.getLogger(__name__)
+
+#: the supervisor's own give-up code: the restart budget
+#: (MXNET_ELASTIC_MAX_RESTARTS) is spent and the fleet still fails —
+#: whatever is wrong, more restarts won't fix it.
+EXIT_RESTART_BUDGET = 86
+
+#: chaos 'kill' / real SIGKILL through a shell
+_KILL_CODES = (137,)
+
+
+def _norm_code(rc: Optional[int]) -> Optional[int]:
+    """Popen reports a signal death as ``-signum``; normalize to the
+    shell's ``128+signum`` so one table covers both spellings."""
+    if rc is None:
+        return None
+    return rc if rc >= 0 else 128 - rc
+
+
+def classify_exit(rc: Optional[int]) -> str:
+    """One worker exit code → restart-reason label (the
+    ``mxnet_elastic_restarts_total{reason}`` vocabulary)."""
+    rc = _norm_code(rc)
+    if rc == 0:
+        return "ok"
+    if rc == EXIT_PREEMPTED:
+        return "preempted"
+    if rc == EXIT_DIVERGED:
+        return "diverged"
+    if rc == EXIT_WATCHDOG_ABORT:
+        return "watchdog_abort"
+    if rc in _KILL_CODES:
+        return "killed"
+    if rc == 128 + signal.SIGTERM:
+        return "terminated"
+    return "crashed"
+
+
+def backoff_delay(attempt: int, base_s: Optional[float] = None,
+                  jitter: bool = True) -> float:
+    """Delay before relaunch ``attempt`` (0-based): ``base * 2^i`` with
+    ±50% jitter — the ``_ps.backoff_delays`` discipline, one fleet
+    relaunch at a time.  ``jitter=False`` gives the deterministic
+    schedule the unit tests pin."""
+    if base_s is None:
+        base_s = _env.get_float("MXNET_ELASTIC_BACKOFF_S")
+    base = max(float(base_s), 0.0) * (2 ** max(int(attempt), 0))
+    if not jitter:
+        return base
+    import random as _random
+
+    return base * (0.5 + _random.random())
+
+
+class SlotBoard:
+    """Which worker slots (the original ranks 0..W-1) are healthy.
+
+    A slot is the supervisor's stand-in for "the machine rank K ran
+    on": a killed/crashed/hung worker fails its slot; a slot rejoins
+    when its ``slot{K}.rejoin`` marker file appears in the state dir
+    (touched by whatever brings the node back — an operator, a node
+    agent, a test).  The marker must be YOUNGER than the failure it
+    answers, so stale debris from an earlier incident never fakes a
+    rejoin."""
+
+    def __init__(self, n_slots: int, state_dir: str):
+        self.n_slots = int(n_slots)
+        self.state_dir = state_dir
+        self._failed_at: Dict[int, float] = {}
+
+    def rejoin_path(self, slot: int) -> str:
+        return os.path.join(self.state_dir, "slot%d.rejoin" % slot)
+
+    def healthy(self) -> List[int]:
+        return [s for s in range(self.n_slots) if s not in self._failed_at]
+
+    def failed(self) -> List[int]:
+        return sorted(self._failed_at)
+
+    def mark_failed(self, slot: int) -> None:
+        self._failed_at.setdefault(int(slot), time.time())
+
+    def restore_all(self) -> None:
+        self._failed_at.clear()
+
+    def poll_rejoin(self) -> List[int]:
+        """Restore (and report) failed slots whose rejoin marker is
+        fresher than the failure; the consumed marker is removed."""
+        restored = []
+        for slot, failed_ts in sorted(self._failed_at.items()):
+            path = self.rejoin_path(slot)
+            try:
+                if os.path.getmtime(path) >= failed_ts - 1.0:
+                    os.unlink(path)
+                    restored.append(slot)
+            except OSError:
+                continue
+        for slot in restored:
+            del self._failed_at[slot]
+        return restored
+
+
+class _Child:
+    """One supervised process + its bookkeeping."""
+
+    def __init__(self, proc: subprocess.Popen, role: str, rank: int,
+                 slot: int, log_path: Optional[str], log_file):
+        self.proc = proc
+        self.role = role
+        self.rank = rank
+        self.slot = slot
+        self.log_path = log_path
+        self._log_file = log_file
+
+    def code(self) -> Optional[int]:
+        return _norm_code(self.proc.poll())
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def close_log(self) -> None:
+        if self._log_file is not None:
+            try:
+                self._log_file.close()
+            except OSError:
+                pass
+            self._log_file = None
+
+
+class FleetSupervisor:
+    """Launch, watch, drain, reshape, relaunch — see the module
+    docstring for the state machine.
+
+    Parameters
+    ----------
+    worker_cmd : argv for one worker process (every mode).
+    num_workers : the full world size W (slot count).
+    num_servers : PS servers per incarnation (``ps`` mode).
+    mode : ``"ps"`` (scheduler + servers + workers on the DMLC env
+        contract — the ``tools/launch.py`` local plumbing, supervised)
+        or ``"exec"`` (plain rank processes; rank rides
+        ``DMLC_WORKER_ID``/``DMLC_NUM_WORKER`` so ``_rank_info`` and
+        the heartbeat files agree).
+    state_dir : supervisor scratch — heartbeat files (``hb/``), rejoin
+        markers, per-generation child logs and the events journal.
+    ckpt_dir : the fleet's shared checkpoint directory; exported as
+        ``MXNET_CKPT_DIR`` and consulted for the newest COMPLETE step
+        (the resume point recorded in events and handed to chaos as
+        ``ckpt_step``).
+    max_restarts / backoff_s / rejoin_s / heartbeat_timeout_s :
+        env-knob overrides (None reads MXNET_ELASTIC_*).
+    drain_s : how long SIGTERMed survivors get to checkpoint-and-83
+        before SIGKILL.
+    env : extra env for every child.
+    jitter : disable for deterministic backoff in tests.
+    """
+
+    def __init__(self, worker_cmd: Sequence[str], num_workers: int,
+                 num_servers: int = 1, mode: str = "ps",
+                 state_dir: str = "elastic_state",
+                 ckpt_dir: Optional[str] = None,
+                 max_restarts: Optional[int] = None,
+                 backoff_s: Optional[float] = None,
+                 rejoin_s: Optional[float] = None,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 drain_s: float = 10.0,
+                 monitor_interval_s: float = 0.1,
+                 env: Optional[Dict[str, str]] = None,
+                 jitter: bool = True):
+        if mode not in ("ps", "exec"):
+            raise ValueError("mode must be 'ps' or 'exec', got %r" % mode)
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.worker_cmd = list(worker_cmd)
+        self.num_workers = int(num_workers)
+        self.num_servers = int(num_servers)
+        self.mode = mode
+        self.state_dir = os.path.abspath(state_dir)
+        self.ckpt_dir = ckpt_dir
+        self.max_restarts = _env.get_int("MXNET_ELASTIC_MAX_RESTARTS") \
+            if max_restarts is None else int(max_restarts)
+        self.backoff_s = _env.get_float("MXNET_ELASTIC_BACKOFF_S") \
+            if backoff_s is None else float(backoff_s)
+        self.rejoin_s = _env.get_float("MXNET_ELASTIC_REJOIN_S") \
+            if rejoin_s is None else float(rejoin_s)
+        self.heartbeat_timeout_s = \
+            _env.get_float("MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S") \
+            if heartbeat_timeout_s is None else float(heartbeat_timeout_s)
+        self.drain_s = float(drain_s)
+        self.monitor_interval_s = float(monitor_interval_s)
+        self.extra_env = dict(env or {})
+        self.jitter = bool(jitter)
+        os.makedirs(self.state_dir, exist_ok=True)
+        self.hb_dir = os.path.join(self.state_dir, "hb")
+        self.slots = SlotBoard(self.num_workers, self.state_dir)
+        self.generation = 0
+        self.restarts = 0
+        self.events: List[dict] = []
+        self._workers: List[_Child] = []
+        self._daemons: List[_Child] = []
+
+    # -- events journal -------------------------------------------------
+    @property
+    def events_path(self) -> str:
+        return os.path.join(self.state_dir, "supervisor_events.json")
+
+    def _event(self, kind: str, **fields) -> None:
+        ev = {"ts": time.time(), "generation": self.generation,
+              "kind": kind}
+        ev.update(fields)
+        self.events.append(ev)
+        _log.info("elastic: %s %s", kind,
+                  {k: v for k, v in fields.items()})
+        payload = {"elastic_supervisor": True, "version": 1,
+                   "num_slots": self.num_workers,
+                   "events": self.events}
+        tmp = self.events_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.events_path)
+        except OSError:
+            pass  # journaling must never take the supervisor down
+
+    def _metric_restart(self, reason: str) -> None:
+        try:
+            from .. import diagnostics as _diag
+
+            _diag.metrics.counter(
+                "mxnet_elastic_restarts_total",
+                help="fleet relaunches by the elastic supervisor",
+                labels={"reason": reason}).inc()
+            _diag.metrics.gauge(
+                "mxnet_elastic_generation",
+                help="current fleet incarnation").set(self.generation)
+        except Exception:
+            pass
+
+    # -- checkpoint frontier --------------------------------------------
+    def newest_resumable_step(self) -> Optional[int]:
+        """The newest COMPLETE checkpoint step (the resume point;
+        verification happens at load, with fallback past corrupt
+        steps).  Judged against the ORIGINAL world size for legacy
+        steps — manifested steps are self-describing."""
+        if not self.ckpt_dir or not os.path.isdir(self.ckpt_dir):
+            return None
+        from .. import checkpoint as _ckpt
+
+        try:
+            return _ckpt.latest_step(self.ckpt_dir,
+                                     num_ranks=self.num_workers)
+        except Exception:
+            return None
+
+    # -- launch ---------------------------------------------------------
+    def _child_env(self, world: List[int]) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env.update(_dist.elastic_env(self.generation, self.hb_dir))
+        if self.ckpt_dir:
+            env["MXNET_CKPT_DIR"] = self.ckpt_dir
+        # per-generation dump dir: gen 1's flight dumps must not
+        # clobber gen 0's evidence (--health groups them by header)
+        base_dump = env.get("MXNET_DUMP_DIR") or self.state_dir
+        env["MXNET_DUMP_DIR"] = os.path.join(
+            base_dump, "gen%d" % self.generation)
+        if self.mode == "ps":
+            env.update({
+                "DMLC_PS_ROOT_URI": "127.0.0.1",
+                "DMLC_PS_ROOT_PORT": str(_dist.free_port()),
+                "DMLC_NUM_SERVER": str(self.num_servers),
+                "DMLC_NUM_WORKER": str(len(world)),
+            })
+        else:
+            env["DMLC_NUM_WORKER"] = str(len(world))
+        return env
+
+    def _spawn(self, argv: Sequence[str], env: Dict[str, str],
+               role: str, rank: int, slot: int) -> _Child:
+        log_path = os.path.join(
+            self.state_dir, "gen%d" % self.generation,
+            "%s%d.log" % (role, rank))
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        log_file = open(log_path, "ab")
+        proc = subprocess.Popen(list(argv), env=env, stdout=log_file,
+                                stderr=subprocess.STDOUT)
+        return _Child(proc, role, rank, slot, log_path, log_file)
+
+    def _launch(self) -> None:
+        world = self.slots.healthy()
+        env = self._child_env(world)
+        self._workers = []
+        self._daemons = []
+        # clear the PREVIOUS incarnation's heartbeat files: a stale
+        # mtime surviving the restart would read as "hung" before the
+        # new worker (jax init takes seconds) ever beats, and the
+        # supervisor would SIGKILL a healthy child every generation
+        try:
+            for name in os.listdir(self.hb_dir):
+                if name.startswith("hb_rank"):
+                    os.unlink(os.path.join(self.hb_dir, name))
+        except OSError:
+            pass
+        if self.mode == "ps":
+            server_argv = [sys.executable, "-c",
+                           "import mxnet_tpu.kvstore_server as s; "
+                           "s.init()"]
+            e = dict(env, DMLC_ROLE="scheduler")
+            self._daemons.append(self._spawn(server_argv, e,
+                                             "scheduler", 0, -1))
+            for i in range(self.num_servers):
+                e = dict(env, DMLC_ROLE="server")
+                self._daemons.append(self._spawn(server_argv, e,
+                                                 "server", i, -1))
+        for rank, slot in enumerate(world):
+            e = dict(env, DMLC_WORKER_ID=str(rank))
+            if self.mode == "ps":
+                e["DMLC_ROLE"] = "worker"
+            self._workers.append(self._spawn(self.worker_cmd, e,
+                                             "worker", rank, slot))
+        self._event("launch", world_size=len(world), slots=world,
+                    resume_step=self.newest_resumable_step(),
+                    mode=self.mode)
+        try:
+            from .. import diagnostics as _diag
+
+            _diag.metrics.gauge(
+                "mxnet_elastic_world_size",
+                help="workers in the current incarnation"
+            ).set(len(world))
+        except Exception:
+            pass
+
+    # -- teardown helpers -----------------------------------------------
+    def _signal(self, child: _Child, sig: int) -> None:
+        try:
+            child.proc.send_signal(sig)
+        except OSError:
+            pass
+
+    def _reap(self, children: List[_Child], timeout_s: float) -> None:
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        for c in children:
+            while c.alive() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if c.alive():
+                self._signal(c, signal.SIGKILL)
+                try:
+                    c.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            c.close_log()
+
+    def _stop_daemons(self) -> None:
+        for d in self._daemons:
+            if d.alive():
+                self._signal(d, signal.SIGTERM)
+        self._reap(self._daemons, 5.0)
+        self._daemons = []
+
+    def _drain_survivors(self) -> Dict[int, Optional[int]]:
+        """SIGTERM every live worker (they dump, checkpoint, exit 83),
+        wait out the drain budget, SIGKILL stragglers.  Returns
+        {rank: exit_code}."""
+        live = [w for w in self._workers if w.alive()]
+        for w in live:
+            self._signal(w, signal.SIGTERM)
+        self._reap(live, self.drain_s)
+        return {w.rank: w.code() for w in live}
+
+    def kill_all(self) -> None:
+        """Emergency teardown (supervisor crashed / interrupted)."""
+        for c in self._workers + self._daemons:
+            if c.alive():
+                self._signal(c, signal.SIGKILL)
+        self._reap(self._workers + self._daemons, 5.0)
+
+    # -- liveness checks ------------------------------------------------
+    def _stale_heartbeats(self) -> List[_Child]:
+        if self.heartbeat_timeout_s <= 0:
+            return []
+        now = time.time()
+        stale = []
+        for w in self._workers:
+            if not w.alive():
+                continue
+            path = os.path.join(self.hb_dir, "hb_rank%d" % w.rank)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue  # never beat: workload may not emit heartbeats
+            if age > self.heartbeat_timeout_s:
+                stale.append(w)
+        return stale
+
+    def _maybe_chaos_kill(self, tick: int) -> None:
+        from .. import chaos as _chaos
+
+        if not _chaos.enabled():
+            return
+        if not any(r.kind == "kill_rank" for r in _chaos.rules()):
+            # other chaos kinds belong to the children; don't pay the
+            # per-tick checkpoint-directory walk for them
+            return
+        step = self.newest_resumable_step()
+        for w in self._workers:
+            if w.alive() and _chaos.should_kill_rank(
+                    w.rank, tick=tick,
+                    ckpt_step=-1 if step is None else step):
+                self._event("chaos_kill", rank=w.rank, slot=w.slot,
+                            ckpt_step=step)
+                self._signal(w, signal.SIGKILL)
+
+    # -- the state machine ----------------------------------------------
+    def run(self) -> int:
+        """Supervise until the fleet finishes (0), the restart budget
+        is exhausted (EXIT_RESTART_BUDGET=86), or every slot is gone."""
+        os.makedirs(self.hb_dir, exist_ok=True)
+        try:
+            while True:
+                self._launch()
+                outcome = self._monitor()
+                if outcome == "done":
+                    self._stop_daemons()
+                    self._event("fleet_done",
+                                restarts=self.restarts)
+                    return 0
+                # failure: outcome is the classified reason
+                rc = self._handle_failure(outcome)
+                if rc is not None:
+                    return rc
+        finally:
+            self.kill_all()
+
+    def _monitor(self) -> str:
+        """Watch one incarnation.  Returns "done" (every worker exited
+        0) or the classified failure reason."""
+        tick = 0
+        while True:
+            tick += 1
+            time.sleep(self.monitor_interval_s)
+            self._maybe_chaos_kill(tick)
+            for w in self._stale_heartbeats():
+                self._event("worker_hung", rank=w.rank, slot=w.slot,
+                            heartbeat_timeout_s=self.heartbeat_timeout_s)
+                self._signal(w, signal.SIGKILL)
+                try:
+                    w.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    continue
+                w._hung = True
+            failed = [w for w in self._workers
+                      if not w.alive() and w.code() != 0]
+            if failed:
+                first = failed[0]
+                reason = "hung" if getattr(first, "_hung", False) \
+                    else classify_exit(first.code())
+                for w in failed:
+                    self._event("worker_exit", rank=w.rank, slot=w.slot,
+                                exit_code=w.code(),
+                                reason="hung"
+                                if getattr(w, "_hung", False)
+                                else classify_exit(w.code()))
+                    w.close_log()
+                return reason
+            if all(not w.alive() for w in self._workers):
+                for w in self._workers:
+                    self._event("worker_exit", rank=w.rank, slot=w.slot,
+                                exit_code=w.code(), reason="ok")
+                    w.close_log()
+                return "done"
+
+    def _handle_failure(self, reason: str) -> Optional[int]:
+        """Drain, account, reshape/rejoin, backoff.  Returns an exit
+        code to give up with, or None to relaunch."""
+        failed_slots = [w.slot for w in self._workers
+                        if not w.alive() and w.code() != 0]
+        survivor_codes = self._drain_survivors()
+        self._stop_daemons()
+        for w in self._workers:
+            w.close_log()
+        self._event("fleet_down", reason=reason,
+                    failed_slots=failed_slots,
+                    survivor_codes={str(k): v
+                                    for k, v in survivor_codes.items()},
+                    resume_step=self.newest_resumable_step())
+        self.restarts += 1
+        if self.restarts > self.max_restarts:
+            self._event("budget_exhausted", restarts=self.restarts,
+                        max_restarts=self.max_restarts)
+            _log.error(
+                "elastic: restart budget exhausted (%d restarts, "
+                "budget %d) — exiting %d",
+                self.restarts, self.max_restarts, EXIT_RESTART_BUDGET)
+            return EXIT_RESTART_BUDGET
+        # a diverged run is a TRAINING failure, not a node failure:
+        # restart the same world from the last verified checkpoint
+        if reason not in ("diverged",):
+            for slot in failed_slots:
+                self.slots.mark_failed(slot)
+        # bounded rejoin window: a failed slot whose marker shows up in
+        # time rejoins, restoring W; otherwise reshape to survivors
+        rejoined: List[int] = []
+        if self.slots.failed() and self.rejoin_s > 0:
+            deadline = time.monotonic() + self.rejoin_s
+            while time.monotonic() < deadline:
+                rejoined.extend(self.slots.poll_rejoin())
+                if not self.slots.failed():
+                    break
+                time.sleep(min(self.monitor_interval_s, 0.1))
+        if rejoined:
+            self._event("slots_rejoined", slots=sorted(rejoined))
+        if not self.slots.healthy():
+            # every slot failed: there is no W' to shrink to — restore
+            # them all and retry at full W (a local crash loop lands
+            # here; the restart budget still bounds it)
+            self._event("all_slots_failed_restoring",
+                        slots=self.slots.failed())
+            self.slots.restore_all()
+        delay = backoff_delay(self.restarts - 1, self.backoff_s,
+                              jitter=self.jitter)
+        self._event("backoff", seconds=round(delay, 3),
+                    restart=self.restarts)
+        time.sleep(delay)
+        self.generation += 1
+        self._metric_restart(reason)
+        new_world = self.slots.healthy()
+        _log.warning(
+            "elastic: restarting as generation %d at W=%d (reason %s, "
+            "restart %d/%d, resume step %s)",
+            self.generation, len(new_world), reason, self.restarts,
+            self.max_restarts, self.newest_resumable_step())
+        return None

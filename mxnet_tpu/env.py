@@ -259,8 +259,8 @@ register("MXNET_CHAOS", "str", None,
          "Fault-injection spec: semicolon-separated rules "
          "'kind:k=v,k=v' with kinds drop_push / delay_collective / "
          "kill / nan_grad / slow_request / fail_execute / "
-         "corrupt_shard / bad_version (see mxnet_tpu/chaos.py).  "
-         "Unset disables all injection.")
+         "corrupt_shard / bad_version / slow_decode / kill_rank "
+         "(see mxnet_tpu/chaos.py).  Unset disables all injection.")
 
 # module — non-finite gradient guard
 register("MXNET_SKIP_NONFINITE_GRADS", "bool", False,
@@ -268,6 +268,56 @@ register("MXNET_SKIP_NONFINITE_GRADS", "bool", False,
          "and skip the step (counting "
          "mxnet_training_skipped_steps_total) instead of poisoning "
          "the fleet.  Costs one host sync per step; off by default.")
+
+# diagnostics.py — loss-spike divergence guard (the nonfinite guard's
+# big sibling: a FINITE loss that exploded is garbage too)
+register("MXNET_DIVERGENCE_WINDOW", "int", 0,
+         "Loss-spike detector window (steps): once the window is "
+         "full, a loss exceeding median + factor x |median| (or going "
+         "non-finite) trips the divergence guard — under the elastic "
+         "supervisor the run exits EXIT_DIVERGED=84 and is restored "
+         "from the last VERIFIED checkpoint instead of training "
+         "through garbage.  0 disables.")
+register("MXNET_DIVERGENCE_FACTOR", "float", 3.0,
+         "Divergence threshold: loss > window median + factor x "
+         "|median| trips the guard (scale-relative above and below "
+         "zero; see MXNET_DIVERGENCE_WINDOW).")
+
+# elastic/ — fleet supervisor (failure detection -> mesh reshape ->
+# resume at the new world size)
+register("MXNET_ELASTIC_MAX_RESTARTS", "int", 3,
+         "Restart budget for the elastic supervisor: fleet relaunches "
+         "allowed before it gives up and exits "
+         "EXIT_RESTART_BUDGET=86.")
+register("MXNET_ELASTIC_BACKOFF_S", "float", 1.0,
+         "Initial supervisor restart backoff (s); doubles per "
+         "consecutive restart with +-50% jitter (the _ps.py retry "
+         "discipline applied to whole-fleet relaunches).")
+register("MXNET_ELASTIC_REJOIN_S", "float", 0.0,
+         "Bounded rejoin window (s): after a failure the supervisor "
+         "waits this long for the failed slot's rejoin marker "
+         "(slot{K}.rejoin in the supervisor state dir) before "
+         "reshaping to W' = survivors; a slot that rejoins in time "
+         "restores the full W.  0 reshapes immediately.")
+register("MXNET_ELASTIC_GENERATION", "int", 0,
+         "Fleet incarnation counter, exported by the supervisor to "
+         "every child: stamped into flight-recorder headers and "
+         "checkpoint sidecars/manifests so merge_traces --health "
+         "attributes dumps to the right incarnation.")
+register("MXNET_ELASTIC_SUPERVISED", "bool", False,
+         "Set by the elastic supervisor on its children: failure "
+         "paths that would otherwise need an operator (divergence "
+         "guard) may exit with a restartable code instead.")
+register("MXNET_ELASTIC_HEARTBEAT_DIR", "str", None,
+         "Directory of per-rank heartbeat files (hb_rank{K}) the "
+         "supervisor watches for hung-worker detection; set by the "
+         "supervisor, touched by diagnostics.touch_heartbeat from the "
+         "fit loops and the PS heartbeat thread.")
+register("MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S", "float", 0.0,
+         "A worker whose heartbeat file is staler than this is "
+         "declared hung and SIGKILLed by the supervisor (restart "
+         "follows the normal failure path).  0 disables hung "
+         "detection (exit codes still supervise).")
 
 # checkpoint.py — elastic checkpoint/resume (fault tolerance)
 register("MXNET_CKPT_DIR", "str", None,

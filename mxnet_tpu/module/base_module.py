@@ -324,11 +324,29 @@ class BaseModule:
                                                    "batch_size", None)},
                          blocking=blocking)
 
+        def _preempt_save() -> None:
+            # a preemption landing right after a periodic boundary
+            # save must NOT re-write that step's shard: the bytes
+            # would differ (iterator position moved) while the step's
+            # assembled manifest still records the boundary digests —
+            # the resume would then reject the step as corrupt.  The
+            # boundary save may still be in flight on the ASYNC writer
+            # though (last_save is set when the write is enqueued) —
+            # wait it out, and only skip once the shard really landed;
+            # a write that errored or never finishes falls through to
+            # a blocking re-save (the manifest re-assembles its digest).
+            if progress["step"] == progress.get("last_save", -1):
+                try:
+                    if manager.wait(timeout=60):
+                        return
+                except Exception:
+                    pass
+            _save_checkpoint(blocking=True)
+
         hook_key = None
         if manager is not None:
             hook_key = _diag.register_preemption_hook(
-                lambda: _save_checkpoint(blocking=True),
-                key="module_fit_%d" % id(self))
+                _preempt_save, key="module_fit_%d" % id(self))
 
         try:
             self._fit_epochs(

@@ -573,6 +573,34 @@ def unpack_flat(flat, ref_arrays):
     return out
 
 
+def pack_flat_np(arrays):
+    """Host-side (numpy) sibling of :func:`pack_flat` — the elastic
+    restage path repacks checkpointed momenta on the host, before any
+    device placement."""
+    import numpy as np
+
+    if len(arrays) == 1:
+        return np.asarray(arrays[0]).ravel()
+    return np.concatenate([np.asarray(a).ravel() for a in arrays])
+
+
+def unpack_flat_np(flat, shapes):
+    """Host-side :func:`unpack_flat` over explicit ``shapes`` (the
+    restage path has shapes, not live ref arrays)."""
+    import numpy as np
+
+    flat = np.asarray(flat)
+    out = []
+    off = 0
+    for shape in shapes:
+        sz = 1
+        for d in shape:
+            sz *= int(d)
+        out.append(flat[off:off + sz].reshape(tuple(shape)))
+        off += sz
+    return out
+
+
 def fused_sgd_mom_flat(flat_w, flat_g, flat_m, lr, momentum, wd):
     """SGD-with-momentum over flat buffers: the one-op multi-tensor
     update.  Identical elementwise math to the per-key path

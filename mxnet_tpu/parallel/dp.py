@@ -183,6 +183,94 @@ def zero1_momentum_buffers(plan, n: int):
     return bufs
 
 
+def zero1_bucket_elems(plan) -> list:
+    """True (unpadded) element count of each bucket's flat buffer —
+    the invariant the elastic restage re-slices by: padding depends on
+    the dp size, the element count only on the bucket layout."""
+    return [int(b.nbytes) // _dtype_itemsize(b.dtype) for b in plan]
+
+
+def zero1_restage_flats(flats, plan, n_new: int):
+    """Re-slice checkpointed GLOBAL flat momentum buffers for an
+    ``n_new``-way dp axis (host numpy, before device placement): trim
+    each bucket's flat to its true element count (dropping the old dp
+    size's zero padding — the pad zone's momenta are zero by
+    construction, gradients there are always zero) and re-pad to a
+    multiple of ``n_new``.  Identity when the dp size is unchanged, so
+    the bitwise same-world resume contract is untouched."""
+    import numpy as np
+
+    if len(flats) != len(plan):
+        raise ValueError(
+            "checkpoint has %d momentum buckets, this plan has %d — "
+            "bucket caps changed between runs; pin bucket_bytes (or "
+            "the same autotune plan) to resume"
+            % (len(flats), len(plan)))
+    out = []
+    for bi, (flat, elems) in enumerate(zip(flats,
+                                           zero1_bucket_elems(plan))):
+        # host-side restage over checkpointed numpy blobs — no device
+        # transfer hides here
+        flat = np.asarray(flat).ravel()  # mxlint: disable=MXL004
+        if flat.size < elems:
+            raise ValueError(
+                "momentum bucket %d holds %d elements, plan needs %d "
+                "— the bucket LAYOUT changed (not just the dp size); "
+                "elastic restage only re-slices identical bucket "
+                "plans" % (bi, flat.size, elems))
+        flat = flat[:elems]
+        pad = (-elems) % max(int(n_new), 1)
+        if pad:
+            flat = np.pad(flat, (0, pad))
+        out.append(flat)
+    return out
+
+
+def zero1_flats_to_tree(flats, plan, shapes):
+    """Checkpointed stage-1 flat momenta → per-param momenta dict (the
+    dp' = 1 / replicated side of the elastic restage).  ``shapes``
+    maps param key → shape, in the plan's own key universe."""
+    from .. import optimizer as _opt
+
+    if len(flats) != len(plan):
+        raise ValueError(
+            "checkpoint has %d momentum buckets, this plan has %d"
+            % (len(flats), len(plan)))
+    out = {}
+    for flat, bucket in zip(flats, plan):
+        missing = [k for k in bucket.keys if k not in shapes]
+        if missing:
+            raise KeyError("restage: bucket keys %s not in the live "
+                           "param tree" % missing[:4])
+        arrs = _opt.unpack_flat_np(flat, [shapes[k]
+                                          for k in bucket.keys])
+        for k, a in zip(bucket.keys, arrs):
+            out[k] = a
+    return out
+
+
+def zero1_tree_to_flats(tree, plan, n: int):
+    """Per-param momenta dict → stage-1 GLOBAL flat buffers padded for
+    an ``n``-way dp axis (the replicated → sharded side of the elastic
+    restage); same packing order the in-graph update uses."""
+    import numpy as np
+
+    from .. import optimizer as _opt
+
+    flats = []
+    for bucket in plan:
+        missing = [k for k in bucket.keys if k not in tree]
+        if missing:
+            raise KeyError("restage: checkpoint momenta missing keys "
+                           "%s" % missing[:4])
+        flat = _opt.pack_flat_np([tree[k] for k in bucket.keys])
+        pad = (-flat.size) % max(int(n), 1)
+        if pad:
+            flat = np.pad(flat, (0, pad))
+        flats.append(flat)
+    return flats
+
+
 def zero1_bucketed_update(grads, params, mom_shards, plan,
                           axis_name: str, n: int, *, lr, momentum, wd,
                           mean_n=None, sp_axis=None, chain=None):

@@ -371,6 +371,8 @@ class TransformerTrainStep:
 
         if not self._built:
             self._build()
+        from ..parallel.dp import zero1_bucket_elems
+
         if self._zero1:
             moms = [np.asarray(m) for m in self._moms]
         else:
@@ -378,7 +380,10 @@ class TransformerTrainStep:
         return pickle.dumps({
             "workload": "transformer_lm",
             "zero_stage": 1 if self._zero1 else 0,
+            "dp": self.n_dp,
             "n_buckets": len(self._bucket_plan),
+            # the restage invariant: padding depends on dp, these don't
+            "bucket_elems": zero1_bucket_elems(self._bucket_plan),
             "momenta": moms,
         })
 
@@ -402,27 +407,94 @@ class TransformerTrainStep:
         if not blob:
             return
         state = pickle.loads(blob) if isinstance(blob, bytes) else blob
+        self._restore_momenta(state)
+
+    def _restore_momenta(self, state: dict) -> None:
+        """Momenta from a checkpoint state blob, ELASTICALLY: a
+        stage-1 checkpoint written at one dp resumes at any other —
+        the per-bucket flat buffers are re-sliced by the (identical)
+        bucket layout and re-padded for the new dp; 2→1 lands as the
+        replicated per-param dict, 1→2 packs the dict back into
+        sharded flats.  Same stage + same dp stays the bitwise
+        exact-resume path (the restage transform is the identity
+        there).  A bucket-LAYOUT mismatch (caps changed between runs)
+        still rejects loudly — restage re-slices, it cannot re-bucket."""
+        jax = _jax()
+        import logging
+
+        import numpy as np
+
+        from ..parallel.dp import (zero1_bucket_elems,
+                                   zero1_flats_to_tree,
+                                   zero1_restage_flats,
+                                   zero1_tree_to_flats)
+
         saved_stage = int(state.get("zero_stage", 0))
-        if saved_stage != (1 if self._zero1 else 0):
-            raise ValueError(
-                "checkpoint momenta were written at ZeRO stage %d but "
-                "this step runs stage %d — resume with the same "
-                "MXNET_ZERO_STAGE (elastic restage is not implemented)"
-                % (saved_stage, 1 if self._zero1 else 0))
+        saved_dp = state.get("dp")
+        cur_stage = 1 if self._zero1 else 0
         moms = state["momenta"]
-        if self._zero1:
-            if len(moms) != len(self._moms):
+        plan = self._bucket_plan
+
+        if saved_stage == 1:
+            if len(moms) != len(plan):
                 raise ValueError(
                     "checkpoint has %d momentum buckets, this plan has "
                     "%d — bucket caps changed between runs; pin "
                     "bucket_bytes (or the same autotune plan) to "
-                    "resume" % (len(moms), len(self._moms)))
-            self._moms = [jax.device_put(np.asarray(m), sh)
-                          for m, sh in zip(moms, self._mom_sh)]
-        else:
+                    "resume" % (len(moms), len(plan)))
+            saved_elems = state.get("bucket_elems")
+            if saved_elems is not None and \
+                    list(saved_elems) != zero1_bucket_elems(plan):
+                raise ValueError(
+                    "checkpoint bucket layout %s != this plan's %s — "
+                    "elastic restage re-slices identical bucket plans "
+                    "only; pin bucket_bytes (or the same autotune "
+                    "plan) to resume"
+                    % (list(saved_elems), zero1_bucket_elems(plan)))
+        restaged = saved_stage != cur_stage or \
+            (saved_dp is not None and int(saved_dp) != self.n_dp)
+        if saved_stage == 1 and self._zero1:
+            # flats → flats: trim to the layout's true element counts,
+            # re-pad for THIS dp (identity when the dp is unchanged —
+            # the same-world bitwise contract rides this line)
+            flats = zero1_restage_flats([np.asarray(m) for m in moms],
+                                        plan, self.n_dp)
+            self._moms = [jax.device_put(m, sh)
+                          for m, sh in zip(flats, self._mom_sh)]
+        elif saved_stage == 0 and not self._zero1:
+            missing = [k for k in self._names if k not in moms]
+            if missing:
+                raise KeyError("checkpoint momenta missing params: %s"
+                               % missing[:4])
             self._moms = {k: jax.device_put(np.asarray(moms[k]),
                                             self._rep)
                           for k in self._names}
+        elif saved_stage == 1:
+            # sharded → replicated (e.g. dp=2 stage-1 resuming at
+            # dp=1, where stage 1 degenerates to replicated)
+            shapes = {k: tuple(v.shape)
+                      for k, v in self._params.items()}
+            trimmed = zero1_restage_flats([np.asarray(m) for m in moms],
+                                          plan, 1)
+            tree = zero1_flats_to_tree(trimmed, plan, shapes)
+            self._moms = {k: jax.device_put(np.asarray(tree[k]),
+                                            self._rep)
+                          for k in self._names}
+        else:
+            # replicated → sharded (dp=1 checkpoint resuming at dp>1
+            # with MXNET_ZERO_STAGE=1)
+            tree = {k: np.asarray(v) for k, v in moms.items()}
+            flats = zero1_tree_to_flats(tree, plan, self.n_dp)
+            self._moms = [jax.device_put(m, sh)
+                          for m, sh in zip(flats, self._mom_sh)]
+        if restaged:
+            logging.getLogger(__name__).warning(
+                "ZERO-1 ELASTIC RESTAGE: momenta written at stage %d "
+                "(dp=%s) re-sliced for stage %d (dp=%d) over the same "
+                "%d-bucket layout — per-rank optimizer state is now "
+                "~1/%d of replicated",
+                saved_stage, saved_dp, cur_stage, self.n_dp,
+                len(plan), max(self.n_dp if self._zero1 else 1, 1))
 
     # -- fit loop -------------------------------------------------------
     def fit(self, train_iter, num_steps: int,
@@ -456,6 +528,16 @@ class TransformerTrainStep:
             train_iter.reset()
             skip = int((payload.get("iterator") or {})
                        .get("nbatch", start))
+            if payload.get("elastic"):
+                # W→W' elastic resume: the checkpointed per-rank batch
+                # count is in the OLD fleet's units — the invariant is
+                # the GLOBAL sample position, re-divided by THIS
+                # fleet's per-rank batch x world size
+                # (checkpoint.scale_resume_skip; without this, a
+                # mid-epoch shard resumed at a different W replays or
+                # skips the partial epoch's data)
+                skip = _ckpt.scale_resume_skip(
+                    payload, getattr(train_iter, "batch_size", None))
             if hasattr(train_iter, "skip_batches"):
                 train_iter.skip_batches(skip)
             else:
@@ -464,6 +546,7 @@ class TransformerTrainStep:
                         train_iter.reset()
                         train_iter.iter_next()
         chaos_on = _chaos.enabled()
+        guard = _diag.DivergenceGuard()
         tps = _diag.metrics.gauge(
             "mxnet_transformer_tokens_per_second",
             "transformer fit throughput (tokens/s, this rank)")
@@ -484,6 +567,15 @@ class TransformerTrainStep:
             # interval is host cost, not step time — same truthful-
             # metric stance as the bulk fit path's step timing
             _jax().block_until_ready(loss_dev)  # mxlint: disable=MXL004
+            if guard.enabled and guard.check(float(loss_dev),
+                                             step=step_i + 1):
+                # loss spiked past the windowed threshold: under the
+                # supervisor this exits EXIT_DIVERGED (restore from
+                # the last VERIFIED checkpoint, automatically);
+                # standalone it raises instead of training through
+                # garbage
+                guard.trip(step_i + 1)
+            _diag.touch_heartbeat()
             now = time.monotonic()
             n_tok = int(tokens.shape[0]) * int(tokens.shape[1])
             if now > t_last:
@@ -502,7 +594,15 @@ class TransformerTrainStep:
                 # manager
                 mgr.save(step_i + 1, params=self._params,
                          optimizer_states=self.optimizer_states_bytes(),
-                         iterator_state={"nbatch": step_i + 1},
+                         nbatch=step_i + 1,
+                         iterator_state={
+                             "nbatch": step_i + 1,
+                             "cursor": getattr(train_iter, "cursor",
+                                               None),
+                             # recorded so a W→W' elastic resume can
+                             # re-derive the global sample position
+                             "batch_size": getattr(train_iter,
+                                                   "batch_size", None)},
                          extra={"workload": "transformer_lm"})
         if mgr is not None:
             mgr.wait()

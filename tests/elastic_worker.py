@@ -1,0 +1,73 @@
+"""Supervised elastic-fleet worker (test_elastic.py's e2e payload).
+
+One script for EVERY incarnation: it reads the world size off the
+dist_sync kvstore, shards ONE deterministic global stream by rank with
+the global batch preserved (per-rank batch = GLOBAL_BATCH / W over the
+strided ``num_parts`` slice), and resumes automatically whenever the
+shared checkpoint directory holds a complete step — which is exactly
+what the elastic supervisor's zero-operator-action contract needs: the
+supervisor only relaunches this same command line at W'; the data and
+resume decisions are the worker's own.
+
+Usage: elastic_worker.py <out_prefix> [per-step delay seconds]
+(checkpoint dir rides MXNET_CKPT_DIR, exported by the supervisor).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import sym
+
+GLOBAL_BATCH = 8
+ROWS = 24  # 3 global batches per epoch
+
+
+def mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=8)
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=4)
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def main():
+    out_prefix = sys.argv[1]
+    step_delay = float(sys.argv[2]) if len(sys.argv) > 2 else 0.0
+    kv = mx.kv.create("dist_sync")
+    rank, world = kv.rank, kv.num_workers
+    # ONE seeded global stream, sharded by rank with the global batch
+    # preserved: W=2 ranks each consume 4-row strided slices, the W'=1
+    # survivor consumes the same 8 rows as one batch — summation order
+    # is the only difference (the PR-8 elastic methodology)
+    rng = np.random.RandomState(7)
+    x = rng.randn(ROWS, 6).astype(np.float32)
+    y = (np.arange(ROWS) % 4).astype(np.float32)
+    train = mx.io.NDArrayIter(
+        x, y, batch_size=GLOBAL_BATCH // world, shuffle=False,
+        num_parts=world, part_index=rank)
+    np.random.seed(0)
+    mx.random.seed(0)
+    mod = mx.mod.Module(symbol=mlp(), context=mx.cpu())
+    ckpt_dir = os.environ["MXNET_CKPT_DIR"]
+    resume = ckpt.latest_step(ckpt_dir, num_ranks=world) is not None
+    cb = (lambda _p: time.sleep(step_delay)) if step_delay else None
+    mod.fit(train, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0, "wd": 0.0},
+            num_epoch=2, checkpoint_every_n=2, checkpoint_dir=ckpt_dir,
+            resume_from=ckpt_dir if resume else None,
+            batch_end_callback=cb)
+    args, _ = mod.get_params()
+    np.savez("%s_rank%d.npz" % (out_prefix, rank),
+             **{k: v.asnumpy() for k, v in args.items()})
+    kv.close()
+    print("elastic worker %d/%d done (resumed=%s)"
+          % (rank, world, resume))
+
+
+if __name__ == "__main__":
+    main()

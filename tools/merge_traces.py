@@ -21,8 +21,14 @@ rank completed, which ranks diverge and at exactly which seq/bucket/key
 suspect by the watchdog, heartbeat-declared dead peers (each rank's
 header carries the scheduler's dead_nodes answer), bucket-plan
 mismatches between ranks, and per-rank step-time distributions with
-slowest-rank / p50-vs-p99 straggler flags.  Exit code 2 when a desync,
-dead peer or plan mismatch was detected.
+slowest-rank / p50-vs-p99 straggler flags.  Dumps from an elastic
+supervisor run are grouped by the header's generation counter and —
+together with the supervisor's ``supervisor_events.json`` journal —
+rendered as the RESTART TIMELINE ("gen 0: W=2, reached seq 12, rank 1
+killed (exit 137); gen 1: W=1, resumed from step 4, completed"); the
+desync/dead-peer verdict judges the NEWEST incarnation.  Exit code 2
+when a desync, dead peer, plan mismatch or exhausted restart budget
+was detected.
 
 Usage:
     tools/merge_traces.py profile_rank0.json profile_rank1.json -o merged.json
@@ -91,27 +97,55 @@ def is_flight_payload(payload: dict) -> bool:
                 and payload.get("header", {}).get("flight_recorder"))
 
 
-def load_health_inputs(paths):
-    """Split input files into ({rank: flight_payload},
-    {rank: trace_payload}) — the two dump families are distinguished by
-    content, so one glob can feed both."""
-    flight, traces = {}, {}
+def is_supervisor_payload(payload: dict) -> bool:
+    """The elastic supervisor's events journal
+    (mxnet_tpu/elastic/supervisor.py supervisor_events.json) —
+    content-classified like the other dump families."""
+    return bool(isinstance(payload, dict)
+                and payload.get("elastic_supervisor"))
+
+
+def load_health_inputs_ex(paths):
+    """Split input files into ``(flight_by_gen, traces, supervisor)``:
+    ``flight_by_gen`` maps generation → {rank: flight_payload} (an
+    elastic supervisor restarts the fleet with a bumped
+    MXNET_ELASTIC_GENERATION, so the SAME rank dumps once per
+    incarnation — duplicates are only an error within one generation),
+    ``traces`` maps rank → trace payload, ``supervisor`` is the
+    supervisor's events journal (or None)."""
+    flight_by_gen, traces = {}, {}
+    supervisor = None
     for idx, p in enumerate(paths):
         with open(p) as f:
             payload = json.load(f)
-        if is_flight_payload(payload):
+        if is_supervisor_payload(payload):
+            supervisor = payload
+        elif is_flight_payload(payload):
             rank = int(payload["header"].get(
                 "rank", rank_of(p, {}, idx)))
-            if rank in flight:
-                raise ValueError("duplicate flight-recorder rank %d (%s)"
-                                 % (rank, p))
-            flight[rank] = payload
+            gen = int(payload["header"].get("generation", 0) or 0)
+            by_rank = flight_by_gen.setdefault(gen, {})
+            if rank in by_rank:
+                raise ValueError(
+                    "duplicate flight-recorder rank %d in generation "
+                    "%d (%s)" % (rank, gen, p))
+            by_rank[rank] = payload
         else:
             rank = rank_of(p, payload, idx)
             if rank in traces:
                 raise ValueError("duplicate trace rank %d (%s)" % (rank, p))
             traces[rank] = payload
-    return flight, traces
+    return flight_by_gen, traces, supervisor
+
+
+def load_health_inputs(paths):
+    """Compatibility surface: ({rank: flight_payload} for the NEWEST
+    generation, {rank: trace_payload}).  Single-generation inputs (no
+    supervisor in play) behave exactly as before."""
+    flight_by_gen, traces, _sup = load_health_inputs_ex(paths)
+    newest = max(flight_by_gen) if flight_by_gen else None
+    return (flight_by_gen.get(newest, {}) if newest is not None
+            else {}), traces
 
 
 def _pct(sorted_vals, q):
@@ -397,12 +431,69 @@ def run_bucket_timings(paths, out_path=None) -> int:
     return 0
 
 
-def health_report(flight, traces):
+def analyze_generations(flight_by_gen, supervisor):
+    """The elastic restart timeline: one row per fleet incarnation,
+    assembled from the supervisor's events journal (world size, resume
+    step, who died with what code) corroborated by the per-generation
+    flight dumps (how far each incarnation's collectives got)."""
+    gens = {}
+
+    def row(gen):
+        return gens.setdefault(int(gen), {
+            "world_size": None, "resume_step": None,
+            "failures": [], "reason": None, "outcome": None,
+            "ranks_dumped": [], "max_completed_seq": None,
+            "dead_peers": [],
+        })
+
+    for gen, by_rank in sorted((flight_by_gen or {}).items()):
+        r = row(gen)
+        r["ranks_dumped"] = sorted(by_rank)
+        desync = analyze_desync(by_rank)
+        r["max_completed_seq"] = desync.get("max_completed_seq")
+        r["dead_peers"] = sorted(
+            analyze_dead_peers(by_rank)["peers"])
+    n_restarts = None
+    exhausted = False
+    for ev in (supervisor or {}).get("events", []):
+        r = row(ev.get("generation", 0))
+        kind = ev.get("kind")
+        if kind == "launch":
+            r["world_size"] = ev.get("world_size")
+            r["resume_step"] = ev.get("resume_step")
+        elif kind in ("worker_exit", "chaos_kill", "worker_hung"):
+            if kind == "worker_exit" and ev.get("reason") == "ok":
+                continue
+            r["failures"].append(
+                {"rank": ev.get("rank"), "kind": kind,
+                 "exit_code": ev.get("exit_code"),
+                 "reason": ev.get("reason")})
+        elif kind == "fleet_down":
+            r["reason"] = ev.get("reason")
+            r["outcome"] = "down"
+        elif kind == "fleet_done":
+            r["outcome"] = "done"
+            n_restarts = ev.get("restarts", n_restarts)
+        elif kind == "budget_exhausted":
+            r["outcome"] = "budget_exhausted"
+            exhausted = True
+    return {"n_generations": len(gens),
+            "restarted": len(gens) > 1,
+            "n_restarts": n_restarts,
+            "budget_exhausted": exhausted,
+            "generations": {str(g): gens[g] for g in sorted(gens)}}
+
+
+def health_report(flight, traces, flight_by_gen=None, supervisor=None):
     report = {"n_flight_dumps": len(flight), "n_trace_dumps": len(traces),
               "desync": analyze_desync(flight)}
     if flight:
         report["bucket_plans"] = analyze_bucket_plans(flight)
         report["dead_peers"] = analyze_dead_peers(flight)
+    multi_gen = flight_by_gen and len(flight_by_gen) > 1
+    if supervisor is not None or multi_gen:
+        report["elastic"] = analyze_generations(flight_by_gen,
+                                                supervisor)
     stragglers = analyze_stragglers(traces)
     if stragglers is not None:
         report["stragglers"] = stragglers
@@ -412,9 +503,45 @@ def health_report(flight, traces):
     return report
 
 
+def format_elastic(elastic):
+    """The restart timeline — "gen 0 died at seq 12 (rank 1 killed);
+    gen 1 resumed at W=1 from step 4"."""
+    lines = ["RESTART TIMELINE: %d generation(s)%s"
+             % (elastic["n_generations"],
+                " — RESTART BUDGET EXHAUSTED"
+                if elastic.get("budget_exhausted") else "")]
+    for g, r in sorted(elastic["generations"].items(),
+                       key=lambda kv: int(kv[0])):
+        bits = []
+        if r.get("world_size") is not None:
+            bits.append("W=%d" % r["world_size"])
+        if r.get("resume_step") is not None:
+            bits.append("resumed from step %s" % r["resume_step"])
+        if r.get("max_completed_seq") is not None:
+            bits.append("reached seq %d" % r["max_completed_seq"])
+        for f in r.get("failures", []):
+            code = f.get("exit_code")
+            bits.append("rank %s %s%s"
+                        % (f.get("rank"),
+                           f.get("reason") or f.get("kind"),
+                           "" if code is None else " (exit %s)" % code))
+        for peer in r.get("dead_peers", []):
+            bits.append("dead peer %s" % peer)
+        if r.get("outcome") == "down":
+            bits.append("died (%s)" % (r.get("reason") or "?"))
+        elif r.get("outcome") == "done":
+            bits.append("completed")
+        elif r.get("outcome") == "budget_exhausted":
+            bits.append("gave up (restart budget)")
+        lines.append("  gen %s: %s" % (g, ", ".join(bits) or "no data"))
+    return lines
+
+
 def format_health(report):
     """Human-readable lines — the "rank 1 never entered seq 12" view."""
     lines = []
+    if report.get("elastic"):
+        lines.extend(format_elastic(report["elastic"]))
     desync = report["desync"]
     for rank, info in sorted(desync.get("ranks", {}).items()):
         lines.append(
@@ -485,8 +612,13 @@ def format_health(report):
 
 
 def run_health(paths, out_path=None) -> int:
-    flight, traces = load_health_inputs(paths)
-    report = health_report(flight, traces)
+    flight_by_gen, traces, supervisor = load_health_inputs_ex(paths)
+    # desync/dead-peer/plan analysis judges the NEWEST incarnation —
+    # cross-generation seq comparison is meaningless by construction
+    newest = max(flight_by_gen) if flight_by_gen else None
+    flight = flight_by_gen.get(newest, {}) if newest is not None else {}
+    report = health_report(flight, traces, flight_by_gen=flight_by_gen,
+                           supervisor=supervisor)
     for line in format_health(report):
         print(line)
     if out_path:
@@ -496,10 +628,14 @@ def run_health(paths, out_path=None) -> int:
     # bucket-plan mismatch is a desync by construction, and a
     # heartbeat-declared dead peer is a fleet failure even when the
     # dead rank left no dump to diverge from — same exit contract as a
-    # seq divergence so script consumers catch all three
+    # seq divergence so script consumers catch all three.  The checks
+    # judge the NEWEST incarnation: a fleet the supervisor already
+    # restarted healthy IS healthy (the timeline still tells the
+    # story) — unless the supervisor itself gave up (budget).
     unhealthy = report["desync"].get("detected") or \
         report.get("bucket_plans", {}).get("mismatch") or \
-        report.get("dead_peers", {}).get("detected")
+        report.get("dead_peers", {}).get("detected") or \
+        report.get("elastic", {}).get("budget_exhausted")
     return 2 if unhealthy else 0
 
 
@@ -647,6 +783,88 @@ def self_test() -> int:
             tm = _at_timing.from_bucket_timings(bt, path=bt_out)
             assert tm.n_units == 3 and tm.total_bytes == 3072
             assert tm.recorded_cap_bytes == 4 << 20
+
+        # --health with generations: gen 0's fleet died (rank 1
+        # killed at seq 12), the supervisor reshaped 2->1 and gen 1
+        # completed — one glob over both incarnations' dumps + the
+        # supervisor journal yields the restart timeline, and the
+        # health verdict judges the NEWEST (healthy) incarnation
+        gen_dir = os.path.join(d, "gens")
+        os.makedirs(gen_dir)
+
+        def gen_flight(gen, rank, n_done, dead=None):
+            payload = {"header": {"flight_recorder": True, "rank": rank,
+                                  "num_workers": 2 - gen,
+                                  "generation": gen,
+                                  "capacity": 256, "next_seq": n_done,
+                                  "dropped": 0,
+                                  "dead_peers": list(dead or []),
+                                  "bucket_plan": None},
+                       "entries": [
+                           {"seq": s, "op": "bucket_reduce",
+                            "bucket": 0, "keys": ["w"], "bytes": 64,
+                            "dtype": "float32",
+                            "enqueue_ts": float(s),
+                            "complete_ts": s + 0.5,
+                            "state": "completed"}
+                           for s in range(n_done)]}
+            p = os.path.join(gen_dir, "g%d_flightrecorder_rank%d.json"
+                             % (gen, rank))
+            with open(p, "w") as f:
+                json.dump(payload, f)
+            return p
+
+        g0a = gen_flight(0, 0, 13, dead=["worker:1"])
+        g0b = gen_flight(0, 1, 12)
+        g1a = gen_flight(1, 0, 40)
+        sup_events = {
+            "elastic_supervisor": True, "version": 1, "num_slots": 2,
+            "events": [
+                {"ts": 1.0, "generation": 0, "kind": "launch",
+                 "world_size": 2, "slots": [0, 1], "resume_step": None},
+                {"ts": 2.0, "generation": 0, "kind": "worker_exit",
+                 "rank": 1, "slot": 1, "exit_code": 137,
+                 "reason": "killed"},
+                {"ts": 2.1, "generation": 0, "kind": "fleet_down",
+                 "reason": "killed", "failed_slots": [1],
+                 "resume_step": 4},
+                {"ts": 3.0, "generation": 1, "kind": "launch",
+                 "world_size": 1, "slots": [0], "resume_step": 4},
+                {"ts": 4.0, "generation": 1, "kind": "worker_exit",
+                 "rank": 0, "slot": 0, "exit_code": 0, "reason": "ok"},
+                {"ts": 4.1, "generation": 1, "kind": "fleet_done",
+                 "restarts": 1},
+            ]}
+        sup_path = os.path.join(gen_dir, "supervisor_events.json")
+        with open(sup_path, "w") as f:
+            json.dump(sup_events, f)
+        fbg, tr, sup = load_health_inputs_ex([g0a, g0b, g1a, sup_path])
+        assert set(fbg) == {0, 1} and set(fbg[0]) == {0, 1} \
+            and set(fbg[1]) == {0}, fbg
+        assert sup is not None and not tr
+        report = health_report(fbg[1], tr, flight_by_gen=fbg,
+                               supervisor=sup)
+        el = report["elastic"]
+        assert el["n_generations"] == 2 and el["restarted"], el
+        assert el["n_restarts"] == 1 and not el["budget_exhausted"]
+        g0 = el["generations"]["0"]
+        assert g0["world_size"] == 2 and g0["max_completed_seq"] == 12
+        assert g0["dead_peers"] == ["worker:1"]
+        assert g0["failures"][0]["exit_code"] == 137
+        g1 = el["generations"]["1"]
+        assert g1["world_size"] == 1 and g1["resume_step"] == 4
+        assert g1["max_completed_seq"] == 39 and g1["outcome"] == "done"
+        text = "\n".join(format_health(report))
+        assert "RESTART TIMELINE: 2 generation(s)" in text, text
+        assert "gen 0: W=2, reached seq 12" in text, text
+        assert "rank 1 killed (exit 137)" in text, text
+        assert "gen 1: W=1, resumed from step 4" in text, text
+        # newest incarnation is healthy -> exit 0 despite gen 0's death
+        rc = run_health([g0a, g0b, g1a, sup_path])
+        assert rc == 0, rc
+        # the compat surface still answers with the NEWEST generation
+        fl, _tr = load_health_inputs([g0a, g0b, g1a, sup_path])
+        assert set(fl) == {0}, fl
     print("merge_traces self-test OK")
     return 0
 
