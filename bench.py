@@ -1306,6 +1306,63 @@ def _emit_final(reason=None):
             }
         except Exception as exc:
             out["static_analysis"] = {"error": repr(exc)}
+    # SDC detector stamp (ISSUE 15): the per-check cost of the
+    # fingerprint pass over THIS bench's gradient/param footprint
+    # (measured by fingerprinting a probe buffer of the stamped
+    # plan's total bytes) and what one check costs as a fraction of
+    # the headline step at the configured cadence.  Off by default
+    # (MXNET_SDC_CHECK_EVERY_N=0) the compiled step is built WITHOUT
+    # the fingerprint output — the hot path is byte-identical, cost 0.
+    try:
+        import time as _time
+
+        import numpy as _np
+
+        from mxnet_tpu import sdc as _sdc
+
+        plan = (out.get("bucketing") or {}).get("plan") or {}
+        fp_bytes = int(plan.get("total_bytes") or 25557032 * 4)
+        probe = _np.zeros(min(fp_bytes, 64 << 20) // 4, _np.float32)
+        n_reps = 5
+        t0 = _time.perf_counter()
+        for _ in range(n_reps):
+            _sdc.fingerprint_np(probe)
+        per_check = (_time.perf_counter() - t0) / n_reps
+        per_check *= fp_bytes / max(probe.nbytes, 1)  # capped probe
+        hrow = next((r for r in _STATE["table"]
+                     if r.get("images_per_sec_per_chip")
+                     and r.get("batch")), None)
+        step_s = (hrow["batch"] / hrow["images_per_sec_per_chip"]) \
+            if hrow else None
+        every_n = _sdc.check_every_n()
+        checks_run = 0
+        try:
+            from mxnet_tpu import diagnostics as _diag
+
+            for key, m in _diag.metrics.dump_json()["metrics"].items():
+                if key.startswith("mxnet_sdc_checks_total"):
+                    checks_run += int(m.get("value") or 0)
+        except Exception:
+            pass
+        out["sdc"] = {
+            "enabled": every_n > 0,
+            "check_every_n": every_n,
+            "checks_run": checks_run,
+            "fingerprint_bytes": fp_bytes,
+            "per_check_seconds": round(per_check, 6),
+            "fraction_of_step_time": round(per_check / step_s, 5)
+            if step_s else None,
+            # amortized over the cadence: what the detector adds to
+            # EVERY step once enabled at check_every_n (0 when off)
+            "amortized_fraction_of_step_time": round(
+                per_check / step_s / every_n, 6)
+            if step_s and every_n else 0.0,
+            # off-path contract: no fingerprint output is compiled
+            # into the step at all (test-pinned, not just claimed)
+            "hot_path_cost_when_off_seconds": 0.0,
+        }
+    except Exception as exc:
+        out["sdc"] = {"error": repr(exc)}
     # elastic provenance: which fleet incarnation produced these
     # numbers (a supervised bench restarted mid-run must not be
     # mistaken for generation 0's uninterrupted pass)

@@ -44,6 +44,7 @@ from . import profiler
 from . import diagnostics
 from . import checkpoint
 from . import chaos
+from . import sdc
 from . import analysis
 from . import autotune
 from . import monitor

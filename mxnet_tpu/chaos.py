@@ -57,6 +57,22 @@ hold; ``nth`` skips the first nth-1 candidate events.  Kinds:
     degraded throughput, never a deadlock (the round-robin consumer
     just waits on that worker's turn).  Match keys: ``worker``,
     ``nth``, ``count``, ``ms``.
+  * ``bitflip_param``  — flip ONE bit in ONE parameter buffer on rank
+    K at global step N (after the optimizer update / param pull) — the
+    HBM bit flip or flaky-ALU silent corruption that every layer below
+    the SDC defense (mxnet_tpu/sdc.py) would faithfully propagate and
+    persist as "verified".  The cross-rank fingerprint vote must name
+    the rank, step and bucket.  Match keys: ``rank``, ``step``,
+    ``nth``, ``count``; selectors: ``param`` (target array name,
+    default the first in sorted order), ``bit`` (flat bit index,
+    default 12).
+  * ``bitflip_grad``   — same flip, but in a GRADIENT buffer before
+    the push/update (backward done, update not) — corruption that
+    propagates THROUGH the synchronous exchange into every rank
+    equally, which voting cannot see and the offline replay audit
+    (``python -m mxnet_tpu.sdc --replay``) must catch.  Match keys:
+    ``rank``, ``step``, ``nth``, ``count``; selectors ``param``/
+    ``bit`` as above.
   * ``kill_rank``      — SUPERVISOR-level kill: the elastic
     supervisor (mxnet_tpu.elastic) SIGKILLs its child worker ``rank``
     mid-run — the machine-went-away failure the automatic
@@ -90,6 +106,8 @@ __all__ = ["Rule", "rules", "enabled", "fault", "should_kill",
            "maybe_slow_request", "should_fail_execute",
            "maybe_corrupt_shard", "should_fail_version",
            "maybe_slow_decode", "should_kill_rank",
+           "should_bitflip_param", "should_bitflip_grad",
+           "apply_bitflip", "flip_bit_np",
            "injected_total", "reset", "KILL_EXIT_CODE"]
 
 _log = logging.getLogger(__name__)
@@ -99,7 +117,7 @@ _log = logging.getLogger(__name__)
 KILL_EXIT_CODE = 137
 
 _INT_KEYS = ("rank", "nth", "count", "step", "version", "nbytes",
-             "worker", "tick", "ckpt_step")
+             "worker", "tick", "ckpt_step", "bit")
 _FLOAT_KEYS = ("ms",)
 
 
@@ -120,8 +138,9 @@ class Rule:
         value (string-compared for non-numeric keys like ``key``/``op``;
         a context that omits the key does not match)."""
         for k, want in self.params.items():
-            if k in ("nth", "count", "ms", "mode", "nbytes"):
-                continue
+            if k in ("nth", "count", "ms", "mode", "nbytes", "param",
+                     "bit"):
+                continue  # selectors/parameters, not match conditions
             if k not in ctx:
                 return False
             have = ctx[k]
@@ -349,6 +368,65 @@ def should_kill_rank(rank: int, **ctx) -> bool:
     return fault("kill_rank", rank=rank, **ctx) is not None
 
 
+def flip_bit_np(arr, bit: int):
+    """Return ``arr`` with flat bit index ``bit`` of its byte buffer
+    flipped (wraps past the end, so any bit index is valid for any
+    non-empty array).  Flips in place when the buffer allows, else
+    returns a flipped copy — callers use the return value."""
+    import numpy as np
+
+    a = np.ascontiguousarray(arr)
+    if not a.flags.writeable:
+        a = a.copy()  # e.g. a jax array's read-only host view
+    buf = a.view(np.uint8).reshape(-1)
+    if buf.size == 0:
+        return a
+    buf[(int(bit) // 8) % buf.size] ^= 1 << (int(bit) % 8)
+    return a
+
+
+def apply_bitflip(rule, arrays) -> Optional[str]:
+    """Apply one bitflip_* rule to ``arrays`` ({name: np.ndarray}):
+    the rule's ``param`` selector names the target (default: first
+    name in sorted order), ``bit`` the flat bit index (default 12).
+    The flipped array is written back into the dict; returns its name
+    (None when there is nothing to flip)."""
+    import numpy as np
+
+    if not arrays:
+        return None
+    name = rule.params.get("param")
+    if name is not None and name not in arrays:
+        # a typo'd explicit selector silently flipping a DIFFERENT
+        # param would make a chaos proof test the wrong bucket while
+        # appearing to pass — be loud about the retarget
+        _log.warning(
+            "chaos: bitflip param=%r not among %s — falling back to "
+            "%r (fix the selector if this test meant that param)",
+            name, sorted(arrays)[:6], sorted(arrays)[0])
+        name = sorted(arrays)[0]
+    elif name is None:
+        name = sorted(arrays)[0]
+    bit = int(rule.params.get("bit", 12))
+    flipped = flip_bit_np(arrays[name], bit)
+    arrays[name] = flipped.reshape(np.shape(arrays[name]))
+    return name
+
+
+def should_bitflip_param(step: int, **ctx) -> Optional[Rule]:
+    """bitflip_param hook (fit loops, AFTER the optimizer update /
+    param pull): returns the firing rule — the caller flips via
+    :func:`apply_bitflip` so the rule's param/bit selectors apply."""
+    return fault("bitflip_param", step=step, **ctx)
+
+
+def should_bitflip_grad(step: int, **ctx) -> Optional[Rule]:
+    """bitflip_grad hook (mid-step window: backward done, update/push
+    not) — the corruption that rides the synchronous exchange into
+    every rank, which only the offline replay audit can catch."""
+    return fault("bitflip_grad", step=step, **ctx)
+
+
 def should_fail_version(model: str, version: int, **ctx) -> bool:
     """bad_version hook (ModelServer canary dispatch): True when the
     matching model's NEW version must fail its canary batch — what
@@ -509,7 +587,45 @@ def _self_test() -> tuple:
         del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
         reset()
 
-    # 9) disabled == inert (and never raises)
+    # 9) the sdc kinds: bitflip_param flips exactly ONE bit of the
+    # selected array on the matching rank+step (roundtrip restores the
+    # original bytes); bitflip_grad shares the grammar
+    import numpy as np
+
+    os.environ["MXNET_CHAOS"] = (  # mxlint: disable=MXL002
+        "bitflip_param:rank=0,step=4,param=fc1_weight,bit=9;"
+        "bitflip_grad:rank=0,step=2")
+    reset()
+    try:
+        checks["bitflip_wrong_step"] = should_bitflip_param(
+            3, rank=0) is None
+        r = should_bitflip_param(4, rank=0)
+        checks["bitflip_fires"] = r is not None
+        arrays = {"fc1_weight": np.arange(4, dtype=np.float32),
+                  "aa_first": np.zeros(2, np.float32)}
+        orig = arrays["fc1_weight"].copy()
+        name = apply_bitflip(r, arrays)
+        flipped = arrays["fc1_weight"]
+        checks["bitflip_targets_param"] = name == "fc1_weight" \
+            and np.array_equal(arrays["aa_first"], np.zeros(2, "f4"))
+        delta = np.frombuffer(orig.tobytes(), np.uint8) ^ \
+            np.frombuffer(flipped.tobytes(), np.uint8)
+        checks["bitflip_one_bit"] = bool(
+            sum(bin(int(b)).count("1") for b in delta) == 1
+            and delta[1] == (1 << 1))  # bit 9 = byte 1, bit 1
+        arrays["fc1_weight"] = flip_bit_np(arrays["fc1_weight"], 9)
+        checks["bitflip_roundtrip"] = bool(np.array_equal(
+            arrays["fc1_weight"], orig))
+        checks["bitflip_consumed"] = should_bitflip_param(
+            4, rank=0) is None
+        g = should_bitflip_grad(2, rank=0)
+        checks["bitflip_grad_fires"] = g is not None and \
+            injected_total("bitflip_grad") == 1
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        reset()
+
+    # 10) disabled == inert (and never raises)
     checks["disabled_inert"] = not enabled() and \
         fault("kill", step=1) is None
 

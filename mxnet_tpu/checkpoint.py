@@ -357,6 +357,10 @@ def _try_assemble_manifest(directory: str, step: int,
         shards[str(r)] = {"path": "rank%d.ckpt" % r,
                           "bytes": int(meta["bytes"]),
                           "sha256": meta["sha256"]}
+        if meta.get("param_fps"):
+            # param-content fingerprints (see _write): the replay
+            # audit's comparison target, riding the same manifest
+            shards[str(r)]["param_fps"] = meta["param_fps"]
         if meta.get("tree"):
             tree = meta["tree"]
         generation = max(generation, int(meta.get("generation", 0)))
@@ -621,12 +625,27 @@ class CheckpointManager:
         # digest of the in-memory bytes, BEFORE they touch disk: any
         # later on-disk flip/truncation is detectable against it
         digest = hashlib.sha256(blob).hexdigest()
+        # per-param CONTENT fingerprints (sdc.fingerprint_np: wrapped
+        # uint32 word sum) ride the sidecar into the manifest: sha256
+        # authenticates the PICKLE, these authenticate the PARAMS —
+        # what the offline replay audit (python -m mxnet_tpu.sdc
+        # --replay) compares its re-executed state against without
+        # trusting (or re-reading) the shard it is auditing
+        try:
+            from . import sdc as _sdc
+
+            param_fps = {str(k): _sdc.fingerprint_np(v)
+                         for k, v in (payload.get("params")
+                                      or {}).items()}
+        except Exception:
+            param_fps = None
         sidecar = {
             "rank": self.rank, "step": int(step),
             "num_ranks": self.num_ranks,
             "generation": int(payload.get("generation", 0)),
             "bytes": len(blob), "sha256": digest,
             "format_version": FORMAT_VERSION,
+            "param_fps": param_fps,
             "tree": {"params": _tree_spec(payload.get("params")),
                      "aux_params": _tree_spec(payload.get("aux_params"))},
         }

@@ -70,7 +70,7 @@ __all__ = [
     "register_preemption_hook", "unregister_preemption_hook",
     "run_preemption_hooks", "set_dead_peers", "dead_peers",
     "generation", "touch_heartbeat", "DivergenceError",
-    "DivergenceGuard",
+    "DivergenceGuard", "loss_signal",
 ]
 
 _log = logging.getLogger(__name__)
@@ -225,6 +225,28 @@ def touch_heartbeat(min_interval_s: float = 0.5) -> Optional[str]:
         return path
     except OSError:
         return None
+
+
+def loss_signal(name_values) -> Optional[float]:
+    """The loss-like scalar among a metric's ``(name, value)`` pairs —
+    what the conv-path divergence guard feeds on: the first metric
+    whose name says loss/entropy/perplexity (spiking accuracy is not
+    divergence); failing that, any NON-FINITE metric value (garbage is
+    garbage whatever the metric is called)."""
+    import math
+
+    fallback = None
+    for name, value in (name_values or ()):
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            continue
+        n = str(name).lower()
+        if any(t in n for t in ("loss", "entropy", "perplex", "nll")):
+            return v
+        if not math.isfinite(v) and fallback is None:
+            fallback = v
+    return fallback
 
 
 class DivergenceError(RuntimeError):

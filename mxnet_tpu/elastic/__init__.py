@@ -6,8 +6,9 @@ the surviving world size, and resume from the newest verified
 checkpoint — zero operator action.  ``--self-test`` runs the no-jax
 state-machine checks (tier-1).
 """
+from ..sdc import EXIT_SDC
 from .supervisor import (EXIT_RESTART_BUDGET, FleetSupervisor,
                          SlotBoard, backoff_delay, classify_exit)
 
-__all__ = ["EXIT_RESTART_BUDGET", "FleetSupervisor", "SlotBoard",
-           "backoff_delay", "classify_exit"]
+__all__ = ["EXIT_RESTART_BUDGET", "EXIT_SDC", "FleetSupervisor",
+           "SlotBoard", "backoff_delay", "classify_exit"]

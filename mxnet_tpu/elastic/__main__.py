@@ -59,6 +59,7 @@ def _self_test() -> tuple:
         and classify_exit(83) == "preempted"
         and classify_exit(84) == "diverged"
         and classify_exit(85) == "watchdog_abort"
+        and classify_exit(87) == "sdc"
         and classify_exit(137) == "killed"
         and classify_exit(-9) == "killed"        # Popen signal form
         and classify_exit(-15) == "terminated"
@@ -157,6 +158,54 @@ def _self_test() -> tuple:
         checks["rejoin_event"] = any(
             e["kind"] == "slots_rejoined" and e["slots"] == [1]
             for e in sup.events)
+
+        # 9) SDC quarantine: rank 1 exits 87 in gen 0 → its slot is
+        # PERMANENTLY excluded (a fresh rejoin marker is ignored, the
+        # journal records the quarantine) and gen 1 launches at W'=1
+        sup = _mini_fleet(tmp, "sdc", 2, {(0, 1): 87}, rejoin_s=0.5)
+        with open(sup.slots.rejoin_path(1), "w"):
+            pass  # fresh marker — a quarantined slot must IGNORE it
+        checks["sdc_rc0"] = sup.run() == 0
+        launches = [e for e in sup.events if e["kind"] == "launch"]
+        checks["sdc_reshapes_despite_rejoin"] = \
+            [e["world_size"] for e in launches] == [2, 1]
+        checks["sdc_reason"] = any(
+            e["kind"] == "fleet_down" and e["reason"] == "sdc"
+            for e in sup.events)
+        checks["sdc_quarantine_event"] = any(
+            e["kind"] == "slot_quarantined" and e["slot"] == 1
+            and e["reason"] == "sdc" for e in sup.events)
+        checks["sdc_board_state"] = sup.slots.quarantined() == [1] \
+            and sup.slots.healthy() == [0]
+
+        # 9b) MIXED simultaneous failures classify PER SLOT: rank 0
+        # exits 87 (sdc) while rank 1 crashes plain in the same tick —
+        # only the sdc slot is quarantined; the crashed slot comes
+        # back through the all-failed restore and gen 1 runs on it
+        sup = _mini_fleet(tmp, "sdc_mixed", 2, {(0, 0): 87,
+                                                (0, 1): 1})
+        checks["mixed_rc0"] = sup.run() == 0
+        checks["mixed_quarantines_only_sdc_slot"] = \
+            sup.slots.quarantined() == [0]
+        launches = [e for e in sup.events if e["kind"] == "launch"]
+        checks["mixed_reshapes_to_crashed_slot"] = \
+            [e["world_size"] for e in launches] == [2, 1] and \
+            launches[1]["slots"] == [1]
+
+        # 10) board-level quarantine semantics: restore_all keeps a
+        # quarantined slot out; every-slot-quarantined gives up
+        board = SlotBoard(2, tmp)
+        board.quarantine(1)
+        board.mark_failed(0)
+        board.restore_all()
+        checks["quarantine_survives_restore"] = \
+            board.healthy() == [0] and board.quarantined() == [1]
+        sup = _mini_fleet(tmp, "sdc_all", 1, {(0, 0): 87},
+                          max_restarts=3)
+        checks["all_quarantined_gives_up"] = \
+            sup.run() == EXIT_RESTART_BUDGET and any(
+                e["kind"] == "all_slots_quarantined"
+                for e in sup.events)
 
     return all(checks.values()), checks
 

@@ -62,6 +62,7 @@ from .. import dist as _dist
 from .. import env as _env
 from ..diagnostics import (EXIT_DIVERGED, EXIT_PREEMPTED,
                            EXIT_WATCHDOG_ABORT)
+from ..sdc import EXIT_SDC
 
 __all__ = [
     "EXIT_RESTART_BUDGET", "classify_exit", "backoff_delay",
@@ -99,6 +100,11 @@ def classify_exit(rc: Optional[int]) -> str:
         return "diverged"
     if rc == EXIT_WATCHDOG_ABORT:
         return "watchdog_abort"
+    if rc == EXIT_SDC:
+        # the SDC fingerprint vote named this rank corrupt: a NODE
+        # failure (flaky chip / HBM), not a training failure — the
+        # slot is quarantined permanently, never rejoined
+        return "sdc"
     if rc in _KILL_CODES:
         return "killed"
     if rc == 128 + signal.SIGTERM:
@@ -137,6 +143,7 @@ class SlotBoard:
         self.n_slots = int(n_slots)
         self.state_dir = state_dir
         self._failed_at: Dict[int, float] = {}
+        self._quarantined: set = set()
 
     def rejoin_path(self, slot: int) -> str:
         return os.path.join(self.state_dir, "slot%d.rejoin" % slot)
@@ -147,17 +154,40 @@ class SlotBoard:
     def failed(self) -> List[int]:
         return sorted(self._failed_at)
 
+    def quarantined(self) -> List[int]:
+        return sorted(self._quarantined)
+
+    def rejoinable(self) -> List[int]:
+        """Failed slots the rejoin window may still restore — a
+        quarantined slot is not one of them."""
+        return [s for s in self.failed() if s not in self._quarantined]
+
     def mark_failed(self, slot: int) -> None:
         self._failed_at.setdefault(int(slot), time.time())
 
+    def quarantine(self, slot: int) -> None:
+        """Permanently exclude a slot (SDC: the machine computes wrong
+        numbers — no rejoin marker, restart or restore ever brings it
+        back; only a fresh SlotBoard does)."""
+        self.mark_failed(slot)
+        self._quarantined.add(int(slot))
+
     def restore_all(self) -> None:
-        self._failed_at.clear()
+        """Forget every failure EXCEPT quarantines — the crash-loop
+        full-W retry must not relaunch onto a chip the fingerprint
+        vote proved corrupt."""
+        for slot in list(self._failed_at):
+            if slot not in self._quarantined:
+                del self._failed_at[slot]
 
     def poll_rejoin(self) -> List[int]:
         """Restore (and report) failed slots whose rejoin marker is
-        fresher than the failure; the consumed marker is removed."""
+        fresher than the failure; the consumed marker is removed.
+        Quarantined slots never rejoin — their markers are ignored."""
         restored = []
         for slot, failed_ts in sorted(self._failed_at.items()):
+            if slot in self._quarantined:
+                continue
             path = self.rejoin_path(slot)
             try:
                 if os.path.getmtime(path) >= failed_ts - 1.0:
@@ -543,8 +573,17 @@ class FleetSupervisor:
     def _handle_failure(self, reason: str) -> Optional[int]:
         """Drain, account, reshape/rejoin, backoff.  Returns an exit
         code to give up with, or None to relaunch."""
-        failed_slots = [w.slot for w in self._workers
-                        if not w.alive() and w.code() != 0]
+        # per-SLOT classification, not the fleet-level `reason` (which
+        # is the FIRST failure's label, kept for metrics/backoff): two
+        # workers dying in one tick with different codes must each get
+        # their own slot policy — an SDC exit next to a plain crash
+        # quarantines exactly the corrupt slot, and a diverged rank
+        # next to a killed one keeps only ITS slot healthy
+        failed = [(w.slot, "hung" if getattr(w, "_hung", False)
+                   else classify_exit(w.code()))
+                  for w in self._workers
+                  if not w.alive() and w.code() != 0]
+        failed_slots = [s for s, _r in failed]
         survivor_codes = self._drain_survivors()
         self._stop_daemons()
         for w in self._workers:
@@ -563,19 +602,34 @@ class FleetSupervisor:
                 "budget %d) — exiting %d",
                 self.restarts, self.max_restarts, EXIT_RESTART_BUDGET)
             return EXIT_RESTART_BUDGET
-        # a diverged run is a TRAINING failure, not a node failure:
-        # restart the same world from the last verified checkpoint
-        if reason not in ("diverged",):
-            for slot in failed_slots:
+        # a diverged slot is a TRAINING failure, not a node failure:
+        # its slot stays healthy and the world restarts from the last
+        # verified checkpoint.  An SDC exit is the OPPOSITE extreme:
+        # the fingerprint vote proved the slot's machine computes
+        # wrong numbers, so it is QUARANTINED permanently — excluded
+        # from the rejoin window, from the all-failed restore, from
+        # everything but a fresh supervisor.
+        for slot, slot_reason in failed:
+            if slot_reason == "sdc":
+                self.slots.quarantine(slot)
+                self._event("slot_quarantined", slot=slot,
+                            reason="sdc")
+                _log.error(
+                    "elastic: slot %d QUARANTINED — the SDC "
+                    "fingerprint vote named its rank corrupt (exit "
+                    "%d); it will not rejoin this fleet", slot,
+                    EXIT_SDC)
+            elif slot_reason != "diverged":
                 self.slots.mark_failed(slot)
-        # bounded rejoin window: a failed slot whose marker shows up in
-        # time rejoins, restoring W; otherwise reshape to survivors
+        # bounded rejoin window: a failed (non-quarantined) slot whose
+        # marker shows up in time rejoins, restoring W; otherwise
+        # reshape to survivors
         rejoined: List[int] = []
-        if self.slots.failed() and self.rejoin_s > 0:
+        if self.slots.rejoinable() and self.rejoin_s > 0:
             deadline = time.monotonic() + self.rejoin_s
             while time.monotonic() < deadline:
                 rejoined.extend(self.slots.poll_rejoin())
-                if not self.slots.failed():
+                if not self.slots.rejoinable():
                     break
                 time.sleep(min(self.monitor_interval_s, 0.1))
         if rejoined:
@@ -583,10 +637,21 @@ class FleetSupervisor:
         if not self.slots.healthy():
             # every slot failed: there is no W' to shrink to — restore
             # them all and retry at full W (a local crash loop lands
-            # here; the restart budget still bounds it)
+            # here; the restart budget still bounds it).  Quarantined
+            # slots stay out; if NOTHING survives the quarantine there
+            # is no hardware left to run on.
             self._event("all_slots_failed_restoring",
-                        slots=self.slots.failed())
+                        slots=self.slots.failed(),
+                        quarantined=self.slots.quarantined())
             self.slots.restore_all()
+        if not self.slots.healthy():
+            self._event("all_slots_quarantined",
+                        slots=self.slots.quarantined())
+            _log.error(
+                "elastic: every slot is quarantined (%s) — no healthy "
+                "hardware left to relaunch on; exiting %d",
+                self.slots.quarantined(), EXIT_RESTART_BUDGET)
+            return EXIT_RESTART_BUDGET
         delay = backoff_delay(self.restarts - 1, self.backoff_s,
                               jitter=self.jitter)
         self._event("backoff", seconds=round(delay, 3),

@@ -283,6 +283,24 @@ register("MXNET_DIVERGENCE_FACTOR", "float", 3.0,
          "|median| trips the guard (scale-relative above and below "
          "zero; see MXNET_DIVERGENCE_WINDOW).")
 
+# sdc.py — silent-data-corruption defense (cross-rank fingerprint
+# voting + supervisor quarantine + replay audit)
+register("MXNET_SDC_CHECK_EVERY_N", "int", 0,
+         "Cross-rank SDC fingerprint-vote cadence (steps): every N "
+         "steps each rank fingerprints its post-update params per "
+         "bucket (bit-exact wrapped uint32 word sum), the vectors are "
+         "exchanged (PS rendezvous ops, or an in-graph all_gather on "
+         "the shard_map tiers) and majority-voted; the minority rank "
+         "dumps an 'sdc' flight event and exits EXIT_SDC=87 without "
+         "saving, so the elastic supervisor QUARANTINES its slot and "
+         "resumes survivors from the newest verified checkpoint.  0 "
+         "(default) disables — the off path adds nothing to the "
+         "compiled step or the fit loop.")
+register("MXNET_SDC_EXCHANGE_TIMEOUT_S", "float", 60.0,
+         "How long a PS-path SDC check waits for every rank's "
+         "fingerprint report before declaring the round inconclusive "
+         "and moving on (a vote must not take down a healthy fleet).")
+
 # elastic/ — fleet supervisor (failure detection -> mesh reshape ->
 # resume at the new world size)
 register("MXNET_ELASTIC_MAX_RESTARTS", "int", 3,

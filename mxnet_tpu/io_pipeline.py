@@ -605,6 +605,14 @@ class ShardedDecodePool(DataIter):
             try:
                 msg = q.get(timeout=0.2)
             except _queue.Empty:
+                # io-bound wait: the parent is alive, just starved —
+                # beacon so a supervised run stuck behind slow decode
+                # workers is not SIGKILLed as "hung" by
+                # MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S (rate-limited,
+                # no-op unsupervised)
+                from . import diagnostics as _diag
+
+                _diag.touch_heartbeat()
                 if not self._procs[w].is_alive():
                     self._declare_dead(w)
                     return self._adopt_next(w)

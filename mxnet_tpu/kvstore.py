@@ -663,7 +663,10 @@ class KVStoreDist(KVStore):
     # pseq.  Control ops (set_optimizer, stop, ...) keep fail-fast
     # semantics — a lost 'stop' ack retried could double-count a
     # worker's shutdown and end the server under its peers.
-    _RETRY_OPS = frozenset(("init", "push", "pull", "pull_rows"))
+    # the sdc ops are idempotent reads/overwrites (a report resent for
+    # the same (step, worker) just rewrites the same vector)
+    _RETRY_OPS = frozenset(("init", "push", "pull", "pull_rows",
+                            "sdc_report", "sdc_gather", "sdc_digest"))
 
     def _req_server(self, idx: int, msg):
         """Server request with bounded retry: on a transport failure
@@ -993,6 +996,57 @@ class KVStoreDist(KVStore):
     def load_optimizer_states(self, fname: str) -> None:
         with open(fname, "rb") as f:
             self.set_optimizer_states_bytes(f.read())
+
+    # -- sdc fingerprint exchange (mxnet_tpu/sdc.py) -------------------
+    def sdc_exchange(self, step: int, fps,
+                     timeout: float = 60.0) -> Dict[int, list]:
+        """Report this rank's per-key fingerprint vector for ``step``
+        and gather every rank's (rendezvous on server 0 — the vectors
+        are a few bytes; no key sharding needed).  Returns
+        ``{rank: fps}`` with however many ranks reported before the
+        timeout — the caller treats a short roster as inconclusive, so
+        a straggling or dead peer can never wedge the vote."""
+        import time as _time
+
+        self._req_server(0, {"op": "sdc_report", "step": int(step),
+                             "worker": self._rank,
+                             "fps": [int(v) for v in fps]})
+        deadline = _time.monotonic() + max(float(timeout), 0.0)
+        got: Dict[int, list] = {}
+        while True:
+            resp = self._req_server(0, {"op": "sdc_gather",
+                                        "step": int(step)})
+            got = {int(k): [int(x) for x in v]
+                   for k, v in (resp.get("data") or {}).items()}
+            if len(got) >= self._nw or _time.monotonic() > deadline:
+                return got
+            _time.sleep(0.02)
+
+    def sdc_reference(self, keys) -> List[int]:
+        """The AUTHORITATIVE fingerprint vector: each key's owning
+        server digests its OWN stored copy — the bytes every rank's
+        pull delivered — so the vote has a tie-breaking voter that a
+        worker-side bit flip cannot touch (server-side-update mode
+        makes the store the ground truth).  Raises when any key is
+        missing server-side (caller votes without the reference)."""
+        by_server: Dict[int, list] = {}
+        for k in keys:
+            by_server.setdefault(self._server_idx(k), []).append(k)
+        digests: Dict[Any, int] = {}
+        for idx, ks in sorted(by_server.items()):
+            resp = self._req_server(idx, {"op": "sdc_digest",
+                                          "keys": list(ks)})
+            for k, v in (resp.get("data") or {}).items():
+                digests[k] = v
+        out = []
+        for k in keys:
+            v = digests.get(k)
+            if v is None:
+                raise MXNetError(
+                    "sdc_reference: server holds no value for key %r"
+                    % (k,))
+            out.append(int(v))
+        return out
 
     # -- cluster control -----------------------------------------------
     def barrier(self) -> None:

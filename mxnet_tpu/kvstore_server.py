@@ -49,6 +49,9 @@ class KVStoreServer:
         self.state: Dict[Any, _KeyState] = {}
         self.updater = None
         self.gc: Optional[GradientCompression] = None
+        # sdc fingerprint rendezvous: step -> {worker: [fps]} (bounded
+        # history — old rounds are evidence nobody will read)
+        self.sdc_rounds: Dict[int, Dict[int, list]] = {}
         self.lock = threading.Condition()
         self.stopped_workers = 0
         self.listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -146,6 +149,34 @@ class KVStoreServer:
             data = self._handle_pull(msg)
             rows = np.asarray(msg["rows"], dtype=np.int64)
             _ps.send_msg(conn, {"data": data[rows], "rows": rows})
+        elif op == "sdc_report":
+            # sdc fingerprint rendezvous (mxnet_tpu/sdc.py): one
+            # worker's per-key fingerprint vector for one step.
+            # Idempotent — a retried report rewrites the same vector.
+            step = int(msg["step"])
+            with self.lock:
+                self.sdc_rounds.setdefault(step, {})[
+                    int(msg["worker"])] = list(msg["fps"])
+                for old in sorted(self.sdc_rounds)[:-8]:
+                    del self.sdc_rounds[old]
+            _ps.send_msg(conn, {"ok": True})
+        elif op == "sdc_gather":
+            with self.lock:
+                data = {w: list(v) for w, v in
+                        self.sdc_rounds.get(int(msg["step"]),
+                                            {}).items()}
+            _ps.send_msg(conn, {"data": data})
+        elif op == "sdc_digest":
+            # the authoritative voter: fingerprint the server's OWN
+            # stored copy of each key — the bytes every worker's pull
+            # delivered, out of reach of a worker-side bit flip
+            from . import sdc as _sdc
+
+            with self.lock:
+                data = {k: (_sdc.fingerprint_np(self.store[k])
+                            if k in self.store else None)
+                        for k in msg["keys"]}
+            _ps.send_msg(conn, {"data": data})
         elif op == "set_optimizer":
             # ref: server cmd channel (kvstore_dist.h:102) + python
             # set_optimizer pickling the optimizer over

@@ -285,10 +285,16 @@ class BaseModule:
 
         # chaos injection (kill/nan_grad at an exact global step) needs
         # per-batch stepping — a fused K-step dispatch has no mid-group
-        # injection point
+        # injection point.  The SDC fingerprint vote needs it too:
+        # every rank must reach the SAME cadence steps, and per-rank
+        # bulk state (a profiler on one rank, a bulk fallback on
+        # another) would misalign the exchange — each check would then
+        # stall its reporting rank for the full exchange timeout.
+        from .. import sdc as _sdc
+
         chaos_on = _chaos.enabled()
         per_batch = monitor is not None or _profiler.is_running() \
-            or chaos_on
+            or chaos_on or _sdc.enabled()
         bulk_k = 1 if per_batch else max(1, _engine.fit_bulk_size())
         can_bulk = bulk_k > 1 and hasattr(self, "_bulk_fit_steps")
 
@@ -374,8 +380,66 @@ class BaseModule:
         from .. import chaos as _chaos
         from .. import diagnostics as _diag
         from .. import profiler as _profiler
+        from .. import sdc as _sdc
 
         progress["last_save"] = progress["step"]
+
+        # divergence guard (MXNET_DIVERGENCE_WINDOW): the conv path
+        # feeds the loss-like training metric — same windowed-median
+        # threshold, trip counter and exit-84 contract the transformer
+        # fit loop already honors.  The metric accumulates an
+        # epoch-running MEAN, which would dilute a late-epoch spike
+        # into invisibility (batch 900's 10x loss moves the mean by
+        # ~1%), so the guard feeds the PER-STEP value recovered from
+        # the metric's (sum_metric, num_inst) deltas where available,
+        # falling back to the running mean only for metric classes
+        # without that surface.
+        guard = _diag.DivergenceGuard()
+        _metric_prev: Dict[int, Tuple[float, float]] = {}
+
+        def _loss_metric_obj():
+            mets = getattr(eval_metric, "metrics", None)
+            for m in ([eval_metric] if mets is None else mets):
+                name = str(getattr(m, "name", "")).lower()
+                if hasattr(m, "sum_metric") and hasattr(m, "num_inst") \
+                        and any(t in name for t in
+                                ("loss", "entropy", "perplex", "nll")):
+                    return m
+            return None
+
+        def _maybe_divergence(step: int) -> None:
+            if not guard.enabled:
+                return
+            m = _loss_metric_obj()
+            v = None
+            if m is not None:
+                prev_sum, prev_n = _metric_prev.get(id(m), (0.0, 0.0))
+                cur_sum = float(m.sum_metric)
+                cur_n = float(m.num_inst)
+                if cur_n < prev_n:  # metric reset (epoch boundary)
+                    prev_sum, prev_n = 0.0, 0.0
+                _metric_prev[id(m)] = (cur_sum, cur_n)
+                if cur_n > prev_n:
+                    v = (cur_sum - prev_sum) / (cur_n - prev_n)
+            if v is None:
+                v = _diag.loss_signal(eval_metric.get_name_value())
+            if v is not None and guard.check(v, step=step):
+                guard.trip(step)
+
+        # SDC fingerprint vote (MXNET_SDC_CHECK_EVERY_N): post-update
+        # params across the dist fleet must be bit-identical — voted
+        # at the cadence, with the corrupt minority exiting EXIT_SDC
+        sdc_guard = _sdc.SDCGuard() if _sdc.enabled() else None
+
+        def _after_update(step: Optional[int] = None) -> None:
+            if step is None:
+                step = progress["step"] + 1
+            if chaos_on and hasattr(self, "_corrupt_param_bitflip"):
+                rule = _chaos.should_bitflip_param(step)
+                if rule is not None:
+                    self._corrupt_param_bitflip(rule)
+            if sdc_guard is not None and sdc_guard.should_check(step):
+                sdc_guard.check_module(self, step)
 
         def _maybe_save() -> None:
             """Save when an every_n boundary was crossed since the last
@@ -442,11 +506,13 @@ class BaseModule:
                             step_tic = time.time()
                             self.forward_backward(b)
                             self.update()
+                            _after_update()
                             self.update_metric(eval_metric, b.label)
                             _diag.record_step(
                                 time.time() - step_tic,
                                 samples=_batch_samples(b),
                                 metric_values=eval_metric.get_name_value())
+                            _maybe_divergence(progress["step"] + 1)
                             nbatch = self._fit_batch_end(
                                 epoch, nbatch, eval_metric,
                                 batch_end_callback)
@@ -477,7 +543,13 @@ class BaseModule:
                         progress["step"] += 1
                         progress["nbatch"] = nbatch
                     # device state is post-GROUP: save once here so the
-                    # shard's step label matches the params it holds
+                    # shard's step label matches the params it holds —
+                    # and the group-end state is what the divergence
+                    # guard can judge (mid-group steps live only
+                    # inside the fused dispatch; the SDC vote forces
+                    # the per-batch path outright, so its cadence
+                    # never lands mid-group on any rank)
+                    _maybe_divergence(progress["step"])
                     _maybe_save()
             else:
                 end_of_batch = False
@@ -503,7 +575,13 @@ class BaseModule:
                                 is not None and \
                                 hasattr(self, "_corrupt_grads_nan"):
                             self._corrupt_grads_nan()
+                        grule = _chaos.should_bitflip_grad(
+                            progress["step"] + 1)
+                        if grule is not None and \
+                                hasattr(self, "_corrupt_grads_bitflip"):
+                            self._corrupt_grads_bitflip(grule)
                     self.update()
+                    _after_update()
                     try:
                         next_data_batch = next(data_iter)
                         self.prepare(next_data_batch)
@@ -514,6 +592,7 @@ class BaseModule:
                         time.time() - step_tic,
                         samples=_batch_samples(data_batch),
                         metric_values=eval_metric.get_name_value())
+                    _maybe_divergence(progress["step"] + 1)
                     if monitor is not None:
                         monitor.toc_print()
                     if batch_end_callback is not None:

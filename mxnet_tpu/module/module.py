@@ -418,6 +418,41 @@ class Module(BaseModule):
             if g is not None:
                 g[:] = float("nan")
 
+    def _corrupt_param_bitflip(self, rule) -> None:
+        """Chaos 'bitflip_param' injection target: flip ONE bit in one
+        post-update parameter buffer — the HBM/flaky-chip silent
+        corruption the SDC fingerprint vote (mxnet_tpu/sdc.py) must
+        name by rank, step and bucket."""
+        from .. import chaos as _chaos
+
+        host = {n: self._exec.arg_dict[n].asnumpy()
+                for n in self._param_names}
+        name = _chaos.apply_bitflip(rule, host)
+        if name is not None:
+            self._exec.arg_dict[name][:] = host[name]
+            self.logger.warning(
+                "chaos: bitflip_param flipped bit %s of %r",
+                rule.params.get("bit", 12), name)
+
+    def _corrupt_grads_bitflip(self, rule) -> None:
+        """Chaos 'bitflip_grad' injection target: flip ONE bit in one
+        gradient buffer before the push/update — corruption that rides
+        the synchronous exchange into every rank equally (the case the
+        offline replay audit catches, voting cannot)."""
+        from .. import chaos as _chaos
+
+        host = {}
+        for n in self._param_names:
+            g = self._exec.grad_dict.get(n)
+            if g is not None:
+                host[n] = g.asnumpy()
+        name = _chaos.apply_bitflip(rule, host)
+        if name is not None:
+            self._exec.grad_dict[name][:] = host[name]
+            self.logger.warning(
+                "chaos: bitflip_grad flipped bit %s of %r",
+                rule.params.get("bit", 12), name)
+
     # ------------------------------------------------------------------
     def _active_updater(self):
         """The updater that actually holds optimizer state: the kvstore's

@@ -529,6 +529,17 @@ class FusedTrainStep:
         # single-device build keeps the replicated layout.
         stage = zero1_stage(self._zero_stage)
         self._zero1 = bool(stage == 1 and self._bucketed)
+        # SDC fingerprint vote (mxnet_tpu/sdc.py): per-bucket bit-exact
+        # fingerprints of the post-update params (+ replicated momenta)
+        # computed INSIDE the single-step program under lax.cond on the
+        # step counter and all-gathered over dp.  Needs the bucketed
+        # multi-device dp path (the buckets ARE the fingerprint units,
+        # and a vote needs >1 replica); off by default — the disabled
+        # path compiles the exact same graph as before.
+        from .. import sdc as _sdcmod
+
+        self._sdc_n = _sdcmod.check_every_n()
+        self._sdc = bool(self._sdc_n > 0 and self._bucketed)
         if stage == 1 and not self._bucketed:
             import logging
 
@@ -677,7 +688,49 @@ class FusedTrainStep:
                 in_specs=(P(), mom_spec, P("dp"), P("dp"), P(), P()),
                 out_specs=(P(), mom_spec, P(), P("dp")),
                 check_rep=False)
+            step_sdc = None
+            if self._sdc:
+                sdc_n = self._sdc_n
+
+                def local_step_sdc(param_vals, mom_vals, data, label,
+                                   key_root, ctr):
+                    with _nn_ops.cross_device_batch_stats("dp"):
+                        new_params, new_moms, loss_val, logits = \
+                            step_body(param_vals, mom_vals, data,
+                                      label, key_root, ctr,
+                                      sharded=True)
+                    groups = []
+                    for b in plan:
+                        leaves = [new_params[i] for i in b.keys]
+                        if not zero1:
+                            # replicated momenta vote too; zero1
+                            # shards differ per rank by design
+                            leaves += [new_moms[i] for i in b.keys]
+                        groups.append(leaves)
+
+                    def _fps():
+                        return _jnp.stack(
+                            [_sdcmod.tree_fingerprint(g)
+                             for g in groups])
+
+                    # the param-bytes pass runs ONLY on cadence steps
+                    # (lax.cond); the always-on all_gather moves
+                    # n_buckets uint32s — noise
+                    fp = _lx.cond(
+                        ctr % sdc_n == 0, _fps,
+                        lambda: _jnp.zeros((len(plan),), _jnp.uint32))
+                    rows = _lx.all_gather(fp, "dp")
+                    return new_params, new_moms, loss_val, logits, rows
+
+                step_sdc = shard_map(
+                    local_step_sdc, mesh=self.mesh,
+                    in_specs=(P(), mom_spec, P("dp"), P("dp"), P(),
+                              P()),
+                    out_specs=(P(), mom_spec, P(), P("dp"), P()),
+                    check_rep=False)
         else:
+            step_sdc = None
+
             def step(param_vals, mom_vals, data, label, key_root, ctr):
                 return step_body(param_vals, mom_vals, data, label,
                                  key_root, ctr, sharded=False)
@@ -707,14 +760,22 @@ class FusedTrainStep:
         # compilation these step programs trigger and warn on
         # shape/dtype churn — a silent recompilation storm doubles step
         # time with no error anywhere
+        # the sdc variant additionally returns the gathered
+        # (n_dp, n_buckets) fingerprint matrix; the K-step scan
+        # variants below keep the plain program (per-step cadence
+        # needs per-step dispatch)
+        step_fn, step_out_sh = (step, (self._param_sh, self._mom_sh,
+                                       rep, data_sh))
+        if step_sdc is not None:
+            step_fn = step_sdc
+            step_out_sh = step_out_sh + (rep,)
         self._step = _diag.instrument_jit(
             "FusedTrainStep.step",
             jax.jit(
-                step,
+                step_fn,
                 in_shardings=(self._param_sh, self._mom_sh, data_sh,
                               data_sh, rep, rep),
-                out_shardings=(self._param_sh, self._mom_sh, rep,
-                               data_sh),
+                out_shardings=step_out_sh,
                 donate_argnums=donate,
             ), meta=step_meta)
 
@@ -801,6 +862,9 @@ class FusedTrainStep:
         self._key_gen = _random._generation
         self._key_ctr = 0
         self._placed = False
+        self._last_sdc_rows = None
+        self._sdc_guard = _sdcmod.SDCGuard(every_n=self._sdc_n) \
+            if self._sdc else None
         self._built = True
 
     @property
@@ -1012,10 +1076,20 @@ class FusedTrainStep:
             self._key_gen = _random._generation
             self._key_ctr = 0
         self._key_ctr += 1
-        new_params, self._moms, loss, logits = self._step(
-            params, self._moms, raw_data, raw_label, self._key_root,
-            self._key_ctr
-        )
+        if self._sdc:
+            new_params, self._moms, loss, logits, rows = self._step(
+                params, self._moms, raw_data, raw_label,
+                self._key_root, self._key_ctr)
+            self._last_sdc_rows = rows
+            if self._key_ctr % self._sdc_n == 0:
+                # one tiny host read per cadence step; a corrupt
+                # device trips dump + exit 87 (supervised) inside
+                self._sdc_guard.check_rows(rows, step=self._key_ctr)
+        else:
+            new_params, self._moms, loss, logits = self._step(
+                params, self._moms, raw_data, raw_label,
+                self._key_root, self._key_ctr
+            )
         self._stamp_bucket_telemetry()
         self._param_vals = new_params
         for i, (p, v) in enumerate(zip(self._cells, new_params)):

@@ -311,8 +311,15 @@ class ModelServer:
     # -- dispatch worker ----------------------------------------------
     def _worker_loop(self, sm: _ServedModel) -> None:
         from .. import chaos as _chaos
+        from .. import diagnostics as _diag
 
         while True:
+            # liveness beacon: a supervised server that idles between
+            # requests (or sits in a long AOT compile before traffic)
+            # must not read as "hung" to the elastic supervisor's
+            # MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S — the batcher loop IS
+            # the proof of life (rate-limited, no-op unsupervised)
+            _diag.touch_heartbeat()
             batch = sm.queue.take_batch(
                 min(self.max_batch, sm.runtime.max_batch),
                 self.batch_deadline_s)

@@ -54,11 +54,30 @@ class LMTokenIter(NDArrayIter):
         corpus = make_corpus(num_sequences, seq_len, vocab_size, seed)
         self.seq_len = int(seq_len)
         self.vocab_size = int(vocab_size)
+        self.num_sequences = int(num_sequences)
+        self.seed = int(seed)
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
         super().__init__(
             corpus[:, :-1], label=corpus[:, 1:], batch_size=batch_size,
             shuffle=shuffle, last_batch_handle=last_batch_handle,
             data_name="tokens", label_name="next_tokens",
             num_parts=num_parts, part_index=part_index)
+
+    def replay_spec(self) -> dict:
+        """Reconstruction spec for ``sdc.replay_audit``: the synthetic
+        corpus is fully determined by these scalars, so an offline
+        audit can re-create THIS stream bit-for-bit."""
+        return {
+            "kind": "lm_token_iter",
+            "batch_size": int(self.batch_size),
+            "seq_len": self.seq_len,
+            "vocab_size": self.vocab_size,
+            "num_sequences": self.num_sequences,
+            "seed": self.seed,
+            "num_parts": self.num_parts,
+            "part_index": self.part_index,
+        }
 
     def skip_batches(self, n: int) -> None:
         """Fast-forward ``n`` batches (cursor moves, nothing

@@ -149,8 +149,17 @@ class TransformerTrainStep:
         self._bucket_plan, self._bucket_tuning = plan, tuning
         sharded = n_total > 1
 
+        from .. import sdc as _sdc
+
         stage = zero1_stage(self._zero_stage)
         self._zero1 = bool(stage == 1 and sharded and n_dp > 1)
+        # SDC fingerprint vote (mxnet_tpu/sdc.py): per-bucket bit-exact
+        # fingerprints of the post-update params computed INSIDE the
+        # compiled step under lax.cond on the step counter and
+        # all-gathered over dp.  Off (the default) leaves the graph
+        # untouched; voting needs >1 dp replica.
+        self._sdc_n = _sdc.check_every_n()
+        self._sdc = bool(self._sdc_n > 0 and sharded and n_dp > 1)
         if stage == 1 and not self._zero1:
             import logging
 
@@ -209,6 +218,33 @@ class TransformerTrainStep:
                 names, params_d, grads, moms, lr, mom_c, wd)
             return new_p, new_m, loss
 
+        sdc_on, sdc_n = self._sdc, self._sdc_n
+
+        def step_body_sdc(params_d, moms, tokens, labels, ctr):
+            new_p, new_m, loss = step_body(params_d, moms, tokens,
+                                           labels)
+            from .. import sdc as _sdcmod
+
+            groups = []
+            for bucket in plan:
+                leaves = [new_p[k] for k in bucket.keys]
+                if not zero1:
+                    # replicated momenta must match across dp too;
+                    # zero1 shards are legitimately different per rank
+                    leaves += [new_m[k] for k in bucket.keys]
+                groups.append(leaves)
+
+            def _fps():
+                return jnp.stack([_sdcmod.tree_fingerprint(g)
+                                  for g in groups])
+
+            # the param-bytes pass is paid ONLY on cadence steps; the
+            # always-on all_gather moves n_buckets uint32s — noise
+            fp = lax.cond(ctr % sdc_n == 0, _fps,
+                          lambda: jnp.zeros((len(plan),), jnp.uint32))
+            rows = lax.all_gather(fp, "dp")
+            return new_p, new_m, loss, rows
+
         if sharded:
             from jax.experimental.shard_map import shard_map
 
@@ -218,6 +254,13 @@ class TransformerTrainStep:
                 in_specs=(P(), mom_spec, data_spec, data_spec),
                 out_specs=(P(), mom_spec, P()),
                 check_rep=False)
+            if sdc_on:
+                step_sdc = shard_map(
+                    step_body_sdc, mesh=self.mesh,
+                    in_specs=(P(), mom_spec, data_spec, data_spec,
+                              P()),
+                    out_specs=(P(), mom_spec, P(), P()),
+                    check_rep=False)
         else:
             step = step_body
 
@@ -234,13 +277,19 @@ class TransformerTrainStep:
 
         step_meta = {"compute_dtype": str(jnp.dtype(cfg.dtype)),
                      "bucket_plan": plan_meta_v}
+        # the sdc variant takes the step counter and returns the
+        # gathered (n_dp, n_buckets) fingerprint rows; the K-step
+        # bench scan below keeps the plain program — per-step cadence
+        # needs per-step dispatch
+        p_sh = {k: rep for k in self._params}
+        step_fn, in_sh, out_sh = step, (p_sh, mom_sh, data_sh,
+                                        data_sh), (p_sh, mom_sh, rep)
+        if sdc_on:
+            step_fn, in_sh, out_sh = (step_sdc, in_sh + (rep,),
+                                      out_sh + (rep,))
         self._step = _diag.instrument_jit(
             "TransformerTrainStep.step",
-            jax.jit(step,
-                    in_shardings=({k: rep for k in self._params},
-                                  mom_sh, data_sh, data_sh),
-                    out_shardings=({k: rep for k in self._params},
-                                   mom_sh, rep),
+            jax.jit(step_fn, in_shardings=in_sh, out_shardings=out_sh,
                     donate_argnums=(0, 1)),
             meta=step_meta)
 
@@ -271,6 +320,8 @@ class TransformerTrainStep:
         self._multi_same: Dict[int, object] = {}
         self._multi_same_fn = multi_step_same
         self._sharded = sharded
+        self._sdc_ctr = 0
+        self._last_sdc_rows = None
         self._built = True
 
     # -- introspection --------------------------------------------------
@@ -327,6 +378,47 @@ class TransformerTrainStep:
         return (jax.device_put(raw(tokens), self._data_sh),
                 jax.device_put(raw(labels), self._data_sh))
 
+    def _bitflip_param(self, rule) -> None:
+        """Chaos 'bitflip_param' for the functional tier: flip one bit
+        in a (replicated) parameter — uniform across replicas, so the
+        in-graph vote cannot see it; the offline replay audit
+        (``python -m mxnet_tpu.sdc --replay``) is what must catch it."""
+        import numpy as np
+
+        from .. import chaos as _chaos
+
+        jax = _jax()
+        host = {k: np.asarray(v) for k, v in self._params.items()}
+        name = _chaos.apply_bitflip(rule, host)
+        if name is not None:
+            self._params[name] = jax.device_put(host[name], self._rep)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "chaos: bitflip_param flipped bit %s of %r",
+                rule.params.get("bit", 12), name)
+
+    def _replay_spec(self, train_iter) -> dict:
+        """Everything ``sdc.replay_audit`` needs to re-execute this
+        run's steps offline: config dims, hyperparameters (with the
+        RESOLVED attention/remat choices, not the env defaults they
+        came from) and the data source's reconstruction spec."""
+        spec_fn = getattr(train_iter, "replay_spec", None)
+        return {
+            "cfg": dict(self.cfg._asdict()),
+            "hyper": {
+                "learning_rate": self._lr,
+                "momentum": self._momentum,
+                "weight_decay": self._wd,
+                "seed": self._seed,
+                "attn_impl": self._impl,
+                "remat": self._policy,
+                "bucket_bytes": self._bucket_bytes,
+            },
+            "data": spec_fn() if spec_fn is not None
+            else {"kind": "unknown"},
+        }
+
     def _stamp_telemetry(self):
         if self._sharded:
             from ..parallel import buckets as _buckets
@@ -340,10 +432,27 @@ class TransformerTrainStep:
         if not self._built:
             self._build()
         tokens, labels = self._put_batch(tokens, labels)
-        self._params, self._moms, loss = self._step(
-            self._params, self._moms, tokens, labels)
+        if self._sdc:
+            self._sdc_ctr += 1
+            (self._params, self._moms, loss,
+             self._last_sdc_rows) = self._step(
+                self._params, self._moms, tokens, labels,
+                self._sdc_ctr)
+        else:
+            self._params, self._moms, loss = self._step(
+                self._params, self._moms, tokens, labels)
         self._stamp_telemetry()
         return loss
+
+    def sdc_rows(self, step: Optional[int] = None):
+        """The newest gathered fingerprint matrix ((n_dp, n_buckets)
+        uint32 — one row per dp replica), meaningful only on cadence
+        steps; None when the detector is off."""
+        if not self._sdc or self._last_sdc_rows is None:
+            return None
+        if step is not None and step % self._sdc_n != 0:
+            return None
+        return self._last_sdc_rows
 
     def run_steps(self, tokens, labels, steps: int):
         """K same-batch steps as ONE compiled program; returns the
@@ -545,8 +654,11 @@ class TransformerTrainStep:
                     if not train_iter.iter_next():
                         train_iter.reset()
                         train_iter.iter_next()
+        from .. import sdc as _sdc
+
         chaos_on = _chaos.enabled()
         guard = _diag.DivergenceGuard()
+        sdc_guard = _sdc.SDCGuard() if self._sdc else None
         tps = _diag.metrics.gauge(
             "mxnet_transformer_tokens_per_second",
             "transformer fit throughput (tokens/s, this rank)")
@@ -563,6 +675,9 @@ class TransformerTrainStep:
                 # mid-run preemption that didn't say goodbye — the
                 # kill/resume harness's injection point
                 _chaos.should_kill(step_i + 1)
+                rule = _chaos.should_bitflip_param(step_i + 1)
+                if rule is not None:
+                    self._bitflip_param(rule)
             # block before sampling the clock: an async dispatch
             # interval is host cost, not step time — same truthful-
             # metric stance as the bulk fit path's step timing
@@ -575,6 +690,12 @@ class TransformerTrainStep:
                 # standalone it raises instead of training through
                 # garbage
                 guard.trip(step_i + 1)
+            if sdc_guard is not None:
+                rows = self.sdc_rows(self._sdc_ctr)
+                if rows is not None:
+                    # one tiny host read per cadence step; a corrupt
+                    # device trips dump + exit 87 (supervised) inside
+                    sdc_guard.check_rows(rows, step=step_i + 1)
             _diag.touch_heartbeat()
             now = time.monotonic()
             n_tok = int(tokens.shape[0]) * int(tokens.shape[1])
@@ -603,7 +724,12 @@ class TransformerTrainStep:
                              # re-derive the global sample position
                              "batch_size": getattr(train_iter,
                                                    "batch_size", None)},
-                         extra={"workload": "transformer_lm"})
+                         extra={"workload": "transformer_lm",
+                                # sdc.replay_audit's reconstruction
+                                # spec: the offline corruption bisector
+                                # re-executes from exactly this state
+                                "replay": self._replay_spec(
+                                    train_iter)})
         if mgr is not None:
             mgr.wait()
         return [float(v) for v in losses]

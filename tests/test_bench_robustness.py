@@ -99,6 +99,34 @@ bench._emit_final()
     assert "bucketing" in out
 
 
+def test_final_json_stamps_sdc_overhead():
+    """ISSUE 15 acceptance: the final JSON carries the sdc block —
+    checks run, measured per-check seconds over the benched gradient
+    footprint, fraction of step time, and the zero-cost-when-off
+    contract (off by default)."""
+    code = """
+import bench
+bench._STATE["table"].append({"model": "resnet50_v1", "batch": 32,
+                              "images_per_sec_per_chip": 1200.0})
+bench._emit_final()
+"""
+    proc = _run(code)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    out = json.loads([ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    s = out["sdc"]
+    assert s["enabled"] is False and s["check_every_n"] == 0
+    assert s["checks_run"] == 0
+    assert s["per_check_seconds"] > 0
+    assert s["fingerprint_bytes"] > 0
+    # a real wall-clock measurement against a synthetic 26.7ms step:
+    # assert sign/presence, not magnitude (a loaded CI box must not
+    # flake this)
+    assert s["fraction_of_step_time"] > 0
+    assert s["amortized_fraction_of_step_time"] == 0.0
+    assert s["hot_path_cost_when_off_seconds"] == 0.0
+
+
 def test_headline_zero_when_no_resnet50():
     code = """
 import bench

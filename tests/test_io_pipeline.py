@@ -360,3 +360,36 @@ def test_io_env_knobs_registered():
                  "MXNET_IO_POOL_SLOTS", "MXNET_IO_START_METHOD",
                  "MXNET_COMPILE_CACHE_DIR"):
         assert env.is_registered(name), name
+
+
+# ---------------------------------------------------------------------
+# elastic heartbeat coverage (ISSUE 15 satellite): the parent's decode
+# wait beacons liveness — a supervised run starved behind slow decode
+# workers must not be SIGKILLed as "hung"
+# ---------------------------------------------------------------------
+def test_io_wait_touches_heartbeat(tmp_path, monkeypatch):
+    from mxnet_tpu import chaos as chaos_mod
+    from mxnet_tpu import diagnostics as diag
+
+    hb_dir = str(tmp_path / "hb")
+    monkeypatch.setenv("MXNET_ELASTIC_HEARTBEAT_DIR", hb_dir)
+    # a seeded straggler: every batch from worker 0 arrives ~0.6s late,
+    # so the parent's fetch loop spins its Empty branch
+    monkeypatch.setenv("MXNET_CHAOS",
+                       "slow_decode:worker=0,ms=600,count=100")
+    chaos_mod.reset()
+    monkeypatch.setattr(diag, "_hb_last", 0.0)
+    monkeypatch.setattr(diag, "_hb_path", None)
+    x = np.arange(32, dtype=np.float32).reshape(16, 2)
+    y = np.arange(16, dtype=np.float32)
+    fn = iop.make_ndarray_iter_fn(x, y, batch_size=4,
+                                  last_batch_handle="discard")
+    pool = iop.ShardedDecodePool(fn, num_workers=1)
+    try:
+        b = pool.next()
+        assert b is not None
+        assert os.path.exists(os.path.join(hb_dir, "hb_rank0")), \
+            os.listdir(hb_dir) if os.path.isdir(hb_dir) else "no hb"
+    finally:
+        pool.close()
+        chaos_mod.reset()

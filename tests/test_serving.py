@@ -669,3 +669,28 @@ def test_http_reload_route(tmp_path):
     finally:
         srv.drain(timeout_s=5.0)
         fe.stop()
+
+
+# ---------------------------------------------------------------------
+# elastic heartbeat coverage (ISSUE 15 satellite): the batcher loop
+# beacons liveness, so a supervised server idling between requests is
+# never falsely SIGKILLed by MXNET_ELASTIC_HEARTBEAT_TIMEOUT_S
+# ---------------------------------------------------------------------
+def test_batcher_loop_touches_heartbeat(tmp_path, monkeypatch):
+    hb_dir = str(tmp_path / "hb")
+    monkeypatch.setenv("MXNET_ELASTIC_HEARTBEAT_DIR", hb_dir)
+    # reset the rate limiter so the beacon fires for THIS dir
+    monkeypatch.setattr(diag, "_hb_last", 0.0)
+    monkeypatch.setattr(diag, "_hb_path", None)
+    rt = serving.demo_runtime(max_batch=2)
+    srv = serving.ModelServer(max_batch=2, queue_max=4)
+    try:
+        srv.add_model(rt)
+        deadline = time.monotonic() + 5.0
+        path = os.path.join(hb_dir, "hb_rank0")
+        while time.monotonic() < deadline and not os.path.exists(path):
+            time.sleep(0.05)  # no traffic at all — idling must beacon
+        assert os.path.exists(path), os.listdir(hb_dir) \
+            if os.path.isdir(hb_dir) else "no hb dir"
+    finally:
+        srv.drain(timeout_s=5.0)

@@ -443,7 +443,7 @@ def analyze_generations(flight_by_gen, supervisor):
             "world_size": None, "resume_step": None,
             "failures": [], "reason": None, "outcome": None,
             "ranks_dumped": [], "max_completed_seq": None,
-            "dead_peers": [],
+            "dead_peers": [], "quarantined": [],
         })
 
     for gen, by_rank in sorted((flight_by_gen or {}).items()):
@@ -468,6 +468,10 @@ def analyze_generations(flight_by_gen, supervisor):
                 {"rank": ev.get("rank"), "kind": kind,
                  "exit_code": ev.get("exit_code"),
                  "reason": ev.get("reason")})
+        elif kind == "slot_quarantined":
+            # SDC: the fingerprint vote named the slot's machine
+            # corrupt — permanently excluded, never rejoined
+            r["quarantined"].append(ev.get("slot"))
         elif kind == "fleet_down":
             r["reason"] = ev.get("reason")
             r["outcome"] = "down"
@@ -527,6 +531,8 @@ def format_elastic(elastic):
                            "" if code is None else " (exit %s)" % code))
         for peer in r.get("dead_peers", []):
             bits.append("dead peer %s" % peer)
+        for slot in r.get("quarantined", []):
+            bits.append("slot %s QUARANTINED (sdc)" % slot)
         if r.get("outcome") == "down":
             bits.append("died (%s)" % (r.get("reason") or "?"))
         elif r.get("outcome") == "done":
@@ -825,6 +831,9 @@ def self_test() -> int:
                 {"ts": 2.0, "generation": 0, "kind": "worker_exit",
                  "rank": 1, "slot": 1, "exit_code": 137,
                  "reason": "killed"},
+                {"ts": 2.05, "generation": 0,
+                 "kind": "slot_quarantined", "slot": 1,
+                 "reason": "sdc"},
                 {"ts": 2.1, "generation": 0, "kind": "fleet_down",
                  "reason": "killed", "failed_slots": [1],
                  "resume_step": 4},
@@ -851,6 +860,7 @@ def self_test() -> int:
         assert g0["world_size"] == 2 and g0["max_completed_seq"] == 12
         assert g0["dead_peers"] == ["worker:1"]
         assert g0["failures"][0]["exit_code"] == 137
+        assert g0["quarantined"] == [1], g0
         g1 = el["generations"]["1"]
         assert g1["world_size"] == 1 and g1["resume_step"] == 4
         assert g1["max_completed_seq"] == 39 and g1["outcome"] == "done"
@@ -858,6 +868,7 @@ def self_test() -> int:
         assert "RESTART TIMELINE: 2 generation(s)" in text, text
         assert "gen 0: W=2, reached seq 12" in text, text
         assert "rank 1 killed (exit 137)" in text, text
+        assert "slot 1 QUARANTINED (sdc)" in text, text
         assert "gen 1: W=1, resumed from step 4" in text, text
         # newest incarnation is healthy -> exit 0 despite gen 0's death
         rc = run_health([g0a, g0b, g1a, sup_path])

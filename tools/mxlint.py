@@ -31,6 +31,21 @@ the fact (recompile storms, config typos, hot-loop host syncs):
                                site: swallows the desync/timeout the
                                flight recorder needs to see (also
                                catches KeyboardInterrupt/SystemExit)
+  MXL008 ad-hoc-exit-code      ``os._exit``/``sys.exit`` with a bare
+                               nonzero NUMERIC LITERAL outside the
+                               sanctioned exit-code sites
+                               (diagnostics.py / elastic/ / serving/):
+                               the exit-code taxonomy (83 preempted,
+                               84 diverged, 85 watchdog-abort, 86
+                               restart-budget, 87 sdc, 137 killed) is
+                               LOAD-BEARING for the elastic
+                               supervisor's failure classification —
+                               a new code invented ad hoc silently
+                               lands in the "crashed" bucket (or
+                               worse, collides).  Exit through the
+                               named constants (EXIT_*,
+                               KILL_EXIT_CODE) or add the code to the
+                               taxonomy first.
   MXL007 jax-in-decode-worker  jax/device call (``device_put``,
                                ``block_until_ready``, any ``jax.*``)
                                inside a decode-worker function: pool
@@ -83,7 +98,15 @@ CODES = {
     "MXL006": "bare except around a collective call site",
     "MXL007": "jax/device call inside a decode-worker function "
               "(workers are host-only; the device stage owns placement)",
+    "MXL008": "numeric-literal exit code outside the sanctioned exit "
+              "sites (the 83-87/137 taxonomy is load-bearing for the "
+              "supervisor — exit through the named constants)",
 }
+
+# files whose exit codes ARE the taxonomy: the documented contract
+# lives there, everything else must exit through its named constants
+SANCTIONED_EXIT_RE = re.compile(
+    r"mxnet_tpu[/\\](diagnostics\.py$|elastic[/\\]|serving[/\\])")
 
 # decode-worker entry points by naming convention
 WORKER_NAME_RE = re.compile(r"(_worker_main$|decode_worker|io_worker)")
@@ -193,6 +216,8 @@ class ModuleLinter:
         self.tree = ast.parse(source, path)
         self.traced_fns = self._collect_traced_fns()
         self.worker_fns = self._collect_worker_fns()
+        self.sanctioned_exit = bool(
+            SANCTIONED_EXIT_RE.search(os.path.abspath(path)))
 
     # -- pass 1: which local functions get traced by jax? --------------
     def _collect_traced_fns(self) -> Set[str]:
@@ -357,6 +382,30 @@ class ModuleLinter:
                       % (".".join(chain), ".".join(fn_stack)),
                       ".".join(fn_stack))
 
+    def _check_exit_call(self, node: ast.Call, fn_stack: List[str]
+                         ) -> None:
+        """MXL008: ``os._exit(<literal>)``/``sys.exit(<literal>)`` with
+        a nonzero int outside the sanctioned exit-code sites.  Named
+        constants (EXIT_PREEMPTED, KILL_EXIT_CODE, ...) and
+        ``sys.exit(main())`` pass — the point is that new CODES enter
+        the taxonomy deliberately, not that exits are forbidden."""
+        if self.sanctioned_exit:
+            return
+        chain = _dotted(node.func)
+        if chain[-2:] not in (["os", "_exit"], ["sys", "exit"]):
+            return
+        if not node.args:
+            return
+        a = node.args[0]
+        if isinstance(a, ast.Constant) and isinstance(a.value, int) \
+                and not isinstance(a.value, bool) and a.value != 0:
+            self._add(node, "MXL008",
+                      "%s(%d): numeric-literal exit code outside the "
+                      "sanctioned sites — the 83-87/137 taxonomy "
+                      "drives the elastic supervisor; exit through a "
+                      "named constant" % (".".join(chain), a.value),
+                      ".".join(fn_stack) or "<module>")
+
     def _check_bare_except(self, node: ast.Try, fn_stack: List[str]
                            ) -> None:
         scope = ".".join(fn_stack) or "<module>"
@@ -399,6 +448,7 @@ class ModuleLinter:
                     self._check_host_sync(child, fn_stack)
                 if worker:
                     self._check_worker_call(child, fn_stack)
+                self._check_exit_call(child, fn_stack)
             if isinstance(child, ast.Try):
                 self._check_bare_except(child, fn_stack)
             self._walk(child, c_stack, c_traced, c_loop, c_worker)
@@ -447,7 +497,7 @@ def load_baseline(path: str) -> Set[str]:
 
 # ---------------------------------------------------------------------------
 SELF_TEST_SRC = '''
-import os, time, random
+import os, sys, time, random
 import numpy as np
 import jax
 
@@ -487,10 +537,20 @@ def my_iter_factory(num_parts=1, part_index=0):
 
 def start_pool():
     return InputPipeline(my_iter_factory, num_workers=2)
+
+def give_up():
+    sys.exit(86)                                           # 008
+EXIT_CUSTOM = 99
+def die_hard(ok):
+    if ok:
+        sys.exit(0)           # literal 0 is fine (success)
+    if os.environ.get("X"):
+        sys.exit(EXIT_CUSTOM)  # named constant: deliberate taxonomy
+    os._exit(87)                                           # 008
 '''
 
 EXPECT_SELF_TEST = {"MXL001": 1, "MXL002": 2, "MXL003": 2, "MXL004": 2,
-                    "MXL005": 1, "MXL006": 1, "MXL007": 3}
+                    "MXL005": 1, "MXL006": 1, "MXL007": 3, "MXL008": 2}
 
 
 def self_test() -> int:
