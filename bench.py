@@ -1156,6 +1156,142 @@ def _memory_probe(batch=16, bulk_k=2, img=128):
     return rec
 
 
+def _overlap_block_from_summary(summary):
+    """The BENCH ``overlap_measured`` block from a traceview
+    attribution summary: phase breakdown, per-bucket collective
+    occupancy, compute/comm overlap fraction and what the capture
+    cost — every number a DEVICE measurement (source=trace), never
+    the simulator's."""
+    phases = {p: round(v.get("mean_s") or 0.0, 9)
+              for p, v in (summary.get("phases") or {}).items()}
+    overlap = summary.get("overlap") or {}
+    capture = summary.get("capture") or {}
+    steps = summary.get("steps") or {}
+    return {
+        "source": "trace",
+        "workload": summary.get("workload"),
+        "n_steps": steps.get("n"),
+        "step_mean_s": steps.get("mean_s"),
+        "phases_per_step_s": phases,
+        "buckets": [
+            {"bucket": b.get("bucket"),
+             "device_s_per_step": b.get("device_s_per_step"),
+             "occupancy": b.get("occupancy")}
+            for b in summary.get("buckets") or []],
+        "overlap_frac": overlap.get("overlap_frac"),
+        "comm_s_per_step": overlap.get("comm_s_per_step"),
+        "plan_match": summary.get("plan_match"),
+        "capture_cost_s": capture.get("capture_cost_s"),
+        "trace_path": capture.get("trace_path"),
+    }
+
+
+def bench_overlap_measured(steps=3):
+    """Arm the traceview capture and run a small dp FusedTrainStep
+    long enough to record ``steps`` steady-state dispatch windows on
+    THIS box's devices; returns the measured overlap block.  Replaces
+    the r05 practice of quoting `scaling.simulate_bucketed_overlap`
+    as if it were a measurement."""
+    import tempfile
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, traceview
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.dp import FusedTrainStep
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    devs = jax.devices()
+    n_dp = 2 if len(devs) >= 2 else 1
+    tdir = tempfile.mkdtemp(prefix="bench_traceview_")
+    os.environ["MXNET_TRACE_DIR"] = tdir
+    os.environ["MXNET_TRACE_STEPS"] = str(int(steps))
+    traceview.reset()
+    try:
+        net = vision.resnet18_v1(classes=8)
+        net.initialize(mx.init.Xavier())
+        mesh = make_mesh((n_dp,), ("dp",), devs[:n_dp])
+        step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              mesh=mesh, learning_rate=0.05)
+        X = nd.random.uniform(shape=(4 * n_dp, 3, 32, 32))
+        y = nd.array((np.arange(4 * n_dp) % 8).astype("float32"))
+        # warmup dispatch (absorbed by the tracer) + recorded windows
+        for _ in range(int(steps) + 2):
+            step(X, y)
+        summary = traceview.last_summary()
+    finally:
+        os.environ.pop("MXNET_TRACE_DIR", None)
+        os.environ.pop("MXNET_TRACE_STEPS", None)
+        traceview.reset()
+    if summary is None:
+        raise RuntimeError("traceview capture recorded no summary "
+                           "(trace dir %s)" % tdir)
+    block = _overlap_block_from_summary(summary)
+    block["platform"] = getattr(devs[0], "platform", "unknown")
+    block["dp"] = n_dp
+    return block
+
+
+def refresh_overlap_measured(path=None, steps=3):
+    """Regenerate the committed OVERLAP_MEASURED.json as a version-2
+    artifact: the legacy r05 schedule-walk fields survive for byte
+    accounting but are explicitly labeled ``source=simulated`` (a
+    static walk of a compiled schedule is a model, not a device
+    measurement); the new ``device_timeline`` block is a REAL
+    traceview capture on this box, with provenance + staleness
+    metadata so the next round knows exactly what to re-measure."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = path or os.path.join(here, "OVERLAP_MEASURED.json")
+    try:
+        with open(path) as f:
+            legacy = json.load(f)
+    except (OSError, ValueError):
+        legacy = {}
+    block = bench_overlap_measured(steps=steps)
+    out = {k: legacy[k] for k in (
+        "n_async_pairs", "n_sync_allreduce_bytes", "async_bytes",
+        "hidden_flops", "program_flops_parsed", "achieved_flops_rate",
+        "ici_GBps_assumed", "overlap_measured", "method", "topology",
+        "model", "measured_at") if k in legacy}
+    out.update({
+        "format": "mxnet-tpu-overlap-measured",
+        "version": 2,
+        # the legacy top-level overlap_measured is the r05 schedule
+        # walk — a simulation-derived number, labeled as such
+        "source": "simulated",
+        "schedule_walk": {
+            "source": "simulated",
+            "note": "r05 static scheduled-HLO walk of the MONOLITHIC "
+                    "program; retained for byte accounting only — "
+                    "predates the bucketed exchange (round 6)",
+            "measured_at": legacy.get("measured_at"),
+        },
+        "device_timeline": block,
+        "provenance": {
+            "tool": "bench.py refresh_overlap_measured "
+                    "(mxnet_tpu.traceview capture + attribution)",
+            "captured_at": time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                                         time.gmtime()),
+            "platform": block.get("platform"),
+            "workload": "%s dp=%d" % (block.get("workload"),
+                                      block.get("dp") or 1),
+            "n_steps": block.get("n_steps"),
+        },
+        "staleness": {
+            "schedule_walk": "STALE: superseded as the overlap source "
+                             "by device_timeline (traceview)",
+            "device_timeline": "regenerate with `python bench.py "
+                               "--refresh-overlap-measured` after any "
+                               "bucketing/schedule change",
+        },
+    })
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    return out
+
+
 # --------------------------------------------------------------------
 # Cumulative result state + signal-safe final emit: an external timeout
 # can truncate the run but can never erase completed rows.
@@ -1163,7 +1299,7 @@ def _memory_probe(batch=16, bulk_k=2, img=128):
 _STATE = {
     "table": [], "io": None, "fit_loop": None, "bare_jax": [],
     "memory": None, "mfu_attribution": None, "serving": None,
-    "transformer": None,
+    "transformer": None, "overlap_measured": None,
     "headline": None, "peak": None, "kind": None, "emitted": False,
 }
 
@@ -1197,6 +1333,7 @@ def _emit_final(reason=None):
         "mfu_attribution": _STATE["mfu_attribution"],
         "serving": _STATE["serving"],
         "transformer": _STATE["transformer"],
+        "overlap_measured": _STATE["overlap_measured"],
     }
     # which reduction schedule produced these numbers: the bucketing
     # config + the last bucket plan the FusedTrainStep runs stamped into
@@ -1695,6 +1832,39 @@ def main():
                                  "error": repr(exc)}
     _progress({"transformer": _STATE["transformer"]})
 
+    # ---- phase 3e: measured device overlap (ISSUE 16 — traceview
+    # capture of a small dp FusedTrainStep; phase breakdown, per-bucket
+    # collective occupancy, overlap fraction, capture cost).  On
+    # failure the block falls back to the committed device_timeline
+    # capture if one exists, else the legacy schedule-walk numbers —
+    # which are SIMULATION-derived and labeled source=simulated. ------
+    try:
+        if left() < 90:
+            raise RuntimeError("time budget spent before overlap "
+                               "capture (elapsed %.0fs)" % elapsed())
+        _STATE["overlap_measured"] = bench_overlap_measured()
+    except Exception as exc:
+        fb = {"error": repr(exc)}
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "OVERLAP_MEASURED.json")) as f:
+                committed = json.load(f)
+            dt = committed.get("device_timeline")
+            if dt:
+                fb.update(dt)
+                fb["source"] = "trace (cached build-time capture)"
+            else:
+                fb["overlap_frac"] = committed.get("overlap_measured")
+                fb["source"] = "simulated"
+                fb["note"] = ("legacy schedule-walk number — a static "
+                              "model of the compiled schedule, not a "
+                              "device measurement")
+        except Exception:
+            fb["source"] = "simulated"
+        _STATE["overlap_measured"] = fb
+    _progress({"overlap_measured": _STATE["overlap_measured"]})
+
     # io comparator: the bf16@32 headline row
     io_compute_ref, io_ref_label = None, None
     for r in _STATE["table"]:
@@ -1807,4 +1977,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--refresh-overlap-measured" in sys.argv:
+        # artifact-refresh mode: no watchdog, no phase budget — just
+        # capture, attribute, and rewrite OVERLAP_MEASURED.json v2
+        refreshed = refresh_overlap_measured()
+        print(json.dumps(refreshed, indent=1))
+    else:
+        main()

@@ -147,17 +147,48 @@ def tune(model: TimingModel, *, chips: int = 256,
             "no step time: the overlap model pivots on the measured "
             "single-chip step time — pass step_time_s/--step-time, or "
             "tune from a SCALING report (which carries it)")
+    from_trace = (model.source or {}).get("kind") == "trace"
     bw = ici_GBps if ici_GBps is not None else \
         (model.measured_GBps or DEFAULT_ICI_GBPS)
-    bw_source = "explicit" if ici_GBps is not None else \
-        ("measured (flight-dump wire durations)" if model.measured_GBps
-         else "assumed (public v5e figure)")
+    if ici_GBps is not None:
+        bw_source, bandwidth_source = "explicit", "explicit"
+    elif model.measured_GBps and from_trace:
+        bw_source = "measured (device-trace collective occupancy)"
+        bandwidth_source = "trace"
+    elif model.measured_GBps:
+        bw_source = "measured (flight-dump wire durations)"
+        bandwidth_source = "flight"
+    else:
+        bw_source = "assumed (public v5e figure)"
+        bandwidth_source = "assumed"
+
+    # measured-overlap calibration: when the model came from a device
+    # trace, the simulator's analytic overlap is checked against the
+    # MEASURED compute/comm overlap of the recorded layout and every
+    # candidate's exposed time is scaled by the resulting factor — a
+    # simulator that is optimistic about this fabric (e.g. a serial
+    # executor that overlaps nothing) stops ranking candidates by an
+    # overlap it cannot deliver.
+    o_meas = getattr(model, "measured_overlap_frac", None)
+    exposure_scale = None
+    if o_meas is not None:
+        rec_sim = _scaling.simulate_bucketed_overlap(
+            [b for b, _dt in model.units], step, chips, bw,
+            backward_frac, coll_latency_s=coll_latency_s,
+            readiness="bytes")
+        o_sim = rec_sim["overlap"]
+        if o_sim < 1.0:
+            exposure_scale = (1.0 - float(o_meas)) / (1.0 - o_sim)
+            exposure_scale = min(max(exposure_scale, 0.25), 4.0)
 
     def score(bucket_bytes):
         sim = _scaling.simulate_bucketed_overlap(
             bucket_bytes, step, chips, bw, backward_frac,
             coll_latency_s=coll_latency_s, readiness="bytes")
-        eff = step / (step + sim["exposed_s"])
+        exposed = sim["exposed_s"]
+        if exposure_scale is not None:
+            exposed = exposed * exposure_scale
+        eff = step / (step + exposed)
         return eff, sim
 
     default_bb = plan_bucket_bytes(model, _buckets.DEFAULT_BUCKET_BYTES)
@@ -185,11 +216,18 @@ def tune(model: TimingModel, *, chips: int = 256,
 
     assumptions = {
         "ici_GBps": bw, "ici_GBps_source": bw_source,
+        "bandwidth_source": bandwidth_source,
         "backward_frac": backward_frac,
         "coll_latency_s": coll_latency_s,
         "readiness": "bytes",
         "step_time_s": step,
     }
+    if exposure_scale is not None:
+        assumptions["overlap_calibration"] = {
+            "measured_overlap_frac": float(o_meas),
+            "simulated_overlap_recorded_layout": o_sim,
+            "exposure_scale": exposure_scale,
+        }
     projection = _scaling.project_efficiency_bucketed(
         best["bucket_bytes"], step, ici_GBps=bw,
         backward_frac=backward_frac, coll_latency_s=coll_latency_s,
@@ -214,6 +252,12 @@ def tune(model: TimingModel, *, chips: int = 256,
             "default_n_buckets": len(default_bb),
             "beats_default": bool(best["eff"] >= default_eff),
             "n_candidates": n_candidates,
+            **({"measured": {
+                "overlap_frac": float(o_meas),
+                "bucket_occupancy": getattr(model, "bucket_occupancy",
+                                            None),
+                "source": "trace",
+            }} if o_meas is not None else {}),
         },
         "assumptions": assumptions,
         "projection": projection,

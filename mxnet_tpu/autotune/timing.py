@@ -37,7 +37,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "TimingModel", "from_flight_dump", "from_bucket_timings",
-    "from_scaling_json", "from_leaf_bytes", "load_any",
+    "from_scaling_json", "from_leaf_bytes", "from_trace", "load_any",
 ]
 
 #: durations shorter than this are issue-stamp overhead, not wire time
@@ -232,10 +232,69 @@ def from_leaf_bytes(leaf_bytes: Sequence[int], dtype: str = "float32",
                        source=dict(source or {"kind": "leaf-bytes"}))
 
 
+def from_trace(payload: dict, path: Optional[str] = None,
+               step_time_s: Optional[float] = None) -> TimingModel:
+    """Extract from a traceview summary
+    (``traceview_summary_rank{K}.json`` — traceview/parse.attribute):
+    the only input whose bandwidth AND step time are both device
+    measurements from one capture.  The returned model additionally
+    carries ``measured_overlap_frac`` / ``bucket_occupancy`` so the
+    cap search can CALIBRATE its simulator against the measured
+    schedule instead of trusting the analytic overlap."""
+    if payload.get("format") != "mxnet-tpu-traceview-summary":
+        raise ValueError("not a traceview summary%s"
+                         % (" %r" % path if path else ""))
+    plan = payload.get("bucket_plan")
+    units = _units_from_plan(plan)
+    buckets = payload.get("buckets") or []
+    if units is None:
+        rows = [b for b in buckets if b.get("bytes")]
+        units = [(int(b["bytes"]), str(b.get("dtype") or "float32"))
+                 for b in rows] or None
+    if units is None:
+        raise ValueError(
+            "traceview summary%s carries no bucket plan — capture with "
+            "bucketing enabled (MXNET_KVSTORE_BUCKET_BYTES != 0) so "
+            "per-bucket reductions appear in the device timeline"
+            % (" %r" % path if path else ""))
+    steps = payload.get("steps") or {}
+    if step_time_s is None:
+        step_time_s = steps.get("mean_s")
+    # effective wire bandwidth from MEASURED device occupancy: bucket
+    # bytes over that bucket's collective device time (median over
+    # buckets); falls back to plan-total / comm-total
+    rates = [float(b["measured_GBps"]) for b in buckets
+             if b.get("measured_GBps")]
+    overlap = payload.get("overlap") or {}
+    if not rates:
+        comm_s = overlap.get("comm_s_per_step")
+        tot = sum(b for b, _dt in units)
+        if comm_s and tot:
+            rates = [tot / float(comm_s) / 1e9]
+    capture = payload.get("capture") or {}
+    model = TimingModel(
+        units, "bucket", step_time_s=step_time_s,
+        measured_GBps=_median(rates),
+        recorded_cap_bytes=(plan or {}).get("cap_bytes"),
+        source={"kind": "trace", "path": path,
+                "workload": payload.get("workload"),
+                "rank": payload.get("rank"),
+                "n_steps": steps.get("n"),
+                "trace_path": capture.get("trace_path")})
+    model.measured_overlap_frac = overlap.get("overlap_frac")
+    model.bucket_occupancy = [
+        {"bucket": int(b.get("bucket", i)),
+         "occupancy": b.get("occupancy"),
+         "device_s_per_step": b.get("device_s_per_step")}
+        for i, b in enumerate(buckets)]
+    return model
+
+
 def load_any(path: str, step_time_s: Optional[float] = None,
              dtype: Optional[str] = None) -> TimingModel:
     """Content-sniffing loader for the CLI's ``--tune`` input: a flight
-    dump, a ``--bucket-timings`` export, or a SCALING report."""
+    dump, a ``--bucket-timings`` export, a SCALING report, or a
+    traceview device-timeline summary."""
     with open(path) as f:
         payload = json.load(f)
     if isinstance(payload, dict):
@@ -245,8 +304,12 @@ def load_any(path: str, step_time_s: Optional[float] = None,
         if payload.get("format") == "bucket-timings":
             return from_bucket_timings(payload, path=path,
                                        step_time_s=step_time_s)
+        if payload.get("format") == "mxnet-tpu-traceview-summary":
+            return from_trace(payload, path=path,
+                              step_time_s=step_time_s)
         if "projection_bucket_pipeline" in payload:
             return from_scaling_json(payload, path=path, dtype=dtype)
     raise ValueError(
         "%r is not a flight-recorder dump, a merge_traces "
-        "--bucket-timings export, or a SCALING report" % path)
+        "--bucket-timings export, a SCALING report, or a traceview "
+        "summary" % path)

@@ -287,12 +287,19 @@ def fault(kind: str, **ctx) -> Optional[Rule]:
         return None
 
 
-def maybe_delay(op: str, **ctx) -> None:
+def maybe_delay(op: str, **ctx) -> Optional[dict]:
     """delay_collective hook (diagnostics.record path): sleep ms when a
-    rule fires."""
+    rule fires.  Returns ``{"kind", "ms"}`` when it fired (None
+    otherwise) so the caller can tag the recorded event
+    ``injected=true`` — traceview and ``merge_traces --health`` then
+    report "INJECTED STALL (chaos)" instead of flagging the seeded
+    straggler as organic."""
     r = fault("delay_collective", op=op, **ctx)
-    if r is not None:
-        time.sleep(float(r.params.get("ms", 200.0)) / 1e3)
+    if r is None:
+        return None
+    ms = float(r.params.get("ms", 200.0))
+    time.sleep(ms / 1e3)
+    return {"kind": "delay_collective", "ms": ms}
 
 
 def should_kill(step: int, **ctx) -> None:
@@ -348,15 +355,20 @@ def maybe_corrupt_shard(path: str, step: int, **ctx) -> bool:
         return False
 
 
-def maybe_slow_decode(worker: int, **ctx) -> None:
+def maybe_slow_decode(worker: int, **ctx) -> Optional[dict]:
     """slow_decode hook (io_pipeline decode worker, AFTER one batch
     decoded): sleep ms when a rule matches this worker — the seeded
     straggler the sharded pipeline must degrade around, not hang on.
     Runs INSIDE the worker process (rules parsed there from the
-    inherited MXNET_CHAOS)."""
+    inherited MXNET_CHAOS).  Returns ``{"kind", "ms"}`` when it fired
+    so the decode span is tagged ``injected=true`` (same contract as
+    maybe_delay)."""
     r = fault("slow_decode", worker=worker, **ctx)
-    if r is not None:
-        time.sleep(float(r.params.get("ms", 100.0)) / 1e3)
+    if r is None:
+        return None
+    ms = float(r.params.get("ms", 100.0))
+    time.sleep(ms / 1e3)
+    return {"kind": "slow_decode", "ms": ms}
 
 
 def should_kill_rank(rank: int, **ctx) -> bool:

@@ -419,11 +419,12 @@ class FlightRecorder:
         try:
             from . import chaos as _chaos
 
+            fired = None
             if _chaos.enabled():
                 # chaos 'delay_collective': a seeded straggler — the
                 # sleep happens where the collective is issued, so the
                 # watchdog/straggler analyses see a real stall
-                _chaos.maybe_delay(str(op))
+                fired = _chaos.maybe_delay(str(op))
             entry = {
                 "seq": -1, "op": str(op),
                 "keys": self._norm_keys(keys),
@@ -433,6 +434,11 @@ class FlightRecorder:
                 "enqueue_ts": time.time(), "complete_ts": None,
                 "state": "in_flight",
             }
+            if fired:
+                # seeded stall: --health/traceview must report it as
+                # "INJECTED STALL (chaos)", never as an organic straggler
+                entry["injected"] = True
+                entry["injected_kind"] = fired.get("kind")
             if args:
                 entry["args"] = dict(args)
             with self._lock:
@@ -1421,6 +1427,28 @@ def record_step(step_time_s: float, samples: Optional[int] = None,
         metrics.maybe_flush()
     except Exception:
         pass  # telemetry must never fail the training loop
+
+
+def feed_phase_seconds(phase_steps) -> None:
+    """``mxnet_step_phase_seconds{phase}`` feed (traceview's ingest
+    calls this with the attributed per-step phase durations): one
+    histogram family per phase, so a phase regression (backward grew,
+    bucket 3's reduce doubled) is scrape-visible with p50/p99 like
+    every other histogram here.  ``phase_steps`` maps phase name to a
+    list of per-step seconds.  Guarded: telemetry must never fail the
+    capture it describes."""
+    try:
+        for phase, vals in (phase_steps or {}).items():
+            h = metrics.histogram(
+                "mxnet_step_phase_seconds",
+                help="measured device seconds per step phase "
+                     "(traceview attribution)",
+                labels={"phase": str(phase)})
+            for v in vals:
+                h.observe(float(v))
+        metrics.maybe_flush()
+    except Exception:
+        pass
 
 
 def feed_kvstore_bytes(op: str, nbytes: int) -> None:

@@ -227,6 +227,16 @@ register("MXNET_PROFILER_FILENAME", "str", "profile.json",
          "Trace dump filename for the autostart path.",
          import_time=True)
 
+# traceview/ — the ONE sanctioned XLA device-trace capture site
+register("MXNET_TRACE_DIR", "str", None,
+         "Arm the traceview device-timeline capture: the next steady-"
+         "state training/serving dispatches are recorded through the "
+         "one sanctioned jax.profiler wrapper and an attributed "
+         "traceview_summary_rank{K}.json lands here.")
+register("MXNET_TRACE_STEPS", "int", 3,
+         "Dispatch windows to record once MXNET_TRACE_DIR is set "
+         "(after one untraced warmup dispatch that absorbs compile).")
+
 # dist.py / profiler rank contract — jax pod launch
 register("MXNET_COORDINATOR_ADDRESS", "str", None,
          "host:port of process 0's coordination service; presence "

@@ -218,8 +218,14 @@ def _decode_worker_main(worker_id, iter_fn, num_parts, part_index,
             exhausted = True
             continue
         decode_s = time.monotonic() - t0_mono
+        injected = None
         if _chaos is not None:
-            _chaos.maybe_slow_decode(worker=worker_id)
+            injected = _chaos.maybe_slow_decode(worker=worker_id)
+            if injected:
+                # fold the seeded stall into the span so the straggler
+                # is visible in the timeline — but TAGGED, so --health
+                # reports "INJECTED STALL (chaos)", not an organic one
+                decode_s = time.monotonic() - t0_mono
         slot = None
         while slot is None:
             try:
@@ -250,7 +256,8 @@ def _decode_worker_main(worker_id, iter_fn, num_parts, part_index,
             v[...] = _np.asarray(a).reshape(v.shape)
         for v, a in zip(l_views, label):
             v[...] = _np.asarray(a).reshape(v.shape)
-        result_q.put(("b", epoch, slot, int(pad), decode_s, t0_mono))
+        result_q.put(("b", epoch, slot, int(pad), decode_s, t0_mono,
+                      (injected or {}).get("kind")))
 
 
 # ---------------------------------------------------------------------------
@@ -329,7 +336,8 @@ def _install_cleanup_once() -> None:
 # telemetry feeds (all guarded: telemetry never fails the pipeline)
 # ---------------------------------------------------------------------------
 def _stamp_decode(worker: int, decode_s: float,
-                  t0_mono: Optional[float] = None) -> None:
+                  t0_mono: Optional[float] = None,
+                  injected_kind: Optional[str] = None) -> None:
     """Per-batch decode evidence: the mxnet_io_decode_seconds histogram
     + a span on the worker's dedicated trace lane (tid BASE+worker) so
     the merged timeline shows every worker's decode activity.  The
@@ -358,8 +366,12 @@ def _stamp_decode(worker: int, decode_s: float,
                 age_us = (time.monotonic() - float(t0_mono)) * 1e6
                 if 0.0 <= age_us < 3600e6:  # sane clock: true anchor
                     start = now - age_us
+            span_args = {"worker": int(worker)}
+            if injected_kind:
+                span_args["injected"] = True
+                span_args["injected_kind"] = str(injected_kind)
             _profiler.record_span("io:decode", start, dur, cat="io",
-                                  tid=tid, args={"worker": int(worker)})
+                                  tid=tid, args=span_args)
     except Exception:
         pass
 
@@ -631,12 +643,13 @@ class ShardedDecodePool(DataIter):
                 self._finished[w] = True
                 return _EPOCH_END
             return None
-        _kind, ep, slot, pad, decode_s, t0_mono = msg
+        _kind, ep, slot, pad, decode_s, t0_mono = msg[:6]
+        injected_kind = msg[6] if len(msg) > 6 else None
         if ep != self._epoch:
             if not self._dead[w]:
                 self._free_qs[w].put(slot)
             return None
-        _stamp_decode(w, decode_s, t0_mono)
+        _stamp_decode(w, decode_s, t0_mono, injected_kind=injected_kind)
         d, l = self._views[w][slot]
         return _HostBatch(w, slot, d, l, int(pad), float(decode_s))
 

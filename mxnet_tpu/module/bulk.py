@@ -440,9 +440,15 @@ class BulkTrainLoop:
             lr = _np.float32(opt.lr_scheduler(opt.num_update)
                              if opt.lr_scheduler else opt.lr)
             ctr0 = jnp.asarray(opt.num_update + 1, dtype=jnp.int32)
-            new_params, new_aux, new_leaves, stacked_outs = self._bulk_fn(
-                params, aux_vals, leaves, tuple(stacked), key_root, ctr0,
-                jnp.asarray(lr))
+            from .. import traceview as _traceview
+
+            with _traceview.step_window("Module.bulk_fit", k=k) as _tvw:
+                (new_params, new_aux, new_leaves,
+                 stacked_outs) = self._bulk_fn(
+                    params, aux_vals, leaves, tuple(stacked), key_root,
+                    ctr0, jnp.asarray(lr))
+                if _tvw is not None:
+                    _tvw.block(stacked_outs)
         except Exception as exc:
             # The program donates param/aux/state buffers: a TRACE/
             # compile failure never consumed them (safe fallback), but a

@@ -323,6 +323,7 @@ def bucketed_reduce(grads: Mapping, plan: Sequence[Bucket],
     'hierarchical' needs ``local_n`` (host_local_count(mesh)); an
     unqualified topology falls back to the flat psum.
     """
+    import jax
     import jax.numpy as jnp
     from jax import lax
 
@@ -335,30 +336,39 @@ def bucketed_reduce(grads: Mapping, plan: Sequence[Bucket],
     out: Dict = {}
     anchor = None
     inv_n = 1.0 / float(n)
-    for bucket in plan:
-        leaves = [grads[k] for k in bucket.keys]
-        flat = leaves[0].ravel() if len(leaves) == 1 else \
-            jnp.concatenate([g.ravel() for g in leaves])
-        if chain and anchor is not None:
-            # reductions issue in reverse-layer order, NCCL-stream
-            # style; the data dependency stops the all-reduce combiner
-            # from re-fusing the buckets back into one op
-            flat, _ = lax.optimization_barrier((flat, anchor))
-        if impl == "ring" and n > 1:
-            red = ring_allreduce_flat(flat, axis_name, n)
-        elif hier:
-            red = hierarchical_reduce_flat(flat, axis_name, n,
-                                           int(local_n))
-        else:
-            red = lax.psum(flat, axis_name)
-        if mean and n > 1:
-            red = red * jnp.asarray(inv_n, dtype=red.dtype)
-        anchor = lax.slice(red, (0,), (1,))
-        off = 0
-        for key, g in zip(bucket.keys, leaves):
-            sz = g.size
-            out[key] = lax.slice(red, (off,), (off + sz,)).reshape(g.shape)
-            off += sz
+    for i, bucket in enumerate(plan):
+        # mxbkt<i> names the bucket in every op's HLO metadata: the
+        # device-trace walker (traceview) maps measured collective
+        # time back to bucket i by this scope — the only channel that
+        # survives into an XLA profile (BatchNorm stat psums and the
+        # loss pmean are name-identical otherwise) — and charges the
+        # pack/unpack (concat/slice) fusions to exchange overhead
+        # instead of forward compute
+        with jax.named_scope("mxbkt%03d" % i):
+            leaves = [grads[k] for k in bucket.keys]
+            flat = leaves[0].ravel() if len(leaves) == 1 else \
+                jnp.concatenate([g.ravel() for g in leaves])
+            if chain and anchor is not None:
+                # reductions issue in reverse-layer order, NCCL-stream
+                # style; the data dependency stops the all-reduce
+                # combiner from re-fusing the buckets into one op
+                flat, _ = lax.optimization_barrier((flat, anchor))
+            if impl == "ring" and n > 1:
+                red = ring_allreduce_flat(flat, axis_name, n)
+            elif hier:
+                red = hierarchical_reduce_flat(flat, axis_name, n,
+                                               int(local_n))
+            else:
+                red = lax.psum(flat, axis_name)
+            if mean and n > 1:
+                red = red * jnp.asarray(inv_n, dtype=red.dtype)
+            anchor = lax.slice(red, (0,), (1,))
+            off = 0
+            for key, g in zip(bucket.keys, leaves):
+                sz = g.size
+                out[key] = lax.slice(red, (off,),
+                                     (off + sz,)).reshape(g.shape)
+                off += sz
     return out
 
 

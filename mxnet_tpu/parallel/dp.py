@@ -288,6 +288,7 @@ def zero1_bucketed_update(grads, params, mom_shards, plan,
     bucket k's gather overlaps bucket k+1's scatter+update.  Returns
     ``({key: updated param}, [new momentum shards])``.
     """
+    import jax
     import jax.numpy as jnp
     from jax import lax
 
@@ -313,8 +314,11 @@ def zero1_bucketed_update(grads, params, mom_shards, plan,
         if chain and anchor is not None:
             # scatters issue in reverse layer order, NCCL-stream style
             flat_g, _ = lax.optimization_barrier((flat_g, anchor))
-        gsh = lax.psum_scatter(flat_g, axis_name,
-                               scatter_dimension=0, tiled=True)
+        # mxbkt<i>: bucket identity in the collective's HLO metadata —
+        # the traceview walker's only handle on which reduce is which
+        with jax.named_scope("mxbkt%03d" % bi):
+            gsh = lax.psum_scatter(flat_g, axis_name,
+                                   scatter_dimension=0, tiled=True)
         anchor = lax.slice(gsh, (0,), (1,))
         if mean_n > 1:
             gsh = gsh * jnp.asarray(1.0 / mean_n, gsh.dtype)
@@ -326,7 +330,8 @@ def zero1_bucketed_update(grads, params, mom_shards, plan,
         w_new, m_new = _opt.fused_sgd_mom_flat(
             wsh, gsh, mom_shards[bi], lr, momentum, wd)
         new_moms.append(m_new)
-        full = lax.all_gather(w_new, axis_name, tiled=True)
+        with jax.named_scope("mxbkt%03d" % bi):
+            full = lax.all_gather(w_new, axis_name, tiled=True)
         if pad:
             full = full[:size]
         off = 0
@@ -981,6 +986,8 @@ class FusedTrainStep:
         self._key_ctr += k
         from .. import profiler as _profiler
 
+        from .. import traceview as _traceview
+
         if _profiler.is_running():
             # profiling path: block on the dispatch so the span is the
             # step's DEVICE wall time — the lane io:* prefetch spans
@@ -988,9 +995,12 @@ class FusedTrainStep:
             # evidence); same block-when-profiling stance as the bulk
             # fit path's step timing
             t0 = _profiler._now_us()
-            new_params, self._moms, losses = runner(
-                params, self._moms, raw_data, raw_label, self._key_root,
-                ctr0)
+            with _traceview.step_window("FusedTrainStep", k=k) as _tvw:
+                new_params, self._moms, losses = runner(
+                    params, self._moms, raw_data, raw_label,
+                    self._key_root, ctr0)
+                if _tvw is not None:
+                    _tvw.block(losses)
             try:
                 jax.block_until_ready(losses)
             except Exception:
@@ -999,9 +1009,12 @@ class FusedTrainStep:
                                   t0, _profiler._now_us() - t0,
                                   cat="step")
         else:
-            new_params, self._moms, losses = runner(
-                params, self._moms, raw_data, raw_label, self._key_root,
-                ctr0)
+            with _traceview.step_window("FusedTrainStep", k=k) as _tvw:
+                new_params, self._moms, losses = runner(
+                    params, self._moms, raw_data, raw_label,
+                    self._key_root, ctr0)
+                if _tvw is not None:
+                    _tvw.block(losses)
         self._stamp_bucket_telemetry()
         self._param_vals = new_params
         for i, (p, v) in enumerate(zip(self._cells, new_params)):
@@ -1076,20 +1089,28 @@ class FusedTrainStep:
             self._key_gen = _random._generation
             self._key_ctr = 0
         self._key_ctr += 1
+        from .. import traceview as _traceview
+
         if self._sdc:
-            new_params, self._moms, loss, logits, rows = self._step(
-                params, self._moms, raw_data, raw_label,
-                self._key_root, self._key_ctr)
+            with _traceview.step_window("FusedTrainStep") as _tvw:
+                new_params, self._moms, loss, logits, rows = self._step(
+                    params, self._moms, raw_data, raw_label,
+                    self._key_root, self._key_ctr)
+                if _tvw is not None:
+                    _tvw.block(loss)
             self._last_sdc_rows = rows
             if self._key_ctr % self._sdc_n == 0:
                 # one tiny host read per cadence step; a corrupt
                 # device trips dump + exit 87 (supervised) inside
                 self._sdc_guard.check_rows(rows, step=self._key_ctr)
         else:
-            new_params, self._moms, loss, logits = self._step(
-                params, self._moms, raw_data, raw_label,
-                self._key_root, self._key_ctr
-            )
+            with _traceview.step_window("FusedTrainStep") as _tvw:
+                new_params, self._moms, loss, logits = self._step(
+                    params, self._moms, raw_data, raw_label,
+                    self._key_root, self._key_ctr
+                )
+                if _tvw is not None:
+                    _tvw.block(loss)
         self._stamp_bucket_telemetry()
         self._param_vals = new_params
         for i, (p, v) in enumerate(zip(self._cells, new_params)):

@@ -147,16 +147,17 @@ def set_state(state="stop"):
             _mem_peak = 0
             _t0 = time.perf_counter_ns()
             if _xla:
-                import jax
+                # traceview owns the ONE sanctioned jax.profiler site
+                # (mxlint MXL009) — this path routes through it
+                from .traceview import capture as _tvcap
 
-                jax.profiler.start_trace(_xla_dir or
-                                         os.path.splitext(_filename)[0] +
-                                         "_xla")
+                _tvcap.start_device_trace(
+                    _xla_dir or os.path.splitext(_filename)[0] + "_xla")
         elif state == "stop" and _state == "run":
             if _xla:
-                import jax
+                from .traceview import capture as _tvcap
 
-                jax.profiler.stop_trace()
+                _tvcap.stop_device_trace()
             stopped_run = True
         _state = state
     if stopped_run:
@@ -528,7 +529,43 @@ def summary(reset: bool = False) -> dict:
         out["counters"].setdefault(cat, {})[name] = {
             "count": int(count), "min": mn, "max": mx,
             "avg": total / count}
+    out["phases"] = _phase_table(out["spans"])
     return out
+
+
+def _phase_table(spans: dict) -> list:
+    """Per-phase rows [{phase, total_s, pct_of_step, p50_s, p99_s,
+    source}] — from traceview's MEASURED device attribution when this
+    process completed a capture, else plain span aggregation (one row
+    per span category, host-side wall)."""
+    try:
+        from . import traceview as _tv
+
+        tvs = _tv.last_summary()
+    except Exception:
+        tvs = None
+    if tvs:
+        rows = []
+        for phase, v in (tvs.get("phases") or {}).items():
+            rows.append({
+                "phase": phase, "total_s": v.get("total_s"),
+                "pct_of_step": v.get("pct_of_step"),
+                "p50_s": v.get("p50_s"), "p99_s": v.get("p99_s"),
+                "source": "trace"})
+        rows.sort(key=lambda r: -(r["total_s"] or 0.0))
+        return rows
+    step_total = sum(s["total_ms"]
+                     for s in (spans.get("step") or {}).values())
+    rows = []
+    for cat, names in spans.items():
+        tot_ms = sum(s["total_ms"] for s in names.values())
+        rows.append({
+            "phase": cat, "total_s": tot_ms / 1e3,
+            "pct_of_step": (tot_ms / step_total * 100.0)
+            if step_total else None,
+            "p50_s": None, "p99_s": None, "source": "spans"})
+    rows.sort(key=lambda r: -(r["total_s"] or 0.0))
+    return rows
 
 
 def dumps(reset: bool = False) -> str:

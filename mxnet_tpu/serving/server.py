@@ -371,6 +371,15 @@ class ModelServer:
 
         from .. import chaos as _chaos
 
+        from .. import traceview as _traceview
+
+        def _exec(rt_, data_):
+            with _traceview.step_window("serving.dispatch") as _tvw:
+                out_ = rt_.execute(data_)
+                if _tvw is not None:
+                    _tvw.block(out_)
+            return out_
+
         name = sm.runtime.name
         total = sum(r.n for r in live)
         with sm._lock:
@@ -388,7 +397,7 @@ class ModelServer:
                         raise ExecutorFailure(
                             "chaos bad_version injected for %r v%d"
                             % (name, rt.version))
-                    out = rt.execute(data)
+                    out = _exec(rt, data)
                 except Exception as ce:
                     # the canary never hurts callers: record the strike
                     # against the NEW version, then transparently
@@ -400,11 +409,11 @@ class ModelServer:
                         "— re-executing on stable v%d", rt.version,
                         name, ce, sm.runtime.version)
                     rt, is_canary = sm.runtime, False
-                    out = rt.execute(data)
+                    out = _exec(rt, data)
                 else:
                     self._record_version_result(sm, rt.version, ok=True)
             else:
-                out = rt.execute(data)
+                out = _exec(rt, data)
                 if sm.canary is not None:
                     self._record_version_result(sm, rt.version, ok=True)
             batch_s = time.monotonic() - t0

@@ -1,0 +1,165 @@
+"""CLI: attribute a captured trace, or replay the committed fixture.
+
+  python -m mxnet_tpu.traceview --self-test
+  python -m mxnet_tpu.traceview TRACE [--plan plan.json]
+                                [--flight dump.json] [-o summary.json]
+
+TRACE is a trace-event ``.json``/``.json.gz`` or a jax profiler dump
+dir.  Jax-free: runs anywhere the dumps land.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import parse
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixture_trace.json")
+
+
+def _close(a, b, rel=1e-6) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    return abs(a - b) <= rel * max(abs(a), abs(b), 1e-12)
+
+
+def self_test() -> int:
+    """Fixture trace -> golden attribution, plus the CPU-lane and
+    no-annotation fallback paths on synthetic events."""
+    n_ok = [0]
+
+    def ok(cond, what):
+        n_ok[0] += 1
+        if not cond:
+            print("traceview self-test FAILED: %s" % what)
+            raise SystemExit(1)
+
+    with open(FIXTURE) as f:
+        fx = json.load(f)
+    s = parse.attribute(fx["trace"], plan_meta=fx["plan_meta"],
+                        workload="fixture")
+    g = fx["golden"]
+    ok(s["format"] == parse.SUMMARY_FORMAT, "summary format")
+    ok(s["steps"]["n"] == g["n_steps"], "step count")
+    ok(_close(s["steps"]["mean_s"], g["step_mean_s"]), "step wall")
+    for phase, want in g["phases_mean_s"].items():
+        got = s["phases"][phase]["mean_s"]
+        ok(_close(got, want),
+           "phase %s mean %r != golden %r" % (phase, got, want))
+    ok(_close(s["phases"]["bucket_reduce"]["pct_of_step"],
+              g["pct_bucket_reduce"]), "bucket_reduce pct_of_step")
+    ok(_close(s["overlap"]["overlap_frac"], g["overlap_frac"]),
+       "overlap_frac %r != %r" % (s["overlap"]["overlap_frac"],
+                                  g["overlap_frac"]))
+    ok(_close(s["overlap"]["comm_s_per_step"], g["comm_s_per_step"]),
+       "comm_s_per_step")
+    ok(_close(s["overlap"]["overlapped_s_per_step"],
+              g["overlapped_s_per_step"]), "overlapped_s_per_step")
+    ok(len(s["buckets"]) == len(g["buckets"]), "bucket count")
+    for got, want in zip(s["buckets"], g["buckets"]):
+        for key in ("bucket",):
+            ok(got[key] == want[key], "bucket id")
+        for key in ("device_s_per_step", "occupancy", "measured_GBps"):
+            ok(_close(got[key], want[key]),
+               "bucket %d %s %r != %r"
+               % (want["bucket"], key, got[key], want[key]))
+    ok(s["plan_match"] is True, "plan_match")
+    ok(s["phases"]["forward"]["p50_s"] is not None, "p50 present")
+
+    # CPU-shaped lanes: thunk events keyed by (pid, tid), hlo_op args,
+    # no /device: process — and no step annotation (fallback window)
+    cpu = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 7, "tid": 0,
+         "args": {"name": "/host:CPU"}},
+        {"name": "fusion.1", "ph": "X", "pid": 7, "tid": 31,
+         "ts": 100.0, "dur": 50.0,
+         "args": {"hlo_op": "fusion.1", "hlo_module": "jit_f"}},
+        {"name": "all-reduce.1", "ph": "X", "pid": 7, "tid": 31,
+         "ts": 160.0, "dur": 40.0,
+         "args": {"hlo_op": "all-reduce.1", "hlo_module": "jit_f"}},
+        {"name": "fusion.1", "ph": "X", "pid": 7, "tid": 32,
+         "ts": 100.0, "dur": 50.0,
+         "args": {"hlo_op": "fusion.1", "hlo_module": "jit_f"}},
+        {"name": "all-reduce.1", "ph": "X", "pid": 7, "tid": 32,
+         "ts": 160.0, "dur": 40.0,
+         "args": {"hlo_op": "all-reduce.1", "hlo_module": "jit_f"}},
+    ]}
+    c = parse.attribute(cpu)
+    ok(c["n_lanes"] == 2, "CPU executor threads are distinct lanes")
+    ok(c["steps"]["n"] == 1, "fallback single window")
+    ok(_close(c["phases"]["bucket_reduce"]["mean_s"], 40e-6),
+       "CPU comm attribution")
+    ok(_close(c["phases"]["forward"]["mean_s"], 50e-6),
+       "CPU compute attribution (pre-comm -> forward)")
+    # serial executor: zero measured overlap is the honest number
+    ok(_close(c["overlap"]["overlap_frac"], 0.0), "CPU overlap 0")
+
+    # injected-stall tagging from flight entries rides into the summary
+    inj = parse.attribute(
+        fx["trace"], plan_meta=fx["plan_meta"],
+        flight_entries=[
+            {"op": "bucket_reduce", "seq": 0, "bucket": 0},
+            {"op": "bucket_reduce", "seq": 1, "bucket": 1,
+             "injected": True, "injected_kind": "delay_collective"}])
+    ok(inj["injected"]["events"] == 1, "injected count")
+    ok(inj["injected"]["kinds"] == ["delay_collective"], "injected kind")
+    ok(inj["buckets"][1]["injected_stall"] is True, "bucket tagged")
+    ok(inj["buckets"][0]["injected_stall"] is False, "bucket 0 clean")
+    ok(inj["flight_cross_check"]["issue_order_ascending"] is True,
+       "flight seq cross-check")
+
+    print("traceview self-test OK: %d check(s) over the fixture + "
+          "synthetic lanes" % n_ok[0])
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.traceview",
+        description="attribute an XLA device trace into step phases "
+                    "and per-bucket occupancy")
+    ap.add_argument("trace", nargs="?",
+                    help="trace-event json(.gz) or jax profiler dump dir")
+    ap.add_argument("--plan", help="bucket plan_meta JSON to match "
+                                   "collectives against")
+    ap.add_argument("--flight", help="flightrecorder_rank*.json dump "
+                                     "for the seq cross-check")
+    ap.add_argument("-o", "--out", help="write the summary JSON here "
+                                        "(default: stdout)")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if not args.trace:
+        ap.error("a trace path is required (or --self-test)")
+    trace = parse.load_trace(args.trace)
+    plan = None
+    if args.plan:
+        with open(args.plan) as f:
+            plan = json.load(f)
+    entries = None
+    if args.flight:
+        with open(args.flight) as f:
+            payload = json.load(f)
+        entries = payload.get("entries") or []
+        if plan is None:
+            plan = (payload.get("header") or {}).get("bucket_plan")
+    summary = parse.attribute(trace, plan_meta=plan,
+                              flight_entries=entries)
+    text = json.dumps(summary, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print("traceview: summary -> %s (%d device events, %d steps)"
+              % (args.out, summary["n_device_events"],
+                 summary["steps"]["n"]))
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

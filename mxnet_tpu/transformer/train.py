@@ -432,15 +432,23 @@ class TransformerTrainStep:
         if not self._built:
             self._build()
         tokens, labels = self._put_batch(tokens, labels)
+        from .. import traceview as _traceview
+
         if self._sdc:
             self._sdc_ctr += 1
-            (self._params, self._moms, loss,
-             self._last_sdc_rows) = self._step(
-                self._params, self._moms, tokens, labels,
-                self._sdc_ctr)
+            with _traceview.step_window("TransformerTrainStep") as _tvw:
+                (self._params, self._moms, loss,
+                 self._last_sdc_rows) = self._step(
+                    self._params, self._moms, tokens, labels,
+                    self._sdc_ctr)
+                if _tvw is not None:
+                    _tvw.block(loss)
         else:
-            self._params, self._moms, loss = self._step(
-                self._params, self._moms, tokens, labels)
+            with _traceview.step_window("TransformerTrainStep") as _tvw:
+                self._params, self._moms, loss = self._step(
+                    self._params, self._moms, tokens, labels)
+                if _tvw is not None:
+                    _tvw.block(loss)
         self._stamp_telemetry()
         return loss
 
@@ -465,8 +473,14 @@ class TransformerTrainStep:
         if runner is None:
             runner = self._multi_same_fn(k)
             self._multi_same[k] = runner
-        self._params, self._moms, losses = runner(
-            self._params, self._moms, tokens, labels)
+        from .. import traceview as _traceview
+
+        with _traceview.step_window("TransformerTrainStep",
+                                    k=k) as _tvw:
+            self._params, self._moms, losses = runner(
+                self._params, self._moms, tokens, labels)
+            if _tvw is not None:
+                _tvw.block(losses)
         for _ in range(k):
             self._stamp_telemetry()
         return losses

@@ -105,15 +105,24 @@ def is_supervisor_payload(payload: dict) -> bool:
                 and payload.get("elastic_supervisor"))
 
 
+def is_traceview_payload(payload: dict) -> bool:
+    """A traceview device-timeline summary
+    (``traceview_summary_rank{K}.json`` — mxnet_tpu/traceview)."""
+    return bool(isinstance(payload, dict)
+                and payload.get("format")
+                == "mxnet-tpu-traceview-summary")
+
+
 def load_health_inputs_ex(paths):
-    """Split input files into ``(flight_by_gen, traces, supervisor)``:
-    ``flight_by_gen`` maps generation → {rank: flight_payload} (an
-    elastic supervisor restarts the fleet with a bumped
-    MXNET_ELASTIC_GENERATION, so the SAME rank dumps once per
+    """Split input files into ``(flight_by_gen, traces, supervisor,
+    traceviews)``: ``flight_by_gen`` maps generation → {rank:
+    flight_payload} (an elastic supervisor restarts the fleet with a
+    bumped MXNET_ELASTIC_GENERATION, so the SAME rank dumps once per
     incarnation — duplicates are only an error within one generation),
     ``traces`` maps rank → trace payload, ``supervisor`` is the
-    supervisor's events journal (or None)."""
-    flight_by_gen, traces = {}, {}
+    supervisor's events journal (or None), ``traceviews`` maps rank →
+    traceview device-timeline summary."""
+    flight_by_gen, traces, traceviews = {}, {}, {}
     supervisor = None
     for idx, p in enumerate(paths):
         with open(p) as f:
@@ -130,19 +139,25 @@ def load_health_inputs_ex(paths):
                     "duplicate flight-recorder rank %d in generation "
                     "%d (%s)" % (rank, gen, p))
             by_rank[rank] = payload
+        elif is_traceview_payload(payload):
+            rank = int(payload.get("rank", rank_of(p, {}, idx)) or 0)
+            if rank in traceviews:
+                raise ValueError("duplicate traceview summary rank %d "
+                                 "(%s)" % (rank, p))
+            traceviews[rank] = payload
         else:
             rank = rank_of(p, payload, idx)
             if rank in traces:
                 raise ValueError("duplicate trace rank %d (%s)" % (rank, p))
             traces[rank] = payload
-    return flight_by_gen, traces, supervisor
+    return flight_by_gen, traces, supervisor, traceviews
 
 
 def load_health_inputs(paths):
     """Compatibility surface: ({rank: flight_payload} for the NEWEST
     generation, {rank: trace_payload}).  Single-generation inputs (no
     supervisor in play) behave exactly as before."""
-    flight_by_gen, traces, _sup = load_health_inputs_ex(paths)
+    flight_by_gen, traces, _sup, _tv = load_health_inputs_ex(paths)
     newest = max(flight_by_gen) if flight_by_gen else None
     return (flight_by_gen.get(newest, {}) if newest is not None
             else {}), traces
@@ -161,7 +176,8 @@ def _entry_brief(e):
     return {"seq": e.get("seq"), "op": e.get("op"),
             "bucket": e.get("bucket"), "keys": e.get("keys"),
             "bytes": e.get("bytes"), "dtype": e.get("dtype"),
-            "state": e.get("state")}
+            "state": e.get("state"),
+            "injected": bool(e.get("injected"))}
 
 
 def analyze_desync(flight):
@@ -297,6 +313,62 @@ def analyze_stragglers(traces, slow_factor: float = 1.25,
     return {"step_span": proxy, "fleet_p50_ms": fleet_p50,
             "slowest_rank": slowest, "flagged_ranks": sorted(flagged),
             "per_rank": per_rank}
+
+
+def analyze_phase_skew(traceviews, slow_factor: float = 1.5):
+    """Cross-rank skew over traceview device-timeline summaries: for
+    every step phase and every reduce bucket, compare each rank's
+    MEASURED per-step device seconds against the fleet median and name
+    the outlier ("rank 2 spends 2.1x fleet-median in bucket 5
+    reduce").  A rank whose summary recorded chaos-injected events is
+    still reported, but its findings are tagged ``injected`` — an
+    injected stall is the fault-injection campaign working, not a
+    hardware straggler, and it never flips the health verdict."""
+    if not traceviews:
+        return None
+    injected_ranks = {rank for rank, tv in traceviews.items()
+                      if (tv.get("injected") or {}).get("events")}
+    phases, buckets = {}, {}
+    for rank, tv in sorted(traceviews.items()):
+        for phase, v in (tv.get("phases") or {}).items():
+            # parse.py emits per_step_s as the per-step LIST and mean_s
+            # as the scalar; accept either shape (hand-rolled summaries
+            # may carry a scalar per_step_s)
+            s = v.get("mean_s")
+            if s is None:
+                s = v.get("per_step_s")
+                if isinstance(s, (list, tuple)):
+                    s = sum(s) / len(s) if s else None
+            if s is not None:
+                phases.setdefault(phase, {})[rank] = float(s)
+        for b in tv.get("buckets") or []:
+            s = b.get("device_s_per_step")
+            if s is not None and b.get("bucket") is not None:
+                buckets.setdefault(int(b["bucket"]), {})[rank] = float(s)
+    findings = []
+
+    def scan(kind, table):
+        for key, per_rank in sorted(table.items()):
+            if len(per_rank) < 2:
+                continue
+            med = _pct(sorted(per_rank.values()), 0.5)
+            if not med:
+                continue
+            for rank, s in sorted(per_rank.items()):
+                if s > slow_factor * med:
+                    findings.append({
+                        "rank": rank, "kind": kind,
+                        kind: key, "per_step_s": s,
+                        "fleet_median_s": med,
+                        "factor": round(s / med, 2),
+                        "injected": rank in injected_ranks})
+
+    scan("phase", phases)
+    scan("bucket", buckets)
+    return {"n_ranks": len(traceviews),
+            "injected_ranks": sorted(injected_ranks),
+            "findings": findings,
+            "detected": any(not f["injected"] for f in findings)}
 
 
 def _merge_intervals(intervals):
@@ -488,7 +560,8 @@ def analyze_generations(flight_by_gen, supervisor):
             "generations": {str(g): gens[g] for g in sorted(gens)}}
 
 
-def health_report(flight, traces, flight_by_gen=None, supervisor=None):
+def health_report(flight, traces, flight_by_gen=None, supervisor=None,
+                  traceviews=None):
     report = {"n_flight_dumps": len(flight), "n_trace_dumps": len(traces),
               "desync": analyze_desync(flight)}
     if flight:
@@ -504,6 +577,9 @@ def health_report(flight, traces, flight_by_gen=None, supervisor=None):
     io = analyze_io_overlap(traces)
     if io is not None:
         report["io_overlap"] = io
+    skew = analyze_phase_skew(traceviews or {})
+    if skew is not None:
+        report["phase_skew"] = skew
     return report
 
 
@@ -569,10 +645,12 @@ def format_health(report):
                 detail.append("bucket %s" % c["bucket"])
             if c.get("keys"):
                 detail.append("keys %s" % ",".join(map(str, c["keys"])))
+            label = "INJECTED STALL (chaos)" if c.get("injected") \
+                else "DESYNC"
             lines.append(
-                "DESYNC: rank %d never completed seq %d (%s%s) — "
+                "%s: rank %d never completed seq %d (%s%s) — "
                 "fleet reached seq %d, rank is %d behind"
-                % (lag["rank"], lag["stalled_at_seq"], where,
+                % (label, lag["rank"], lag["stalled_at_seq"], where,
                    (", " + ", ".join(detail)) if detail else "",
                    desync["max_completed_seq"], lag["behind_by"]))
     elif desync.get("ranks"):
@@ -614,17 +692,37 @@ def format_health(report):
                 % (rank, r["n_io_spans"], r["io_ms"],
                    r["io_overlap_ms"],
                    100.0 * r["prefetch_overlap_frac"]))
+    skew = report.get("phase_skew")
+    if skew:
+        lines.append("device-timeline summaries: %d rank(s)"
+                     % skew["n_ranks"])
+        for f in skew["findings"]:
+            where = ("bucket %d reduce" % f["bucket"]
+                     if f["kind"] == "bucket" else f["phase"])
+            head = "INJECTED STALL (chaos)" if f["injected"] \
+                else "PHASE SKEW"
+            lines.append(
+                "%s: rank %d spends %.1fx fleet-median in %s "
+                "(%.6fs vs %.6fs per step)%s"
+                % (head, f["rank"], f["factor"], where,
+                   f["per_step_s"], f["fleet_median_s"],
+                   " — chaos-injected, not a hardware straggler"
+                   if f["injected"] else ""))
+        if not skew["findings"]:
+            lines.append("no cross-rank phase skew")
     return lines
 
 
 def run_health(paths, out_path=None) -> int:
-    flight_by_gen, traces, supervisor = load_health_inputs_ex(paths)
+    (flight_by_gen, traces, supervisor,
+     traceviews) = load_health_inputs_ex(paths)
     # desync/dead-peer/plan analysis judges the NEWEST incarnation —
     # cross-generation seq comparison is meaningless by construction
     newest = max(flight_by_gen) if flight_by_gen else None
     flight = flight_by_gen.get(newest, {}) if newest is not None else {}
     report = health_report(flight, traces, flight_by_gen=flight_by_gen,
-                           supervisor=supervisor)
+                           supervisor=supervisor,
+                           traceviews=traceviews)
     for line in format_health(report):
         print(line)
     if out_path:
@@ -638,10 +736,18 @@ def run_health(paths, out_path=None) -> int:
     # judge the NEWEST incarnation: a fleet the supervisor already
     # restarted healthy IS healthy (the timeline still tells the
     # story) — unless the supervisor itself gave up (budget).
-    unhealthy = report["desync"].get("detected") or \
+    # A lag whose stalled collective carries the chaos injected tag is
+    # the fault-injection campaign working (satellite of the traceview
+    # PR: the tag replaces timing heuristics) — report it loudly as
+    # INJECTED STALL but do NOT fail health on it.
+    desync_real = report["desync"].get("detected") and any(
+        not (lag.get("collective") or {}).get("injected")
+        for lag in report["desync"].get("laggards", []))
+    unhealthy = desync_real or \
         report.get("bucket_plans", {}).get("mismatch") or \
         report.get("dead_peers", {}).get("detected") or \
-        report.get("elastic", {}).get("budget_exhausted")
+        report.get("elastic", {}).get("budget_exhausted") or \
+        report.get("phase_skew", {}).get("detected")
     return 2 if unhealthy else 0
 
 
@@ -847,7 +953,8 @@ def self_test() -> int:
         sup_path = os.path.join(gen_dir, "supervisor_events.json")
         with open(sup_path, "w") as f:
             json.dump(sup_events, f)
-        fbg, tr, sup = load_health_inputs_ex([g0a, g0b, g1a, sup_path])
+        fbg, tr, sup, _tv = load_health_inputs_ex(
+            [g0a, g0b, g1a, sup_path])
         assert set(fbg) == {0, 1} and set(fbg[0]) == {0, 1} \
             and set(fbg[1]) == {0}, fbg
         assert sup is not None and not tr
@@ -876,6 +983,81 @@ def self_test() -> int:
         # the compat surface still answers with the NEWEST generation
         fl, _tr = load_health_inputs([g0a, g0b, g1a, sup_path])
         assert set(fl) == {0}, fl
+
+        # --health over traceview summaries: rank 2 spends 2.1x the
+        # fleet median in bucket 5's reduce — the skew analysis names
+        # the rank AND the bucket from MEASURED device time
+        def tv_summary(rank, slow=1.0, injected=0):
+            return {
+                "format": "mxnet-tpu-traceview-summary", "version": 1,
+                "rank": rank, "workload": "FusedTrainStep",
+                "steps": {"n": 3, "mean_s": 0.01},
+                "phases": {
+                    "backward": {"per_step_s": 0.004},
+                    "bucket_reduce": {"per_step_s": 0.001 * slow},
+                },
+                "buckets": [
+                    {"bucket": b, "device_s_per_step":
+                     0.0002 * (slow if b == 5 else 1.0)}
+                    for b in range(6)],
+                "injected": {"events": injected,
+                             "kinds": ["delay_collective"]
+                             if injected else []},
+            }
+
+        tv_paths = []
+        for rank in range(3):
+            p = os.path.join(d, "traceview_summary_rank%d.json" % rank)
+            with open(p, "w") as f:
+                json.dump(tv_summary(rank, slow=2.1 if rank == 2
+                                     else 1.0), f)
+            tv_paths.append(p)
+        _fbg, _tr2, _sup2, tvs = load_health_inputs_ex(tv_paths)
+        assert set(tvs) == {0, 1, 2}, tvs
+        skew = analyze_phase_skew(tvs)
+        assert skew["detected"], skew
+        kinds = {(f["kind"], f.get("bucket"), f["rank"])
+                 for f in skew["findings"]}
+        assert ("bucket", 5, 2) in kinds, skew["findings"]
+        assert all(f["rank"] == 2 for f in skew["findings"])
+        report = health_report({}, {}, traceviews=tvs)
+        text = "\n".join(format_health(report))
+        assert "rank 2 spends 2.1x fleet-median in bucket 5 reduce" \
+            in text, text
+        rc = run_health(tv_paths)
+        assert rc == 2, rc  # a real straggler fails health
+        # the SAME skew with the chaos injected tag: reported as an
+        # INJECTED STALL, health verdict stays green
+        with open(tv_paths[2], "w") as f:
+            json.dump(tv_summary(2, slow=2.1, injected=4), f)
+        _fbg, _tr2, _sup2, tvs = load_health_inputs_ex(tv_paths)
+        skew = analyze_phase_skew(tvs)
+        assert not skew["detected"] and skew["findings"], skew
+        assert skew["injected_ranks"] == [2], skew
+        text = "\n".join(format_health(
+            health_report({}, {}, traceviews=tvs)))
+        assert "INJECTED STALL (chaos): rank 2" in text, text
+        assert "not a hardware straggler" in text, text
+        rc = run_health(tv_paths)
+        assert rc == 0, rc
+        # an injected flight-recorder stall is labeled, not
+        # misattributed: rank 1 stuck inside a chaos-delayed collective
+        f1_inj = os.path.join(d, "inj_flightrecorder_rank1.json")
+        with open(os.path.join(d, "flightrecorder_rank1.json")) as f:
+            inj_payload = json.load(f)
+        for e in inj_payload["entries"]:
+            if e.get("state") == "suspect":
+                e["injected"] = True
+                e["injected_kind"] = "delay_collective"
+        with open(f1_inj, "w") as f:
+            json.dump(inj_payload, f)
+        flight2, _ = load_health_inputs([f0, f1_inj])
+        report2 = health_report(flight2, {})
+        (lag2,) = report2["desync"]["laggards"]
+        assert lag2["collective"]["injected"], lag2
+        text2 = "\n".join(format_health(report2))
+        assert "INJECTED STALL (chaos): rank 1 never completed seq 12" \
+            in text2, text2
     print("merge_traces self-test OK")
     return 0
 

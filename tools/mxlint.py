@@ -59,6 +59,16 @@ the fact (recompile storms, config typos, hot-loop host syncs):
                                ``*decode_worker*`` / ``*io_worker*``
                                and functions passed as ``iter_fn`` to
                                InputPipeline/ShardedDecodePool.
+  MXL009 rogue-device-trace    direct ``jax.profiler.start_trace`` /
+                               ``stop_trace`` / ``trace`` /
+                               ``TraceAnnotation`` outside
+                               mxnet_tpu/traceview/: the traceview
+                               capture wrapper is the ONE sanctioned
+                               XLA device-trace site — a second trace
+                               session corrupts (or silently drops)
+                               the armed capture, and ad-hoc
+                               annotations bypass the step-window
+                               naming the attribution walker keys on.
 
 Pure-AST: imports NOTHING from the package (the env registry is read
 by parsing mxnet_tpu/env.py's ``register(...)`` calls), so it lints a
@@ -101,12 +111,21 @@ CODES = {
     "MXL008": "numeric-literal exit code outside the sanctioned exit "
               "sites (the 83-87/137 taxonomy is load-bearing for the "
               "supervisor — exit through the named constants)",
+    "MXL009": "direct jax.profiler trace call outside "
+              "mxnet_tpu/traceview/ (the one sanctioned device-trace "
+              "capture site)",
 }
 
 # files whose exit codes ARE the taxonomy: the documented contract
 # lives there, everything else must exit through its named constants
 SANCTIONED_EXIT_RE = re.compile(
     r"mxnet_tpu[/\\](diagnostics\.py$|elastic[/\\]|serving[/\\])")
+
+# the ONE sanctioned jax.profiler device-trace site (MXL009)
+SANCTIONED_TRACE_RE = re.compile(r"mxnet_tpu[/\\]traceview[/\\]")
+# jax.profiler attributes that open/annotate an XLA device trace
+TRACE_PROFILER_ATTRS = {"start_trace", "stop_trace", "trace",
+                        "TraceAnnotation", "StepTraceAnnotation"}
 
 # decode-worker entry points by naming convention
 WORKER_NAME_RE = re.compile(r"(_worker_main$|decode_worker|io_worker)")
@@ -218,6 +237,8 @@ class ModuleLinter:
         self.worker_fns = self._collect_worker_fns()
         self.sanctioned_exit = bool(
             SANCTIONED_EXIT_RE.search(os.path.abspath(path)))
+        self.sanctioned_trace = bool(
+            SANCTIONED_TRACE_RE.search(os.path.abspath(path)))
 
     # -- pass 1: which local functions get traced by jax? --------------
     def _collect_traced_fns(self) -> Set[str]:
@@ -406,6 +427,28 @@ class ModuleLinter:
                       "named constant" % (".".join(chain), a.value),
                       ".".join(fn_stack) or "<module>")
 
+    def _check_trace_call(self, node: ast.Call, fn_stack: List[str]
+                          ) -> None:
+        """MXL009: ``jax.profiler.start_trace/stop_trace/trace/
+        TraceAnnotation`` outside mxnet_tpu/traceview/.  The capture
+        wrapper there is the one sanctioned device-trace site — route
+        through ``traceview.capture`` (or ``traceview.step_window``)
+        so a second profiler session can never corrupt an armed
+        capture."""
+        if self.sanctioned_trace:
+            return
+        chain = _dotted(node.func)
+        if len(chain) < 3 or chain[-3] != "jax" \
+                or chain[-2] != "profiler" \
+                or chain[-1] not in TRACE_PROFILER_ATTRS:
+            return
+        self._add(node, "MXL009",
+                  "%s: direct jax.profiler trace call outside "
+                  "mxnet_tpu/traceview/ — route through "
+                  "traceview.capture (the one sanctioned device-trace "
+                  "site)" % ".".join(chain),
+                  ".".join(fn_stack) or "<module>")
+
     def _check_bare_except(self, node: ast.Try, fn_stack: List[str]
                            ) -> None:
         scope = ".".join(fn_stack) or "<module>"
@@ -449,6 +492,7 @@ class ModuleLinter:
                 if worker:
                     self._check_worker_call(child, fn_stack)
                 self._check_exit_call(child, fn_stack)
+                self._check_trace_call(child, fn_stack)
             if isinstance(child, ast.Try):
                 self._check_bare_except(child, fn_stack)
             self._walk(child, c_stack, c_traced, c_loop, c_worker)
@@ -540,6 +584,9 @@ def start_pool():
 
 def give_up():
     sys.exit(86)                                           # 008
+
+def rogue_trace(d):
+    jax.profiler.start_trace(d)                            # 009
 EXIT_CUSTOM = 99
 def die_hard(ok):
     if ok:
@@ -550,7 +597,8 @@ def die_hard(ok):
 '''
 
 EXPECT_SELF_TEST = {"MXL001": 1, "MXL002": 2, "MXL003": 2, "MXL004": 2,
-                    "MXL005": 1, "MXL006": 1, "MXL007": 3, "MXL008": 2}
+                    "MXL005": 1, "MXL006": 1, "MXL007": 3, "MXL008": 2,
+                    "MXL009": 1}
 
 
 def self_test() -> int:
