@@ -1156,6 +1156,155 @@ def _memory_probe(batch=16, bulk_k=2, img=128):
     return rec
 
 
+def bench_large_batch_remat(per_probe_timeout=420):
+    """ISSUE 17 row: effective batch >= 128 bf16 training UNDER the HBM
+    ceiling — per-stage remat (MXNET_REMAT_POLICY=stage) plus microbatch
+    gradient accumulation (accum_steps) so the compiled step sees the
+    full batch while only one microbatch's residuals are ever live.
+    The probe also audits the remat plan against its no-remat twin
+    (same net, same accumulation, policy=none): the traced program's
+    peak live residual bytes must DROP, or the row says so."""
+    out = {"pipeline": "large_batch_remat (MXNET_REMAT_POLICY=stage + "
+                       "grad accumulation)"}
+    env = dict(os.environ)
+    env["MXNET_REMAT_POLICY"] = "stage"
+    env.setdefault("MXNET_RECOMPILE_WARN_N", "0")
+    try:
+        proc = _tracked_run(
+            [sys.executable, "-c",
+             "import bench; import json; "
+             "print('LBR', json.dumps(bench._large_batch_probe()))"],
+            text=True, timeout=per_probe_timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        out["error"] = "probe timeout (%ds)" % per_probe_timeout
+        return out
+    rec = None
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("LBR "):
+            rec = json.loads(ln[4:])
+    if rec is None:
+        out["error"] = (proc.stdout + proc.stderr)[-400:]
+    else:
+        out.update(rec)
+    return out
+
+
+def _large_batch_probe(model=None, batch=None, accum=None, img=None,
+                       bulk_k=None):
+    """Child-process body for bench_large_batch_remat: one bf16 train
+    config at effective batch >= 128 under the ACTIVE MXNET_REMAT_POLICY
+    with microbatch accumulation; reports throughput, mfu, the
+    prefusion-bytes/HBM ratio and the auditor's remat-vs-twin peak
+    residual evidence."""
+    model = model or ("resnet18_v1" if _SMOKE else "resnet50_v1")
+    batch = batch or 128
+    accum = accum or 4
+    img = img or (32 if _SMOKE else BENCH_IMG)
+    bulk_k = bulk_k or (1 if _SMOKE else 4)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import diagnostics as _diag
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.dp import FusedTrainStep
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    import jax
+
+    def build(policy, accum_steps):
+        os.environ["MXNET_REMAT_POLICY"] = policy
+        net = vision.get_model(model, classes=1000)
+        net.initialize(mx.init.Xavier())
+        mesh = make_mesh((1,), ("dp",), jax.devices()[:1])
+        return FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              mesh=mesh, learning_rate=0.05, momentum=0.9,
+                              dtype="bfloat16", accum_steps=accum_steps)
+
+    policy = os.environ.get("MXNET_REMAT_POLICY", "stage")
+    step = build(policy, accum)
+    X = nd.random.uniform(shape=(batch, 3, img, img))
+    y = nd.array(np.random.randint(0, 1000, batch).astype("float32"))
+    sps = _time_step(step, X, y, bulk_k, windows=2)
+    rec = {"model": model, "img": img, "dtype": "bfloat16",
+           "effective_batch": batch, "grad_accum_steps": accum,
+           "microbatch": batch // accum, "bulk_steps": bulk_k,
+           "remat_policy": policy,
+           "images_per_sec_per_chip": round(batch / sps, 2)}
+    peak, _kind = _peak()
+    alg = ALG_GFLOPS.get(model)
+    if alg and peak:
+        rec["mfu"] = round(alg * 1e9 * _TRAIN_FACTOR * batch / sps / peak,
+                           4)
+    _flops, bytes_acc = _step_flops(step, X, y, bulk_k)
+    hbm = _peak_hbm()
+    if bytes_acc and hbm:
+        ratio = bytes_acc / sps / hbm
+        rec["prefusion_bytes_over_hbm_peak"] = round(ratio, 3)
+        rec["hbm_ceiling_ok"] = bool(ratio <= 1.0)
+    # compiled-program peak (same XLA memory analysis _memory_probe uses)
+    try:
+        raw = jax.device_put(X._data.astype("bfloat16"), step._data_sh)
+        lab = jax.device_put(y._data, step._data_sh)
+        compiled = step._multi_step_same[bulk_k].lower(
+            step._param_vals, step._moms, raw, lab,
+            step._key_root, step._key_ctr).compile()
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            rec["peak_bytes"] = int(getattr(ma, "temp_size_in_bytes", 0) +
+                                    getattr(ma, "output_size_in_bytes", 0))
+    except Exception as exc:
+        rec["peak_bytes_error"] = repr(exc)
+    # auditor evidence: the DEPLOYED program (accum scan) must actually
+    # rematerialize (remat eqns in its trace), and the remat plan must
+    # beat its no-remat twin on peak live residual bytes.  The peak
+    # comparison traces the SINGLE-STEP full-batch grad program
+    # (accum=1) under policy vs none — at that level the per-stage
+    # checkpoint eqns sit in the walked eqn sequence, so the liveness
+    # walk sees boundaries-only vs every conv intermediate; under the
+    # accum scan the whole microbatch grad is one atomic eqn and the
+    # delta is invisible.  Trace-only on all sides: no twin compiles.
+    try:
+        from mxnet_tpu.analysis import auditor as _aud
+
+        name = "FusedTrainStep.multi_step_same[k=%d]" % bulk_k
+        fn, specs, smeta = _diag.recorded_steps()[name]
+        _f, ameta = _aud.audit_step(
+            fn, specs, site="bench.large_batch_remat",
+            compute_dtype="bfloat16",
+            remat_policy=smeta.get("remat_policy"))
+
+        def _single_step_peak(pol):
+            # same arg structure as multi_step_same (params, moms,
+            # data, label, key, ctr) — the recorded specs fit exactly
+            t = build(pol, 1)
+            t._build(X)
+            _ff, m = _aud.audit_step(
+                t._step, specs,
+                site="bench.large_batch_remat.%s" % pol,
+                compute_dtype="bfloat16", remat_policy=pol)
+            return m.get("peak_live_bytes")
+
+        p = _single_step_peak(policy)
+        tp = _single_step_peak("none")
+        rec["remat_evidence"] = {
+            "n_remat_eqns": ameta.get("n_remat_eqns"),
+            "basis": "single-step full-batch (bs=%d) grad program, "
+                     "policy=%s vs none" % (batch, policy),
+            "peak_live_bytes": p,
+            "twin_peak_live_bytes": tp,
+            "residual_bytes_saved": (tp - p) if p and tp else None,
+            "peak_drop_frac": round(1.0 - p / tp, 4) if p and tp else
+            None,
+            "effective": bool(p and tp and p < tp),
+        }
+    except Exception as exc:
+        rec["remat_evidence"] = {"error": repr(exc)}
+    finally:
+        os.environ["MXNET_REMAT_POLICY"] = policy
+    return rec
+
+
 def _overlap_block_from_summary(summary):
     """The BENCH ``overlap_measured`` block from a traceview
     attribution summary: phase breakdown, per-bucket collective
@@ -1296,12 +1445,27 @@ def refresh_overlap_measured(path=None, steps=3):
 # Cumulative result state + signal-safe final emit: an external timeout
 # can truncate the run but can never erase completed rows.
 # --------------------------------------------------------------------
+class _BudgetSkip(RuntimeError):
+    """A phase gate declined to START the phase (deadline budget spent,
+    or smoke mode).  Distinct from a failure: the final artifact records
+    ``{"skipped": reason}`` for the slot (the PR 4 skip convention)
+    instead of an ``error`` block a dashboard would page on."""
+
+
 _STATE = {
     "table": [], "io": None, "fit_loop": None, "bare_jax": [],
     "memory": None, "mfu_attribution": None, "serving": None,
     "transformer": None, "overlap_measured": None,
+    "large_batch_remat": None,
     "headline": None, "peak": None, "kind": None, "emitted": False,
 }
+
+#: phase slots whose None must never reach the JSON as a bare null —
+#: a phase that NEVER STARTED (watchdog/deadline fired first) emits the
+#: same {"skipped": reason} shape a gated phase does
+_PHASE_SLOTS = ("io", "fit_loop", "memory", "mfu_attribution",
+                "serving", "transformer", "overlap_measured",
+                "large_batch_remat")
 
 
 def _emit_final(reason=None):
@@ -1334,7 +1498,12 @@ def _emit_final(reason=None):
         "serving": _STATE["serving"],
         "transformer": _STATE["transformer"],
         "overlap_measured": _STATE["overlap_measured"],
+        "large_batch_remat": _STATE["large_batch_remat"],
     }
+    for slot in _PHASE_SLOTS:
+        if out.get(slot) is None:
+            out[slot] = {"skipped": "phase did not run (deadline/"
+                                    "watchdog reached first)"}
     # which reduction schedule produced these numbers: the bucketing
     # config + the last bucket plan the FusedTrainStep runs stamped into
     # the flight-recorder header (diagnostics.py) — BENCH artifacts are
@@ -1691,8 +1860,8 @@ def _phase_fit(elapsed, left):
 
     try:
         if left() < 90:
-            raise RuntimeError("time budget spent before fit row "
-                               "(elapsed %.0fs)" % elapsed())
+            raise _BudgetSkip("time budget spent before fit row "
+                              "(elapsed %.0fs)" % elapsed())
         # rung 1 (mandatory): 64 px comparator — cheapest program that
         # still answers the dispatch-overhead question
         expr64 = "*bench.bench_fit_with_comparator(64, batch=8, " \
@@ -1749,6 +1918,9 @@ def _phase_fit(elapsed, left):
                 _STATE["fit_loop"]["fullsize"] = {
                     "skipped": "%d px compile exceeded its window "
                                "(64 px row stands)" % img}
+    except _BudgetSkip as exc:
+        _STATE["fit_loop"] = {"pipeline": "Module.fit",
+                              "skipped": str(exc)}
     except subprocess.TimeoutExpired as exc:
         _STATE["fit_loop"] = {"pipeline": "Module.fit",
                               "error": "timeout: %r" % (exc,)}
@@ -1790,10 +1962,13 @@ def main():
     # two bounded probe subprocesses, cheap shapes) --------------------
     try:
         if left() < 180:
-            raise RuntimeError("time budget spent before memory row "
-                               "(elapsed %.0fs)" % elapsed())
+            raise _BudgetSkip("time budget spent before memory row "
+                              "(elapsed %.0fs)" % elapsed())
         _STATE["memory"] = bench_memory_remat(
             per_probe_timeout=min(300, max(120, left() / 5)))
+    except _BudgetSkip as exc:
+        _STATE["memory"] = {"pipeline": "memory/remat",
+                            "skipped": str(exc)}
     except Exception as exc:
         _STATE["memory"] = {"pipeline": "memory/remat", "error": repr(exc)}
     _progress({"memory": _STATE["memory"]})
@@ -1812,9 +1987,11 @@ def main():
     # item-1 acceptance line; in-process, CPU-cheap, budget-gated) ----
     try:
         if left() < 60:
-            raise RuntimeError("time budget spent before serving row "
-                               "(elapsed %.0fs)" % elapsed())
+            raise _BudgetSkip("time budget spent before serving row "
+                              "(elapsed %.0fs)" % elapsed())
         _STATE["serving"] = bench_serving()
+    except _BudgetSkip as exc:
+        _STATE["serving"] = {"pipeline": "serving", "skipped": str(exc)}
     except Exception as exc:
         _STATE["serving"] = {"pipeline": "serving", "error": repr(exc)}
     _progress({"serving": _STATE["serving"]})
@@ -1823,10 +2000,13 @@ def main():
     # downsized dims + the ZeRO-1 per-rank memory block) --------------
     try:
         if left() < 120:
-            raise RuntimeError("time budget spent before transformer "
-                               "row (elapsed %.0fs)" % elapsed())
+            raise _BudgetSkip("time budget spent before transformer "
+                              "row (elapsed %.0fs)" % elapsed())
         _STATE["transformer"] = bench_transformer(
             windows=2 if left() < 300 else 3)
+    except _BudgetSkip as exc:
+        _STATE["transformer"] = {"pipeline": "transformer_lm",
+                                 "skipped": str(exc)}
     except Exception as exc:
         _STATE["transformer"] = {"pipeline": "transformer_lm",
                                  "error": repr(exc)}
@@ -1840,9 +2020,12 @@ def main():
     # which are SIMULATION-derived and labeled source=simulated. ------
     try:
         if left() < 90:
-            raise RuntimeError("time budget spent before overlap "
-                               "capture (elapsed %.0fs)" % elapsed())
+            raise _BudgetSkip("time budget spent before overlap "
+                              "capture (elapsed %.0fs)" % elapsed())
         _STATE["overlap_measured"] = bench_overlap_measured()
+    except _BudgetSkip as exc:
+        _STATE["overlap_measured"] = {"pipeline": "overlap_measured",
+                                      "skipped": str(exc)}
     except Exception as exc:
         fb = {"error": repr(exc)}
         try:
@@ -1865,6 +2048,24 @@ def main():
         _STATE["overlap_measured"] = fb
     _progress({"overlap_measured": _STATE["overlap_measured"]})
 
+    # ---- phase 3f: large-batch remat row (ISSUE 17 tentpole — bf16 at
+    # effective batch >= 128 UNDER the HBM ceiling: per-stage remat +
+    # microbatch gradient accumulation, with the auditor's peak-live-
+    # residual evidence vs the no-remat twin) -------------------------
+    try:
+        if left() < 150:
+            raise _BudgetSkip("time budget spent before large-batch "
+                              "remat row (elapsed %.0fs)" % elapsed())
+        _STATE["large_batch_remat"] = bench_large_batch_remat(
+            per_probe_timeout=min(420, max(150, left() / 3)))
+    except _BudgetSkip as exc:
+        _STATE["large_batch_remat"] = {"pipeline": "large_batch_remat",
+                                       "skipped": str(exc)}
+    except Exception as exc:
+        _STATE["large_batch_remat"] = {"pipeline": "large_batch_remat",
+                                       "error": repr(exc)}
+    _progress({"large_batch_remat": _STATE["large_batch_remat"]})
+
     # io comparator: the bf16@32 headline row
     io_compute_ref, io_ref_label = None, None
     for r in _STATE["table"]:
@@ -1877,14 +2078,17 @@ def main():
     # ---- phase 4: decomposed IO row ---------------------------------
     try:
         if _SMOKE:
-            raise RuntimeError("BENCH_SMOKE=1: io row skipped")
+            raise _BudgetSkip("BENCH_SMOKE=1: io row skipped")
         if left() < DEADLINE_S * 0.30:
-            raise RuntimeError("time budget spent before io row "
-                               "(elapsed %.0fs)" % elapsed())
+            raise _BudgetSkip("time budget spent before io row "
+                              "(elapsed %.0fs)" % elapsed())
         _STATE["io"] = bench_recordio_input(
             compute_ips=io_compute_ref, compute_dtype="bfloat16", batch=64)
         if io_ref_label:
             _STATE["io"]["compute_ref"] = io_ref_label
+    except _BudgetSkip as exc:
+        _STATE["io"] = {"pipeline": "ImageRecordIter->train",
+                        "skipped": str(exc)}
     except Exception as exc:  # never lose the run to an IO failure
         _STATE["io"] = {"pipeline": "ImageRecordIter->train",
                         "error": repr(exc)}
@@ -1923,9 +2127,9 @@ def main():
     # bandwidth, not framework or input shapes. ------------------------
     try:
         if _SMOKE:
-            raise RuntimeError("BENCH_SMOKE=1: attribution row skipped")
+            raise _BudgetSkip("BENCH_SMOKE=1: attribution row skipped")
         if elapsed() > DEADLINE_S * 0.82:
-            raise RuntimeError("budget spent before attribution row")
+            raise _BudgetSkip("budget spent before attribution row")
         sps_nobn = _bare_resnet_sec_per_step(
             "resnet50_v1", 32, "bfloat16", 48, windows=2, bn_mode="none")
         nobn_ips = 32.0 / sps_nobn
@@ -1953,6 +2157,8 @@ def main():
                     bf16_row["prefusion_bytes_over_hbm_peak"]
         _STATE["mfu_attribution"] = attr
         _progress({"mfu_attribution": attr})
+    except _BudgetSkip as exc:
+        _STATE["mfu_attribution"] = {"skipped": str(exc)}
     except Exception as exc:
         _STATE["mfu_attribution"] = {"error": repr(exc)}
 

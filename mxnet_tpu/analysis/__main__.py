@@ -54,14 +54,34 @@ def _self_test(args) -> int:
     if meta.get("n_collectives", 0) < 1:
         failures.append("clean step signature missed its psum")
 
+    # 6. remat effectiveness: the declared-but-inert policy is flagged;
+    # the real per-stage plan shows remat eqns AND a lower residual peak
+    expect("noop_remat", auditor.check_remat_effectiveness(
+        fixtures.noop_remat_jaxpr(), "fixture.noop_remat", "stage"),
+        "remat-effectiveness")
+    remat_jx, twin_jx = fixtures.remat_twin_jaxprs()
+    if auditor.count_remat_eqns(remat_jx) < 3:
+        failures.append("remat twin: expected >=3 remat eqns, got %d"
+                        % auditor.count_remat_eqns(remat_jx))
+    peak, twin_peak = (auditor.peak_live_bytes(remat_jx),
+                       auditor.peak_live_bytes(twin_jx))
+    if not peak < twin_peak:
+        failures.append("remat twin: peak live bytes did not drop "
+                        "(%d >= %d)" % (peak, twin_peak))
+    if auditor.check_remat_effectiveness(
+            remat_jx, "fixture.remat_twin", "stage", twin_jaxpr=twin_jx):
+        failures.append("effective remat plan wrongly flagged")
+
     if failures:
         print("analysis self-test FAILED:")
         for f in failures:
             print("  -", f)
         return 1
-    print("analysis self-test OK: 4 seeded violations flagged, clean "
-          "step passed (%d eqns, %d collectives)"
-          % (meta.get("n_eqns", 0), meta.get("n_collectives", 0)))
+    print("analysis self-test OK: 5 seeded violations flagged, clean "
+          "step passed (%d eqns, %d collectives), remat twin peak "
+          "%d -> %d bytes" % (meta.get("n_eqns", 0),
+                              meta.get("n_collectives", 0),
+                              twin_peak, peak))
     return 0
 
 

@@ -53,9 +53,10 @@ from typing import (Any, Dict, Iterable, Iterator, List, Mapping,
 __all__ = [
     "Finding", "AuditReport", "iter_eqns", "collective_signature",
     "check_collective_uniformity", "check_bucket_plan", "check_donation",
-    "check_dtype", "check_host_sync", "audit_step",
+    "check_dtype", "check_host_sync", "check_remat_effectiveness",
+    "count_remat_eqns", "peak_live_bytes", "audit_step",
     "audit_recorded_steps", "load_baseline", "apply_baseline",
-    "DEFAULT_BASELINE",
+    "DEFAULT_BASELINE", "REMAT_PRIMS",
 ]
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
@@ -73,6 +74,11 @@ HOST_SYNC_PRIMS = frozenset({
 })
 # the MXU heavyweights whose dtype decides throughput
 MXU_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+
+# rematerialization wrappers a declared remat policy must leave in the
+# traced program ("remat2" is the jax.checkpoint primitive on current
+# JAX; the older spellings keep the check portable)
+REMAT_PRIMS = frozenset({"remat2", "remat", "checkpoint"})
 
 WIDE_DTYPES = ("float32", "float64")
 
@@ -345,6 +351,127 @@ def check_host_sync(jaxpr, site: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# check 5: remat effectiveness
+# ---------------------------------------------------------------------------
+def count_remat_eqns(jaxpr) -> int:
+    """Number of rematerialization wrapper eqns anywhere in the program
+    (``jax.checkpoint`` traces to one ``remat2`` eqn per wrapped call
+    site, including under scan/pjit/shard_map)."""
+    return sum(1 for eqn in iter_eqns(jaxpr)
+               if eqn.primitive.name in REMAT_PRIMS)
+
+
+def _descend_single_wrapper(jaxpr):
+    """Unwrap single-eqn wrapper layers (a jitted fn traces to one
+    outer pjit eqn; shard_map adds another) so the liveness walk sees
+    the real program body instead of one atomic eqn."""
+    for _ in range(8):
+        if hasattr(jaxpr, "jaxpr"):   # ClosedJaxpr at any layer
+            jaxpr = jaxpr.jaxpr
+        if len(jaxpr.eqns) != 1:
+            return jaxpr
+        inner = [sub for v in jaxpr.eqns[0].params.values()
+                 for sub in _inner_jaxprs(v)]
+        if len(inner) != 1:
+            return jaxpr
+        jaxpr = inner[0]
+    return jaxpr if not hasattr(jaxpr, "jaxpr") else jaxpr.jaxpr
+
+
+def peak_live_bytes(jaxpr) -> int:
+    """Peak bytes simultaneously live across the program's top-level
+    eqn sequence — the static proxy for residual memory.
+
+    Liveness walk: a value is live from the eqn that produces it (or
+    program entry, for inputs) to its last consumer; nested eqns
+    (scan/remat/pjit bodies) are atomic, so values internal to a
+    ``jax.checkpoint`` region never count.  That is exactly the remat
+    contract: under a ``stage`` policy only stage-boundary values cross
+    eqn boundaries, and this peak drops accordingly vs the no-remat
+    twin (a DECLARED policy that leaves the peak unchanged did
+    nothing).  Not an XLA allocator model — fusion/scheduling shift
+    absolute numbers — but the remat-vs-twin DELTA is real."""
+    jaxpr = _descend_single_wrapper(jaxpr)
+    eqns = jaxpr.eqns
+    n = len(eqns)
+    last: Dict[Any, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if not hasattr(v, "val"):   # skip Literals
+                last[v] = i
+    for v in jaxpr.outvars:
+        if not hasattr(v, "val"):
+            last[v] = n
+    alive = set()
+    live = 0
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        if v in last and v not in alive:
+            alive.add(v)
+            live += _nbytes(v.aval)
+    peak = live
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            if getattr(v, "aval", None) is not None \
+                    and last.get(v, -1) > i and v not in alive:
+                alive.add(v)
+                live += _nbytes(v.aval)
+        peak = max(peak, live)
+        for v in eqn.invars:
+            if not hasattr(v, "val") and v in alive \
+                    and last.get(v) == i:
+                alive.remove(v)
+                live -= _nbytes(v.aval)
+    return peak
+
+
+def check_remat_effectiveness(jaxpr, site: str,
+                              remat_policy: Optional[str],
+                              twin_jaxpr=None) -> List[Finding]:
+    """A DECLARED remat policy must change the traced program.
+
+    Two layers of evidence: (a) a policy other than ``none`` must leave
+    remat wrapper eqns in the jaxpr — zero means the policy silently
+    matched nothing (wrong scope string, a model without the marked
+    blocks, an exporter that dropped the markers) and the run will OOM
+    exactly where the operator believes it cannot; (b) when the caller
+    supplies the no-remat ``twin_jaxpr`` (same step traced under
+    ``none``), the policy must REDUCE the top-level peak live bytes —
+    wrappers that re-save every intermediate are as useless as no
+    wrappers."""
+    if not remat_policy or remat_policy == "none":
+        return []
+    findings: List[Finding] = []
+    n_remat = count_remat_eqns(jaxpr)
+    if n_remat == 0:
+        findings.append(Finding(
+            "remat-effectiveness", "error", site,
+            "remat policy %r is declared but the traced program "
+            "contains no remat eqns — the policy matched nothing and "
+            "every activation is still a live residual (the config "
+            "will OOM at exactly the batch the policy was meant to "
+            "unlock)" % remat_policy,
+            {"fingerprint_key": "no-op-remat:%s" % remat_policy,
+             "remat_policy": remat_policy, "n_remat_eqns": 0}))
+        return findings
+    if twin_jaxpr is not None:
+        peak = peak_live_bytes(jaxpr)
+        twin_peak = peak_live_bytes(twin_jaxpr)
+        if peak >= twin_peak:
+            findings.append(Finding(
+                "remat-effectiveness", "error", site,
+                "remat policy %r leaves %d remat eqn(s) in the program "
+                "but peak live residual bytes did not drop vs the "
+                "no-remat twin (%d >= %d) — the wraps re-save every "
+                "intermediate instead of trading memory for recompute"
+                % (remat_policy, n_remat, peak, twin_peak),
+                {"fingerprint_key": "ineffective-remat:%s" % remat_policy,
+                 "remat_policy": remat_policy, "n_remat_eqns": n_remat,
+                 "peak_live_bytes": peak,
+                 "twin_peak_live_bytes": twin_peak}))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # audit drivers
 # ---------------------------------------------------------------------------
 class AuditReport:
@@ -405,17 +532,21 @@ def apply_baseline(findings: Iterable[Finding], baseline: set
 def audit_step(fn, specs: Sequence, *, site: str,
                plan_meta: Optional[Mapping] = None,
                compute_dtype: Optional[str] = None,
+               remat_policy: Optional[str] = None,
                n_traces: int = 2,
                donation_min_bytes: int = DONATION_MIN_BYTES
                ) -> Tuple[List[Finding], Dict[str, Any]]:
-    """Run all four checks on one compiled step.
+    """Run all five checks on one compiled step.
 
     ``fn`` is the jitted callable (or diagnostics' instrumented
     wrapper), ``specs`` the abstract call args (ShapeDtypeStructs —
     what ``diagnostics.recorded_steps()`` captured).  ``n_traces``
     independent re-traces feed the uniformity check: a trace whose
     collective order depends on ambient state (dict ordering, env,
-    time) cannot produce identical schedules twice.
+    time) cannot produce identical schedules twice.  ``remat_policy``
+    (the step's declared policy, from step meta) arms the
+    remat-effectiveness check; the twin comparison needs an explicit
+    :func:`check_remat_effectiveness` call with both programs.
     """
     import jax
 
@@ -432,10 +563,13 @@ def audit_step(fn, specs: Sequence, *, site: str,
     findings += check_host_sync(jaxpr, site)
     if compute_dtype is not None:
         findings += check_dtype(jaxpr, site, compute_dtype)
+    findings += check_remat_effectiveness(jaxpr, site, remat_policy)
 
     meta: Dict[str, Any] = {
         "n_eqns": sum(1 for _ in iter_eqns(jaxpr)),
         "n_collectives": len(collective_signature(jaxpr)),
+        "n_remat_eqns": count_remat_eqns(jaxpr),
+        "peak_live_bytes": peak_live_bytes(jaxpr),
     }
     try:
         lowered = fn.lower(*specs)
@@ -477,6 +611,7 @@ def audit_recorded_steps(names: Optional[Sequence[str]] = None,
                 # live step)
                 plan_meta=step_meta.get("bucket_plan"),
                 compute_dtype=dtype,
+                remat_policy=step_meta.get("remat_policy"),
                 donation_min_bytes=donation_min_bytes)
         except Exception as exc:
             report.sites[name] = {"audit_error": repr(exc)}

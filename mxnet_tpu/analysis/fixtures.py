@@ -15,6 +15,7 @@ from typing import Dict
 __all__ = [
     "rank_dependent_traces", "undonated_lowered", "donated_lowered",
     "upcast_jaxpr", "host_sync_jaxpr", "clean_step", "UNDONATED_BYTES",
+    "remat_twin_jaxprs", "noop_remat_jaxpr",
 ]
 
 UNDONATED_BYTES = 100 * 1024 * 1024  # the planted 100MB param
@@ -128,6 +129,49 @@ def host_sync_jaxpr():
         return out
 
     return jax.make_jaxpr(steps)(jax.ShapeDtypeStruct((4,), np.float32))
+
+
+def _stage_chain_grad(checkpoint_stages):
+    """Gradient program over a 6-layer matmul chain, optionally with
+    each 2-layer 'stage' under ``jax.checkpoint`` — the minimal
+    stand-in for a conv-stage remat plan.  Without checkpoints every
+    layer activation is a live backward residual; with them only the 3
+    stage boundaries survive the forward sweep."""
+    import jax
+    import jax.numpy as jnp
+
+    def stage(x, w1, w2):
+        return jnp.tanh(jnp.tanh(x @ w1) @ w2)
+
+    def loss(x, ws):
+        for i in range(0, 6, 2):
+            f = stage if not checkpoint_stages else \
+                jax.checkpoint(stage)
+            x = f(x, ws[i], ws[i + 1])
+        return jnp.sum(x)
+
+    def grad_fn(x, ws):
+        return jax.grad(loss, argnums=1)(x, ws)
+
+    x = jax.ShapeDtypeStruct((64, 256), jnp.float32)
+    ws = [jax.ShapeDtypeStruct((256, 256), jnp.float32)] * 6
+    return jax.make_jaxpr(grad_fn)(x, ws)
+
+
+def remat_twin_jaxprs():
+    """(remat_jaxpr, twin_jaxpr): the SAME stage-chain gradient traced
+    with per-stage ``jax.checkpoint`` and without.  The remat program
+    must carry remat eqns AND a strictly lower top-level peak of live
+    residual bytes — the effectiveness evidence the auditor demands of
+    a real remat plan."""
+    return _stage_chain_grad(True), _stage_chain_grad(False)
+
+
+def noop_remat_jaxpr():
+    """A program whose builder DECLARED a remat policy but whose trace
+    contains no remat eqns (the policy string matched no block — the
+    planted no-op): check_remat_effectiveness must flag it."""
+    return _stage_chain_grad(False)
 
 
 def clean_step():

@@ -134,12 +134,23 @@ def tune(model: TimingModel, *, chips: int = 256,
          coll_latency_s: float = DEFAULT_COLL_LATENCY_S,
          caps_mib: Sequence[int] = CAPS_MIB,
          first_fracs: Sequence[float] = FIRST_FRACS,
-         last_mults: Sequence[int] = LAST_MULTS) -> Dict:
+         last_mults: Sequence[int] = LAST_MULTS,
+         accum_steps: Optional[int] = None) -> Dict:
     """Sweep the cap ladder and return the tuned-plan dict (the JSON
     ``plan.save_plan`` persists and ``buckets.plan_with_tuning``
-    consumes)."""
+    consumes).
+
+    ``accum_steps`` (default: the MXNET_GRAD_ACCUM_STEPS env, via
+    remat.grad_accum_steps) makes the scoring accum-aware: under
+    microbatch accumulation every bucket is only issueable during the
+    LAST microbatch's backward (((A-1)+share)/A readiness), so the
+    sweep stops rewarding small early buckets for overlap windows the
+    accumulated schedule does not have."""
     from ..parallel import buckets as _buckets
     from ..parallel import scaling as _scaling
+    from ..remat import grad_accum_steps as _accum
+
+    accum = _accum(accum_steps)
 
     step = step_time_s if step_time_s is not None else model.step_time_s
     if step is None or step <= 0:
@@ -175,7 +186,7 @@ def tune(model: TimingModel, *, chips: int = 256,
         rec_sim = _scaling.simulate_bucketed_overlap(
             [b for b, _dt in model.units], step, chips, bw,
             backward_frac, coll_latency_s=coll_latency_s,
-            readiness="bytes")
+            readiness="bytes", accum_steps=accum)
         o_sim = rec_sim["overlap"]
         if o_sim < 1.0:
             exposure_scale = (1.0 - float(o_meas)) / (1.0 - o_sim)
@@ -184,7 +195,8 @@ def tune(model: TimingModel, *, chips: int = 256,
     def score(bucket_bytes):
         sim = _scaling.simulate_bucketed_overlap(
             bucket_bytes, step, chips, bw, backward_frac,
-            coll_latency_s=coll_latency_s, readiness="bytes")
+            coll_latency_s=coll_latency_s, readiness="bytes",
+            accum_steps=accum)
         exposed = sim["exposed_s"]
         if exposure_scale is not None:
             exposed = exposed * exposure_scale
@@ -221,6 +233,7 @@ def tune(model: TimingModel, *, chips: int = 256,
         "coll_latency_s": coll_latency_s,
         "readiness": "bytes",
         "step_time_s": step,
+        "grad_accum_steps": accum,
     }
     if exposure_scale is not None:
         assumptions["overlap_calibration"] = {
@@ -231,7 +244,7 @@ def tune(model: TimingModel, *, chips: int = 256,
     projection = _scaling.project_efficiency_bucketed(
         best["bucket_bytes"], step, ici_GBps=bw,
         backward_frac=backward_frac, coll_latency_s=coll_latency_s,
-        readiness="bytes")
+        readiness="bytes", accum_steps=accum)
     return {
         "format": "mxnet-tpu-autotune-plan",
         "version": 1,

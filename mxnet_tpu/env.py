@@ -200,9 +200,22 @@ register("MXNET_BACKWARD_DO_MIRROR", "bool", False,
          "Keep only conv/matmul residuals and rematerialize cheap "
          "activations in backward (jax.checkpoint mirror policy).")
 register("MXNET_REMAT_POLICY", "str", "none",
-         "Per-block rematerialization for the transformer workload "
-         "tier: 'none', 'block' (keep only block-boundary residuals) "
-         "or 'attention' (recompute just the attention sub-graph).")
+         "Per-scope rematerialization policy (one string, shared "
+         "registry across workload tiers): 'none'; transformer tier "
+         "'block' (keep only block-boundary residuals) or 'attention' "
+         "(recompute just the attention sub-graph); conv tier 'stage' "
+         "(each resnet stage reruns in backward, only stage-boundary "
+         "activations stay live) or 'conv_block' (each residual unit "
+         "— finer boundaries, more kept, less recompute).")
+register("MXNET_GRAD_ACCUM_STEPS", "int", 1,
+         "Microbatch gradient accumulation inside the compiled step: "
+         "the dispatch batch splits into this many microbatches, a "
+         "lax.scan runs forward+backward per microbatch accumulating "
+         "gradients (per-bucket flats on the bucketed/ZeRO-1 paths), "
+         "and ONE bucketed reduce + fused update runs after the scan "
+         "— effective batch = dispatch batch at one microbatch's "
+         "activation memory.  1 disables (byte-identical step "
+         "program).  Must divide the per-device batch.")
 
 # transformer/ — decoder-only LM workload tier
 register("MXNET_ATTENTION_IMPL", "str", "flash",

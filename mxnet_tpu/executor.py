@@ -22,6 +22,7 @@ per iteration, matching the reference's cached-opr fast path
 from __future__ import annotations
 
 import functools
+import re
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as _np
@@ -85,6 +86,124 @@ def place_nodes(symbol, default_ctx: Context,
 
 
 # ---------------------------------------------------------------------------
+# scoped remat on the symbol path (MXNET_REMAT_POLICY=stage/conv_block)
+# ---------------------------------------------------------------------------
+_STAGE_RE = re.compile(r"(stage\d+)_")
+
+
+def _stage_keys(topo):
+    """Per-node stage key for remat segmentation, or None (boundary).
+
+    A node's stage is read from its own name (hand-written symbols name
+    ops ``stage1_unit1_conv1``), else from the stage prefix of its
+    parameter variables (gluon-exported symbols carry it only on param
+    names, ``...stage1_conv0_weight``), else inherited from its
+    producers when they agree (relu/pool/add between parameterized
+    nodes).  Parameterized nodes whose params carry no stage (stem
+    conv, FC head) or mix stages are boundaries."""
+    key_of: Dict[int, Optional[str]] = {}
+    for node in topo:
+        m = _STAGE_RE.search(node.name or "")
+        if node.is_variable:
+            key_of[id(node)] = m.group(1) if m else None
+            continue
+        if m:
+            key_of[id(node)] = m.group(1)
+            continue
+        var_in = [p for p, _ in node.inputs if p.is_variable]
+        vkeys = {key_of[id(p)] for p in var_in} - {None}
+        if len(vkeys) == 1:
+            key_of[id(node)] = vkeys.pop()
+        elif vkeys or var_in:
+            key_of[id(node)] = None
+        else:
+            akeys = {key_of[id(p)] for p, _ in node.inputs} - {None}
+            key_of[id(node)] = akeys.pop() if len(akeys) == 1 else None
+    return key_of
+
+
+class _RematSegment:
+    """One contiguous same-stage run of op nodes, executed under ONE
+    ``jax.checkpoint``: only the values crossing the segment boundary
+    (``in_refs`` consumed from outside, ``out_refs`` exported to
+    outside or to the graph outputs, plus aux-state writebacks) survive
+    as backward residuals — everything inside is rematerialized."""
+
+    __slots__ = ("key", "nodes", "node_ids", "in_refs", "out_refs",
+                 "aux_out_names")
+
+    def __init__(self, key, nodes):
+        self.key = key
+        self.nodes = nodes
+        self.node_ids = {id(n) for n in nodes}
+        self.in_refs: List[Tuple[int, int]] = []
+        self.out_refs: List[Tuple[int, int]] = []
+        self.aux_out_names: List[str] = []
+
+
+def _remat_plan(topo, flat_outputs, aux_names):
+    """Segment the topo order into ('node', n) / ('seg', _RematSegment)
+    entries covering every non-variable node, or None when the graph
+    carries no stage structure (then the plain inline loop runs).
+    Correct for ANY grouping — each segment threads its exact boundary
+    values — so an imperfect name heuristic only costs memory, never
+    numerics."""
+    key_of = _stage_keys(topo)
+    op_nodes = [n for n in topo if not n.is_variable]
+    if not any(key_of[id(n)] for n in op_nodes):
+        return None
+    runs: List[Tuple[Optional[str], List[Any]]] = []
+    for n in op_nodes:
+        k = key_of[id(n)]
+        if runs and runs[-1][0] == k:
+            runs[-1][1].append(n)
+        else:
+            runs.append((k, [n]))
+    # global consumer map: which op nodes read each (producer, out_idx)
+    consumers: Dict[Tuple[int, int], set] = {}
+    for n in op_nodes:
+        for p, oi in n.inputs:
+            consumers.setdefault((id(p), oi), set()).add(id(n))
+    out_positions = {(id(n), oi) for n, oi in flat_outputs}
+    from .ops import registry as _reg
+
+    plan: List[Tuple[str, Any]] = []
+    for k, nodes in runs:
+        if k is None or len(nodes) < 2:
+            plan.extend(("node", n) for n in nodes)
+            continue
+        seg = _RematSegment(k, nodes)
+        seen_in = set()
+        aux_out = set()
+        for n in nodes:
+            for p, oi in n.inputs:
+                ref = (id(p), oi)
+                if (p.is_variable or id(p) not in seg.node_ids) \
+                        and ref not in seen_in:
+                    seen_in.add(ref)
+                    seg.in_refs.append(ref)
+            for pos in _reg.get(n.op).mutate_aux:
+                if pos < len(n.inputs):
+                    parent, _ = n.inputs[pos]
+                    if parent.is_variable and parent.name in aux_names:
+                        aux_out.add(parent.name)
+        seg.aux_out_names = sorted(aux_out)
+        pos_of = {id(n): i for i, n in enumerate(nodes)}
+        exported = set()
+        for (pid, oi), readers in consumers.items():
+            if pid in seg.node_ids and readers - seg.node_ids:
+                exported.add((pid, oi))
+        for pid, oi in out_positions:
+            if pid in seg.node_ids:
+                exported.add((pid, oi))
+        seg.out_refs = sorted(exported, key=lambda r: (pos_of[r[0]], r[1]))
+        plan.append(("seg", seg))
+    if not any(kind == "seg" for kind, _ in plan):
+        return None
+    return plan
+
+
+# ---------------------------------------------------------------------------
 # pure graph evaluator
 # ---------------------------------------------------------------------------
 def build_graph_eval(symbol, collect_internals: bool = False,
@@ -112,27 +231,65 @@ def build_graph_eval(symbol, collect_internals: bool = False,
 
     node_index = {id(n): i for i, n in enumerate(topo)}
 
+    # scoped remat (MXNET_REMAT_POLICY=stage/conv_block): segment the
+    # graph by stage and run each segment under jax.checkpoint.  The
+    # monitor tap needs every internal alive, and placed graphs run
+    # op-by-op on their own devices — both keep the inline loop.  On
+    # the symbol path residual units share one stage prefix, so both
+    # conv policies checkpoint at stage granularity.
+    remat_plan = None
+    if not collect_internals and placement is None:
+        from .remat import CONV_SCOPES, remat_policy
+
+        if remat_policy() in CONV_SCOPES:
+            remat_plan = _remat_plan(topo, flat_outputs, aux_names)
+
+    def apply_node(node, args, rng_key, training):
+        """One op node → (visible outputs, [(aux name, value)])."""
+        op = _op_registry.get(node.op)
+        params = {k: _op_registry.coerce_attr(v)
+                  for k, v in node.attrs.items()
+                  if not k.startswith("__")}
+        if op.train_aware:
+            params["_training"] = training
+        if op.rng:
+            args = [jax.random.fold_in(rng_key, node_index[id(node)])] + args
+        out = op.fn(*args, **params)
+        outs = list(out) if isinstance(out, tuple) else [out]
+        if op.nondiff:
+            # the reference registers NO gradient for these ops
+            # (MultiBoxTarget, samplers, ...): jax must not
+            # differentiate through their internals — argmax/where/
+            # division inside target-assignment produces NaN
+            # cotangents that poison every upstream gradient
+            outs = [jax.lax.stop_gradient(o) for o in outs]
+        n_vis = len(outs) - len(op.mutate_aux)
+        # aux writebacks route to the feeding variable's name
+        aux_writes = []
+        for k, pos in enumerate(op.mutate_aux):
+            if pos < len(node.inputs):
+                parent, _ = node.inputs[pos]
+                if parent.is_variable and parent.name in aux_names:
+                    aux_writes.append((parent.name, outs[n_vis + k]))
+        return outs[:n_vis], aux_writes
+
     def eval_fn(arg_vals: Dict[str, Any], aux_vals: Dict[str, Any], rng_key,
                 training: bool):
         env: Dict[int, List[Any]] = {}
         aux_updates: Dict[str, Any] = {}
         internals: Dict[str, Any] = {}
         for node in topo:
-            if node.is_variable:
-                if node.name in aux_vals:
-                    val = aux_vals[node.name]
-                elif node.name in arg_vals:
-                    val = arg_vals[node.name]
-                else:
-                    raise MXNetError("unbound variable %r" % node.name)
-                env[id(node)] = [val]
+            if not node.is_variable:
                 continue
-            op = _op_registry.get(node.op)
-            params = {k: _op_registry.coerce_attr(v)
-                      for k, v in node.attrs.items()
-                      if not k.startswith("__")}
-            if op.train_aware:
-                params["_training"] = training
+            if node.name in aux_vals:
+                val = aux_vals[node.name]
+            elif node.name in arg_vals:
+                val = arg_vals[node.name]
+            else:
+                raise MXNetError("unbound variable %r" % node.name)
+            env[id(node)] = [val]
+
+        def run_inline(node):
             args = [env[id(p)][oi] for p, oi in node.inputs]
             if placement is not None:
                 # pin every input to the node's device: cross-group edges
@@ -143,29 +300,50 @@ def build_graph_eval(symbol, collect_internals: bool = False,
                 # set_params)
                 dev = placement[id(node)].jax_device()
                 args = [jax.device_put(a, dev) for a in args]
-            if op.rng:
-                args = [jax.random.fold_in(rng_key, node_index[id(node)])] + args
-            out = op.fn(*args, **params)
-            outs = list(out) if isinstance(out, tuple) else [out]
-            if op.nondiff:
-                # the reference registers NO gradient for these ops
-                # (MultiBoxTarget, samplers, ...): jax must not
-                # differentiate through their internals — argmax/where/
-                # division inside target-assignment produces NaN
-                # cotangents that poison every upstream gradient
-                outs = [jax.lax.stop_gradient(o) for o in outs]
-            n_vis = len(outs) - len(op.mutate_aux)
-            env[id(node)] = outs[:n_vis]
+            outs, aux_writes = apply_node(node, args, rng_key, training)
+            env[id(node)] = outs
             if collect_internals:
-                for k in range(n_vis):
-                    suffix = "_output" if n_vis == 1 else "_output%d" % k
+                for k in range(len(outs)):
+                    suffix = "_output" if len(outs) == 1 else "_output%d" % k
                     internals[node.name + suffix] = outs[k]
-            # aux writebacks route to the feeding variable's name
-            for k, pos in enumerate(op.mutate_aux):
-                if pos < len(node.inputs):
-                    parent, _ = node.inputs[pos]
-                    if parent.is_variable and parent.name in aux_names:
-                        aux_updates[parent.name] = outs[n_vis + k]
+            for name, val in aux_writes:
+                aux_updates[name] = val
+
+        def run_segment(seg):
+            ext = [env[pid][oi] for pid, oi in seg.in_refs]
+
+            def seg_fn(key, *ext_vals):
+                local = dict(zip(seg.in_refs, ext_vals))
+                aux_up = {}
+                for node in seg.nodes:
+                    args = [local[(id(p), oi)] for p, oi in node.inputs]
+                    outs, aux_writes = apply_node(node, args, key, training)
+                    for oi, v in enumerate(outs):
+                        local[(id(node), oi)] = v
+                    for name, val in aux_writes:
+                        aux_up[name] = val
+                return (tuple(local[r] for r in seg.out_refs),
+                        tuple(aux_up[n] for n in seg.aux_out_names))
+
+            outs, auxs = jax.checkpoint(seg_fn)(rng_key, *ext)
+            for (pid, oi), v in zip(seg.out_refs, outs):
+                slot = env.setdefault(pid, [])
+                while len(slot) <= oi:
+                    slot.append(None)
+                slot[oi] = v
+            for name, v in zip(seg.aux_out_names, auxs):
+                aux_updates[name] = v
+
+        if remat_plan is None:
+            for node in topo:
+                if not node.is_variable:
+                    run_inline(node)
+        else:
+            for kind, item in remat_plan:
+                if kind == "node":
+                    run_inline(item)
+                else:
+                    run_segment(item)
         outputs = [env[id(n)][oi] for n, oi in flat_outputs]
         if collect_internals:
             return outputs, aux_updates, internals

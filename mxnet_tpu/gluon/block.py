@@ -199,6 +199,17 @@ class Block:
 
     # -- execution ------------------------------------------------------
     def __call__(self, *args):
+        # scoped remat (MXNET_REMAT_POLICY=stage/conv_block): blocks that
+        # declare a ``_remat_scope`` (resnet stages / residual units) get
+        # their forward wrapped in jax.checkpoint when traced under a
+        # CachedOp — eager/settle calls fall through untouched
+        scope = getattr(self, "_remat_scope", None)
+        if scope is not None:
+            from ..remat import checkpoint_block_call
+
+            out = checkpoint_block_call(self, scope, args)
+            if out is not NotImplemented:
+                return out
         return self.forward(*args)
 
     def forward(self, *args):

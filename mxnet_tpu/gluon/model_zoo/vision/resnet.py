@@ -23,6 +23,8 @@ def _conv3x3(channels, stride, in_channels):
 
 
 class BasicBlockV1(HybridBlock):
+    _remat_scope = "conv_block"  # MXNET_REMAT_POLICY=conv_block boundary
+
     def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
@@ -48,6 +50,8 @@ class BasicBlockV1(HybridBlock):
 
 
 class BottleneckV1(HybridBlock):
+    _remat_scope = "conv_block"
+
     def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
         super().__init__(**kwargs)
         self.body = nn.HybridSequential(prefix="")
@@ -76,6 +80,8 @@ class BottleneckV1(HybridBlock):
 
 
 class BasicBlockV2(HybridBlock):
+    _remat_scope = "conv_block"
+
     def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
         super().__init__(**kwargs)
         self.bn1 = nn.BatchNorm()
@@ -102,6 +108,8 @@ class BasicBlockV2(HybridBlock):
 
 
 class BottleneckV2(HybridBlock):
+    _remat_scope = "conv_block"
+
     def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
         super().__init__(**kwargs)
         self.bn1 = nn.BatchNorm()
@@ -160,6 +168,10 @@ class ResNetV1(HybridBlock):
     def _make_layer(self, block, layers, channels, stride, stage_index,
                     in_channels=0):
         layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
+        # remat boundary (MXNET_REMAT_POLICY=stage): only this
+        # sequential's input/output activations survive as backward
+        # residuals; everything inside is rematerialized
+        layer._remat_scope = "stage"
         with layer.name_scope():
             layer.add(block(channels, stride, channels != in_channels,
                             in_channels=in_channels, prefix=""))

@@ -348,10 +348,15 @@ class BulkTrainLoop:
         # dispatch (jnp.stack), nothing else holds it, so the program
         # reuses K batches of HBM as scratch instead of holding them
         # alongside its intermediates
+        from ..remat import remat_policy as _remat_policy
+
         self._bulk_fn = _diag.instrument_jit(
             "Module.bulk_fit",
             jax.jit(bulk, donate_argnums=(0, 1, 2, 3)),
-            meta={"bucket_plan": plan_meta_v})
+            meta={"bucket_plan": plan_meta_v,
+                  # auditor parity with FusedTrainStep: the declared
+                  # policy is cross-checked against the traced program
+                  "remat_policy": _remat_policy()})
         self._n_outs = n_outs
         self._built = True
 

@@ -309,11 +309,23 @@ def host_local_count(mesh) -> Optional[int]:
         return None
 
 
+def pack_flats(grads: Mapping, plan: Sequence[Bucket]) -> List:
+    """Pack ``grads`` (``{key: array}``) into one flat buffer per
+    bucket, in plan order — the exact concat layout
+    :func:`bucketed_reduce` reduces and the ZeRO-1 schedule scatters.
+    The accumulation scan carries these buffers instead of the per-key
+    tree so microbatch sums land directly in reduce layout."""
+    from .. import optimizer as _opt
+
+    return [_opt.pack_flat([grads[k] for k in b.keys]) for b in plan]
+
+
 def bucketed_reduce(grads: Mapping, plan: Sequence[Bucket],
                     axis_name: str, *, n: int, mean: bool = False,
                     chain: Optional[bool] = None,
                     impl: Optional[str] = None,
-                    local_n: Optional[int] = None) -> Dict:
+                    local_n: Optional[int] = None,
+                    flats: Optional[Sequence] = None) -> Dict:
     """Reduce ``grads`` (``{key: local array}``) bucket by bucket over
     ``axis_name`` inside shard_map; returns ``{key: reduced array}``.
 
@@ -321,7 +333,10 @@ def bucketed_reduce(grads: Mapping, plan: Sequence[Bucket],
     a global-mean loss); each bucket is one flat concat → one reduction
     op; consecutive buckets chain via optimization_barrier.  ``impl``
     'hierarchical' needs ``local_n`` (host_local_count(mesh)); an
-    unqualified topology falls back to the flat psum.
+    unqualified topology falls back to the flat psum.  ``flats``
+    (pre-packed per-bucket buffers from :func:`pack_flats` — the
+    accumulation scan's carry) skips the concat; ``grads`` then only
+    supplies the per-key shapes for the unpack.
     """
     import jax
     import jax.numpy as jnp
@@ -346,8 +361,11 @@ def bucketed_reduce(grads: Mapping, plan: Sequence[Bucket],
         # instead of forward compute
         with jax.named_scope("mxbkt%03d" % i):
             leaves = [grads[k] for k in bucket.keys]
-            flat = leaves[0].ravel() if len(leaves) == 1 else \
-                jnp.concatenate([g.ravel() for g in leaves])
+            if flats is not None:
+                flat = flats[i]
+            else:
+                flat = leaves[0].ravel() if len(leaves) == 1 else \
+                    jnp.concatenate([g.ravel() for g in leaves])
             if chain and anchor is not None:
                 # reductions issue in reverse-layer order, NCCL-stream
                 # style; the data dependency stops the all-reduce

@@ -273,7 +273,8 @@ def zero1_tree_to_flats(tree, plan, n: int):
 
 def zero1_bucketed_update(grads, params, mom_shards, plan,
                           axis_name: str, n: int, *, lr, momentum, wd,
-                          mean_n=None, sp_axis=None, chain=None):
+                          mean_n=None, sp_axis=None, chain=None,
+                          flats=None):
     """One ZeRO-1 step over the bucket plan, inside shard_map.
 
     ``grads``/``params``: ``{key: local array}`` (grads are this
@@ -287,6 +288,11 @@ def zero1_bucketed_update(grads, params, mom_shards, plan,
     the replicated reduction schedule; gathers ride the dataflow, so
     bucket k's gather overlaps bucket k+1's scatter+update.  Returns
     ``({key: updated param}, [new momentum shards])``.
+
+    ``flats`` (per-bucket pre-packed gradient buffers,
+    :func:`buckets.pack_flats` layout — the accumulation scan's carry)
+    replaces the concat; ``grads`` may then be None and ``params``
+    supplies the per-key unpack shapes.
     """
     import jax
     import jax.numpy as jnp
@@ -303,8 +309,10 @@ def zero1_bucketed_update(grads, params, mom_shards, plan,
     new_moms = []
     anchor = None
     for bi, bucket in enumerate(plan):
-        leaves = [grads[k] for k in bucket.keys]
-        flat_g = _opt.pack_flat(leaves)
+        leaves = [(grads if flats is None else params)[k]
+                  for k in bucket.keys]
+        flat_g = flats[bi] if flats is not None \
+            else _opt.pack_flat(leaves)
         size = flat_g.shape[0]
         pad = (-size) % n
         if pad:
@@ -408,7 +416,7 @@ class FusedTrainStep:
     def __init__(self, block, loss_fn, mesh=None, learning_rate=0.05,
                  momentum=0.9, weight_decay=0.0, param_spec_fn=None,
                  dtype=None, bucket_bytes=None, fused_update=True,
-                 zero_stage=None):
+                 zero_stage=None, accum_steps=None):
         jax = _jax()
         self.mesh = mesh if mesh is not None else make_mesh((1,), ("dp",),
                                                             jax.devices()[:1])
@@ -429,6 +437,9 @@ class FusedTrainStep:
         self._fused_update = bool(fused_update)
         # ZeRO stage: None = MXNET_ZERO_STAGE; 1 shards momenta over dp
         self._zero_stage = zero_stage
+        # microbatch gradient accumulation inside the compiled step:
+        # None = MXNET_GRAD_ACCUM_STEPS (default 1 = off)
+        self._accum_steps = accum_steps
         self._zero1 = False
         self._bucketed = False
         self._bucket_plan = None
@@ -490,7 +501,18 @@ class FusedTrainStep:
         n_params = len(self._cells)
         loss_block = loss_fn
         aux_idx = self._aux_idx
+        # ordered aux positions: the trace returns updated aux states in
+        # this order, and the accumulation scan carries them as a tuple
+        aux_order = list(self._cached._aux_positions)
         lr, mom_c, wd = learning_rate, momentum, weight_decay
+
+        # scoped remat + microbatch accumulation (remat.py knobs), both
+        # resolved at build time like the reference's graph-init reads
+        from ..remat import grad_accum_steps, remat_policy
+
+        accum = grad_accum_steps(self._accum_steps)
+        self._grad_accum = accum
+        remat_pol = remat_policy()
 
         import jax.numpy as _jnp
         from jax import lax as _lx
@@ -609,8 +631,91 @@ class FusedTrainStep:
             # rematerialize activations in backward (remat.py)
             from ..remat import maybe_checkpoint
 
-            (loss_val, (new_aux, logits)), grads = jax.value_and_grad(
-                maybe_checkpoint(pure_loss), has_aux=True)(diff)
+            flats = None
+            if accum == 1:
+                (loss_val, (new_aux, logits)), grads = jax.value_and_grad(
+                    maybe_checkpoint(pure_loss), has_aux=True)(diff)
+            else:
+                # MXNET_GRAD_ACCUM_STEPS: lax.scan over microbatches
+                # inside the SAME program — one microbatch of
+                # activations live at a time, gradients accumulated
+                # locally (per-bucket flats on the bucketed/zero1 paths,
+                # riding the reduce layout) and reduced/applied ONCE
+                # after the scan, so comm + optimizer cost stay
+                # amortized over the effective batch.
+                if data.shape[0] % accum:
+                    raise ValueError(
+                        "MXNET_GRAD_ACCUM_STEPS=%d does not divide the "
+                        "per-device batch %d" % (accum, data.shape[0]))
+                mb = data.shape[0] // accum
+                mb_data = data.reshape((accum, mb) + data.shape[1:])
+                mb_label = label.reshape((accum, mb) + label.shape[1:])
+                aux0 = tuple(aux[i] for i in aux_order)
+                # sharded == the bucketed shard_map path: accumulate
+                # straight into the per-bucket flat buffers the one
+                # reduce consumes
+                use_flats = sharded
+
+                def micro_loss(diff_params, aux_t, data_c, label_c,
+                               key_c):
+                    by_pos = dict(zip(aux_order, aux_t))
+                    allp = [diff_params[i] if i in diff_params
+                            else by_pos[i] for i in range(n_params)]
+                    outs = raw_fn(key_c, data_c, *allp, _training=True,
+                                  _n_inputs=1)
+                    outs = outs if isinstance(outs, tuple) else (outs,)
+                    n_aux = len(aux_idx)
+                    visible = outs[: len(outs) - n_aux] if n_aux else outs
+                    new_aux_t = outs[len(outs) - n_aux:] if n_aux else ()
+                    out_nd = NDArray.from_raw(visible[0])
+                    lab_nd = NDArray.from_raw(label_c)
+                    with autograd._RecordingScope(False, True):
+                        loss = loss_block(out_nd, lab_nd)
+                    return loss._data.mean(), (new_aux_t, visible[0])
+
+                def accum_body(carry, xs):
+                    aux_c, acc = carry
+                    data_c, label_c, idx = xs
+                    # per-microbatch rng stream (dropout masks must not
+                    # repeat across microbatches)
+                    key_c = jax.random.fold_in(key, idx)
+                    (loss_m, (new_aux_t, logits_m)), g = \
+                        jax.value_and_grad(
+                            maybe_checkpoint(
+                                lambda d: micro_loss(d, aux_c, data_c,
+                                                     label_c, key_c)),
+                            has_aux=True)(diff)
+                    if use_flats:
+                        gf = _buckets.pack_flats(g, plan)
+                        acc = [a + f for a, f in zip(acc, gf)]
+                    else:
+                        acc = {i: acc[i] + g[i] for i in acc}
+                    return (new_aux_t, acc), (loss_m, logits_m)
+
+                if use_flats:
+                    acc0 = [_jnp.zeros(sum(diff[k].size for k in b.keys),
+                                       dtype=_jnp.dtype(b.dtype))
+                            for b in plan]
+                else:
+                    acc0 = {i: _jnp.zeros_like(v)
+                            for i, v in diff.items()}
+                (new_aux, acc), (losses, logits_m) = _lx.scan(
+                    accum_body, (aux0, acc0),
+                    (mb_data, mb_label, _jnp.arange(accum)))
+                # mean of the microbatch means == the full-batch mean
+                # (equal microbatches); 1/accum is dyadic for the
+                # power-of-two factors the knob is used with, so the
+                # scale costs no precision there
+                loss_val = losses.mean()
+                logits = logits_m.reshape((mb * accum,)
+                                          + logits_m.shape[2:])
+                grads = None
+                if use_flats:
+                    flats = [f * _jnp.asarray(1.0 / accum, f.dtype)
+                             for f in acc]
+                else:
+                    grads = {i: g * _jnp.asarray(1.0 / accum, g.dtype)
+                             for i, g in acc.items()}
 
             if sharded:
                 loss_val = _lx.pmean(loss_val, "dp")
@@ -620,7 +725,7 @@ class FusedTrainStep:
                 # mom_vals is the per-bucket momentum-shard list
                 upd, new_moms = zero1_bucketed_update(
                     grads, diff, mom_vals, plan, "dp", n_dp,
-                    lr=lr, momentum=mom_c, wd=wd)
+                    lr=lr, momentum=mom_c, wd=wd, flats=flats)
                 aux_iter = iter(new_aux)
                 new_params = [next(aux_iter) if i in aux_idx else upd[i]
                               for i in range(n_params)]
@@ -634,9 +739,10 @@ class FusedTrainStep:
                 # (local_n keyed off the mesh's host topology; an
                 # unqualified topology falls back to the flat psum
                 # inside bucketed_reduce)
-                grads = _buckets.bucketed_reduce(grads, plan, "dp",
-                                                 n=n_dp, mean=True,
-                                                 local_n=hier_local_n)
+                grads = _buckets.bucketed_reduce(
+                    grads if flats is None else diff, plan, "dp",
+                    n=n_dp, mean=True, local_n=hier_local_n,
+                    flats=flats)
 
             aux_iter = iter(new_aux)
             if fused:
@@ -760,7 +866,13 @@ class FusedTrainStep:
         # step's traced collective schedule against THIS plan (the
         # global flight-recorder header may belong to another step)
         step_meta = {"compute_dtype": str(_jnp.dtype(compute_dtype)),
-                     "bucket_plan": plan_meta_v}
+                     "bucket_plan": plan_meta_v,
+                     # the auditor cross-checks the declared remat
+                     # policy against the traced program (a policy that
+                     # rematerializes nothing is a finding) and scores
+                     # overlap accum-aware
+                     "remat_policy": remat_pol,
+                     "grad_accum_steps": accum}
         # recompile tracking (diagnostics.py): count/time every XLA
         # compilation these step programs trigger and warn on
         # shape/dtype churn — a silent recompilation storm doubles step

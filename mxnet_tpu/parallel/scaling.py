@@ -378,7 +378,8 @@ def simulate_bucketed_overlap(bucket_bytes: Sequence[int],
                               ici_GBps: float = 45.0,
                               backward_frac: float = 2.0 / 3.0,
                               coll_latency_s: float = 0.0,
-                              readiness: str = "uniform") -> Dict:
+                              readiness: str = "uniform",
+                              accum_steps: int = 1) -> Dict:
     """DDP pipeline model over a measured bucket plan: bucket k's
     reduction becomes issueable partway through backward (reverse layer
     order) and reductions serialize on the comm stream (the
@@ -395,9 +396,18 @@ def simulate_bucketed_overlap(bucket_bytes: Sequence[int],
     too-large buckets expose the comm tail.  Defaults reproduce the r6
     behavior exactly.
 
+    ``accum_steps`` > 1 models microbatch gradient accumulation
+    (MXNET_GRAD_ACCUM_STEPS): gradients only exist after the LAST
+    microbatch's backward, so bucket k becomes issueable at
+    ((A-1) + share)/A of the step's total backward time — the first
+    A-1 microbatches offer no overlap window, compressing all comm
+    into the final 1/A and cutting the achievable overlap (the honest
+    cost of accumulation the autotuner must score).
+
     A MODEL, not a measured schedule — returned with its assumptions so
     the artifact can never pass it off as a measurement."""
     t_bwd = backward_frac * step_time_s
+    A = max(int(accum_steps), 1)
     ring = 2.0 * (n - 1) / n
     clock, total = 0.0, 0.0
     B = max(len(bucket_bytes), 1)
@@ -405,8 +415,9 @@ def simulate_bucketed_overlap(bucket_bytes: Sequence[int],
     cum = 0
     for k, nbytes in enumerate(bucket_bytes):
         cum += nbytes
-        ready = (cum / total_bytes if readiness == "bytes"
-                 else (k + 1) / B) * t_bwd
+        share = (cum / total_bytes if readiness == "bytes"
+                 else (k + 1) / B)
+        ready = ((A - 1) + share) / A * t_bwd
         dur = coll_latency_s + ring * nbytes / (ici_GBps * 1e9)
         clock = max(clock, ready) + dur
         total += dur
@@ -415,7 +426,8 @@ def simulate_bucketed_overlap(bucket_bytes: Sequence[int],
     return {"overlap": round(max(0.0, min(1.0, overlap)), 4),
             "exposed_s": exposed, "t_comm_total_s": total,
             "t_backward_s": t_bwd, "n_buckets": len(bucket_bytes),
-            "coll_latency_s": coll_latency_s, "readiness": readiness}
+            "coll_latency_s": coll_latency_s, "readiness": readiness,
+            "accum_steps": A}
 
 
 def project_efficiency_bucketed(bucket_bytes: Sequence[int],
@@ -425,19 +437,22 @@ def project_efficiency_bucketed(bucket_bytes: Sequence[int],
                                 ici_GBps: float = 45.0,
                                 backward_frac: float = 2.0 / 3.0,
                                 coll_latency_s: float = 0.0,
-                                readiness: str = "uniform") -> Dict:
+                                readiness: str = "uniform",
+                                accum_steps: int = 1) -> Dict:
     """Scaling projection under the bucket-pipeline model:
     eff(n) = t_step / (t_step + exposed(n)).  ``coll_latency_s`` /
-    ``readiness`` thread through to simulate_bucketed_overlap (the
-    autotuner scores candidates under readiness='bytes' + a stated
-    launch cost; defaults reproduce r6)."""
+    ``readiness`` / ``accum_steps`` thread through to
+    simulate_bucketed_overlap (the autotuner scores candidates under
+    readiness='bytes' + a stated launch cost, accum-aware when
+    MXNET_GRAD_ACCUM_STEPS>1; defaults reproduce r6)."""
     table = {}
     detail = {}
     for n in chips:
         sim = simulate_bucketed_overlap(bucket_bytes, step_time_s, n,
                                         ici_GBps, backward_frac,
                                         coll_latency_s=coll_latency_s,
-                                        readiness=readiness)
+                                        readiness=readiness,
+                                        accum_steps=accum_steps)
         table[str(n)] = round(
             step_time_s / (step_time_s + sim["exposed_s"]), 4)
         detail[str(n)] = sim["overlap"]

@@ -29,7 +29,8 @@ consults it during graph init.
 from . import env as _env
 
 __all__ = ["mirror_enabled", "mirror_policy", "maybe_checkpoint",
-           "REMAT_POLICIES", "remat_policy", "checkpoint_scope"]
+           "REMAT_POLICIES", "remat_policy", "checkpoint_scope",
+           "checkpoint_block_call", "grad_accum_steps"]
 
 # ops whose OUTPUTS are kept as backward residuals under the mirror
 # policy: the MXU heavyweights.  Everything else (BN math, relu, adds,
@@ -71,14 +72,32 @@ def maybe_checkpoint(fn):
 
 
 # ---------------------------------------------------------------------------
-# Per-block remat policies (the transformer workload tier).  The mirror
-# knob above is a whole-program save-policy; deep homogeneous stacks
-# want SCOPED remat instead: rematerialize each block (keep only
-# block-boundary residuals — activation memory O(L + T) instead of
-# O(L·T)) or just the attention sub-graph (recompute the O(T) score
-# path, keep the cheap MLP residuals).
+# Per-scope remat policies (shared registry across workload tiers).  The
+# mirror knob above is a whole-program save-policy; deep homogeneous
+# stacks want SCOPED remat instead: rematerialize each repeated unit and
+# keep only unit-boundary residuals.  One policy string selects which
+# scope gets the ``jax.checkpoint`` wrap:
+#
+#   transformer tier:  ``block``      — each decoder block (activation
+#                                       memory O(L + T) instead of O(L*T))
+#                      ``attention``  — just the O(T) score path
+#   conv tier:         ``stage``      — each resnet stage: only the four
+#                                       stage-boundary activations stay
+#                                       live; BN/elementwise/conv
+#                                       activations inside a stage are
+#                                       rematerialized during backward
+#                      ``conv_block`` — each residual unit (finer: unit-
+#                                       boundary residuals, more kept,
+#                                       less recompute)
+#
+# Scopes never nest: the policy is a single string, so a ``stage`` run
+# leaves ``conv_block``/``block``/``attention`` wraps as identity.
 # ---------------------------------------------------------------------------
-REMAT_POLICIES = ("none", "block", "attention")
+REMAT_POLICIES = ("none", "block", "attention", "stage", "conv_block")
+
+# conv-tier scopes: the gluon Block.__call__ hook and the symbolic
+# executor's stage segmentation consult this subset
+CONV_SCOPES = ("stage", "conv_block")
 
 
 def remat_policy(override=None) -> str:
@@ -106,3 +125,97 @@ def checkpoint_scope(fn, policy: str, scope: str):
     import jax
 
     return jax.checkpoint(fn)
+
+
+def _subtree_params(block):
+    """Ordered flat (param, is_aux) list for a gluon block subtree —
+    the same ``_reg_params`` + ``_children`` walk ``CachedOp`` uses, so
+    a checkpointed sub-call threads exactly the cells the outer trace
+    swapped."""
+    cells = []
+    seen = set()
+
+    def collect(b):
+        for p in b._reg_params.values():
+            if id(p) not in seen:
+                seen.add(id(p))
+                cells.append(p)
+        for c in b._children.values():
+            collect(c)
+
+    collect(block)
+    return cells
+
+
+def checkpoint_block_call(block, scope: str, args):
+    """``jax.checkpoint`` one gluon sub-block call at its declared remat
+    scope (``Block._remat_scope``: resnet stages are ``'stage'``,
+    residual units ``'conv_block'``).
+
+    Returns ``NotImplemented`` when the wrap does not apply — wrong
+    policy, eager/settle forward (inputs are concrete, not tracers), or
+    params not yet settled — and the caller falls through to the plain
+    ``forward``.  Fires only inside a ``CachedOp`` trace, where
+    ``_raw_fn`` already swapped every param cell's buffer for the traced
+    value; this helper re-threads the subtree's buffers as EXPLICIT
+    checkpoint arguments (closure-captured tracers would become
+    unrematerializable constvar residuals) and returns BN aux writebacks
+    as checkpoint outputs, committing them to the cells *outside* the
+    wrap so the outer trace harvests outer-scope values — the same
+    swap/harvest/restore discipline as ``CachedOp._raw_fn``, at stage
+    granularity."""
+    try:
+        policy = remat_policy()
+    except ValueError:
+        return NotImplemented  # bad env value surfaces at trace entry
+    if policy != scope:
+        return NotImplemented
+    import jax
+
+    from .ndarray import NDArray
+
+    if not args or not isinstance(args[0], NDArray) \
+            or not isinstance(args[0]._data, jax.core.Tracer):
+        return NotImplemented  # concrete forward: settle/eager path
+    params = _subtree_params(block)
+    if any(p._data is None for p in params):
+        return NotImplemented  # unsettled subtree: let forward handle it
+    aux_ps = [p for p in params if p.grad_req == "null"]
+    arg_raws = tuple(a._data for a in args)
+    n_args = len(arg_raws)
+
+    def seg_fn(*flat):
+        inputs = [NDArray.from_raw(r) for r in flat[:n_args]]
+        for p, r in zip(params, flat[n_args:]):
+            p._data._data = r
+        out = block.forward(*inputs)
+        out_raws = tuple(o._data for o in out) \
+            if isinstance(out, (list, tuple)) else (out._data,)
+        return out_raws, tuple(p._data._data for p in aux_ps)
+
+    saved = [p._data._data for p in params]
+    try:
+        out_raws, aux_raws = jax.checkpoint(seg_fn)(
+            *(arg_raws + tuple(saved)))
+    finally:
+        for p, old in zip(params, saved):
+            p._data._data = old
+    for p, r in zip(aux_ps, aux_raws):
+        p._data._data = r
+    outs = [NDArray.from_raw(r) for r in out_raws]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def grad_accum_steps(override=None) -> int:
+    """Microbatch gradient-accumulation factor: explicit argument wins,
+    else ``MXNET_GRAD_ACCUM_STEPS`` (default 1 = off).  The compiled
+    step splits its batch into this many microbatches and lax.scans
+    forward+backward over them, accumulating gradients before the ONE
+    bucketed reduce + fused update — effective batch = dispatch batch,
+    live activation memory = one microbatch's."""
+    n = int(override) if override is not None \
+        else _env.get_int("MXNET_GRAD_ACCUM_STEPS")
+    if n < 1:
+        raise ValueError(
+            "MXNET_GRAD_ACCUM_STEPS must be >= 1, got %d" % n)
+    return n

@@ -1,0 +1,211 @@
+"""Microbatch gradient accumulation inside the compiled step
+(MXNET_GRAD_ACCUM_STEPS / FusedTrainStep(accum_steps=...)).
+
+The step reshapes the per-device batch (B, ...) into (A, B/A, ...),
+lax.scans over the A microbatches accumulating per-bucket flat gradient
+buffers (a plain grads dict on the unbucketed path), and only THEN
+issues the one bucketed reduce + fused update — large effective batches
+under the HBM ceiling without touching the optimizer math or the
+gradient-exchange schedule (ZeRO-1 rides the same reduce-scatter
+layout).
+
+Pinned with exact-arithmetic constructions (integer data, 1/4-quantized
+weights, power-of-two lr/momentum/batch sizes: every intermediate is
+exactly representable in fp32): the accumulated and full-batch steps
+must agree BITWISE after one step on the single-device, bucketed-dp and
+ZeRO-1 paths; multi-step trajectories track at float tolerance (the
+update's dyadic denominators deepen past fp32 exactness at step 2+);
+a non-dividing accum count is a trace-time ValueError; and a
+checkpoint/resume at a step boundary — which under in-step accumulation
+is ALWAYS an accumulation-window boundary, the window being atomic
+inside the compiled program — replays the continuous run bitwise.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.dp import FusedTrainStep
+from mxnet_tpu.parallel.mesh import make_mesh, current_device_count
+
+
+def _need_devices(n):
+    if current_device_count() < n:
+        pytest.skip("needs %d devices" % n)
+
+
+def _exact_net(seed=0):
+    """BN-free Dense net with weights quantized to multiples of 1/4 —
+    with {-1,0,1} inputs every product/sum below is exact in fp32."""
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, in_units=8, activation="relu"),
+                nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    for p in net.collect_params().values():
+        w = p.data().asnumpy()
+        p.set_data(nd.array(np.round(w * 4.0) / 4.0))
+    return net
+
+
+def _batch(n=32):
+    rng = np.random.RandomState(1)
+    X = nd.array(rng.randint(-1, 2, (n, 8)).astype("float32"))
+    y = nd.array(rng.randint(-1, 2, (n, 4)).astype("float32"))
+    return X, y
+
+
+def _norm_params(net):
+    """Gluon auto-naming increments prefixes across net constructions;
+    normalize so two separately-built twins can be compared."""
+    return {k.split("_", 1)[-1]: p.data().asnumpy()
+            for k, p in net.collect_params().items()}
+
+
+def _build(accum, n_dp=1, zero_stage=None, seed=0):
+    net = _exact_net(seed)
+    mesh = make_mesh((n_dp,), ("dp",))
+    step = FusedTrainStep(net, gluon.loss.L2Loss(), mesh=mesh,
+                          learning_rate=0.25, momentum=0.5,
+                          weight_decay=0.0, accum_steps=accum,
+                          zero_stage=zero_stage)
+    return net, step
+
+
+def _one_step(accum, n_dp=1, zero_stage=None):
+    net, step = _build(accum, n_dp=n_dp, zero_stage=zero_stage)
+    X, y = _batch()
+    loss, logits = step(X, y)
+    return (float(loss.asnumpy()), logits.asnumpy(), _norm_params(net))
+
+
+def _assert_one_step_bitwise(n_dp, zero_stage=None):
+    l1, o1, p1 = _one_step(1, n_dp=n_dp, zero_stage=zero_stage)
+    l4, o4, p4 = _one_step(4, n_dp=n_dp, zero_stage=zero_stage)
+    assert l1 == l4, (l1, l4)
+    np.testing.assert_array_equal(o1, o4)
+    assert set(p1) == set(p4)
+    for k in p1:
+        np.testing.assert_array_equal(p1[k], p4[k], err_msg=k)
+
+
+def test_accum_matches_full_batch_single_device():
+    """accum=4 over 8-image microbatches == the bs32 full-batch step,
+    bitwise: loss, logits AND updated params."""
+    _assert_one_step_bitwise(n_dp=1)
+
+
+def test_accum_matches_full_batch_dp2_bucketed():
+    """Same identity through the bucketed shard_map exchange: the accum
+    scan packs per-bucket flats and the ONE reduce at the end sees
+    exactly the full-batch gradient."""
+    _need_devices(2)
+    _assert_one_step_bitwise(n_dp=2)
+
+
+def test_accum_matches_full_batch_dp2_zero1():
+    """And through ZeRO-1: accumulated flats feed the same
+    reduce-scatter + sharded-momentum update layout."""
+    _need_devices(2)
+    _assert_one_step_bitwise(n_dp=2, zero_stage=1)
+
+
+def test_accum_multi_step_trajectory():
+    """4-step loss trajectories track at float tolerance (the momentum
+    update's dyadic denominators deepen each step, so bitwise equality
+    past step 1 is not a representable claim in fp32)."""
+
+    def traj(accum):
+        _net, step = _build(accum)
+        X, y = _batch()
+        return [float(step(X, y)[0].asnumpy()) for _ in range(4)]
+
+    t1, t4 = traj(1), traj(4)
+    assert t1[0] == t4[0], (t1, t4)  # step 1 IS bitwise
+    np.testing.assert_allclose(t1, t4, rtol=1e-6)
+
+
+def test_accum_env_knob(monkeypatch):
+    """MXNET_GRAD_ACCUM_STEPS is the no-code-change path: the built
+    step honors the env default, and an explicit accum_steps=1
+    override beats it (same precedence as every registered knob)."""
+    monkeypatch.setenv("MXNET_GRAD_ACCUM_STEPS", "4")
+    net, step = _build(None)
+    X, y = _batch()
+    l_env, _ = step(X, y)
+    assert step._grad_accum == 4
+    _net2, control = _build(1)
+    l_ctl, _ = control(X, y)
+    assert control._grad_accum == 1
+    assert float(l_env.asnumpy()) == float(l_ctl.asnumpy())
+
+
+def test_accum_must_divide_batch():
+    """A non-dividing accum count fails loudly at trace time, not with
+    a silent reshape truncation."""
+    _net, step = _build(5)
+    X, y = _batch(32)
+    with pytest.raises(ValueError, match="does not divide"):
+        step(X, y)
+
+
+def test_accum_with_batchnorm_aux_dp2():
+    """BN running stats thread through the accum scan carry (the last
+    microbatch's stats win, matching the sequential-small-batch
+    semantics) and still reach the cells."""
+    _need_devices(2)
+    mx.random.seed(2)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16), nn.BatchNorm(), nn.Activation("relu"),
+                nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    mesh = make_mesh((2,), ("dp",))
+    step = FusedTrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mesh=mesh, accum_steps=4)
+    X = nd.array(np.random.RandomState(0).rand(32, 8).astype("float32"))
+    y = nd.array((np.arange(32) % 2).astype("float32"))
+    loss, _ = step(X, y)
+    assert np.isfinite(float(loss.asnumpy()))
+    rm = [p for name, p in net.collect_params().items()
+          if name.endswith("running_mean")][0]
+    assert float(np.abs(rm.data().asnumpy()).sum()) > 0, \
+        "BN running stats must update through the accumulated step"
+
+
+def test_accum_resume_at_step_boundary_bitwise():
+    """Checkpoint after step 2, rebuild from scratch, restore params +
+    momenta, run steps 3-4: losses and final params replay the
+    uninterrupted 4-step run bitwise.  Under in-step accumulation every
+    dispatch boundary is an accumulation-window boundary (the window is
+    one atomic compiled program), so a step-granular checkpoint can
+    never land mid-window."""
+    X, y = _batch()
+
+    net_a, step_a = _build(4)
+    cont = [float(step_a(X, y)[0].asnumpy()) for _ in range(4)]
+    params_cont = _norm_params(net_a)
+
+    net_b, step_b = _build(4)
+    first = [float(step_b(X, y)[0].asnumpy()) for _ in range(2)]
+    np.testing.assert_array_equal(cont[:2], first)
+    ckpt_params = _norm_params(net_b)
+    ckpt_moms = [np.asarray(m) for m in step_b._moms]
+    ckpt_ctr = step_b._key_ctr
+
+    net_c, step_c = _build(4)
+    step_c._build(X)  # build WITHOUT dispatching a step
+    for k, p in net_c.collect_params().items():
+        p.set_data(nd.array(ckpt_params[k.split("_", 1)[-1]]))
+    step_c._moms = list(ckpt_moms)  # placed (device_put) on first call
+    step_c._key_ctr = ckpt_ctr
+    resumed = [float(step_c(X, y)[0].asnumpy()) for _ in range(2)]
+
+    np.testing.assert_array_equal(cont[2:], resumed)
+    params_res = _norm_params(net_c)
+    for k in params_cont:
+        np.testing.assert_array_equal(params_cont[k], params_res[k],
+                                      err_msg=k)

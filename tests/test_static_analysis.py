@@ -64,6 +64,50 @@ def test_fixture_host_callback_under_scan():
     assert found[0].details["prim"] == "pure_callback"
 
 
+def test_fixture_noop_remat_flagged():
+    """A DECLARED policy whose trace contains zero remat eqns is an
+    error finding — the run would OOM exactly where remat was supposed
+    to save it (wrong scope string / markerless model)."""
+    found = auditor.check_remat_effectiveness(
+        fixtures.noop_remat_jaxpr(), "fx", "stage")
+    assert found and found[0].check == "remat-effectiveness"
+    assert found[0].severity == "error"
+    assert "no-op-remat" in found[0].details["fingerprint_key"]
+    # policy none declares nothing — no finding to raise
+    assert auditor.check_remat_effectiveness(
+        fixtures.noop_remat_jaxpr(), "fx", "none") == []
+
+
+def test_fixture_remat_twin_peak_drops():
+    """The effective per-stage plan leaves checkpoint eqns in the trace
+    AND measurably lowers the liveness walk's peak residual bytes vs
+    its no-remat twin; a plan that changes nothing is flagged."""
+    remat_jx, twin_jx = fixtures.remat_twin_jaxprs()
+    assert auditor.count_remat_eqns(remat_jx) >= 3
+    assert auditor.count_remat_eqns(twin_jx) == 0
+    peak = auditor.peak_live_bytes(remat_jx)
+    twin_peak = auditor.peak_live_bytes(twin_jx)
+    assert peak < twin_peak, (peak, twin_peak)
+    # the real plan passes the twin comparison...
+    assert auditor.check_remat_effectiveness(
+        remat_jx, "fx", "stage", twin_jaxpr=twin_jx) == []
+    # ...and an ineffective one (remat "plan" == its own twin) does not
+    found = auditor.check_remat_effectiveness(
+        twin_jx, "fx", "stage", twin_jaxpr=twin_jx)
+    assert found and found[0].severity == "error"
+
+
+def test_audit_step_meta_carries_remat_evidence():
+    """audit_step stamps n_remat_eqns + peak_live_bytes into the site
+    meta so audit_recorded_steps reports remat evidence next to the
+    collective/donation accounting."""
+    fn, specs = fixtures.clean_step()
+    _findings, meta = auditor.audit_step(fn, specs, site="fx.clean",
+                                         compute_dtype="bfloat16")
+    assert meta["n_remat_eqns"] == 0
+    assert meta["peak_live_bytes"] > 0
+
+
 def test_clean_fixture_passes_all_checks():
     fn, specs = fixtures.clean_step()
     findings, meta = auditor.audit_step(fn, specs, site="fx.clean",
