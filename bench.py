@@ -832,6 +832,108 @@ def _bench_serving_reload(srv):
         shutil.rmtree(ckdir, ignore_errors=True)
 
 
+def bench_generation(slo_p99_tpot_ms=200.0):
+    """The generation acceptance row: sustained tokens/s at a fixed
+    p99 TPOT SLO over the continuous-batched paged-KV decode path
+    (serving.gen_tokens_at_slo — offered QPS ramps geometrically until
+    inter-token p99 breaks the SLO), TTFT percentiles at that rate,
+    and the continuous-vs-whole-batch A/B at mixed output lengths.
+    The A/B is the core utilization claim: whole-batch decode holds
+    every slot until the LONGEST rider finishes (per-tick useful work
+    ~= mean/max of the length mix), continuous batching refills each
+    slot the tick its sequence retires.  In-process over the demo
+    transformer: the number measures the decode serving tier (paged
+    allocator + bucketed compiled steps + slot scheduler), not a
+    production model's FLOPs."""
+    import random
+
+    from mxnet_tpu import diagnostics, serving
+
+    mix = dict(slots=4, block_tokens=16, max_prompt=16,
+               max_context=64, max_new=48, prefill_batch=4)
+    t0 = time.time()
+    cont = serving.demo_generation_runtime("bench_gen", n_layers=1,
+                                           **mix)
+    cont.compile(warmup=True)
+    whole = serving.demo_generation_runtime(
+        "bench_gen_whole", n_layers=1, continuous=False, **mix)
+    whole.compile(warmup=True)
+    compile_s = time.time() - t0
+
+    # A/B: identical mixed-length work list through both schedulers,
+    # each engine driven to idle on the caller thread (no queue noise).
+    # The mix is the straggler shape that hurts whole-batch decode in
+    # practice: mostly short completions with a long one in every
+    # slot-group, so the long rider pins all 4 slots until it retires.
+    # Best-of-3 walls per scheduler (same warm executors both ways).
+    rng = random.Random(0)
+    work = [([rng.randrange(1, cont.cfg.vocab_size)
+              for _ in range(rng.randint(2, mix["max_prompt"]))],
+             mix["max_new"] if i % mix["slots"] == 0
+             else rng.randint(4, 8)) for i in range(16)]
+
+    def drive_once(rt):
+        before = rt.engine.tokens_out
+        for prompt, max_new in work:
+            rt.engine.enqueue(serving.GenRequest(rt.name, prompt,
+                                                 max_new))
+        t = time.time()
+        while not rt.engine.idle():
+            rt.engine.step()
+        return time.time() - t, rt.engine.tokens_out - before
+
+    # interleaved repeats so machine drift hits both schedulers alike
+    walls = {"whole": [], "cont": []}
+    for _ in range(5):
+        walls["whole"].append(drive_once(whole))
+        walls["cont"].append(drive_once(cont))
+    whole_s, ab_tokens = min(walls["whole"])
+    cont_s, _ = min(walls["cont"])
+    whole_tps = ab_tokens / whole_s
+    cont_tps = ab_tokens / cont_s
+
+    # SLO ramp through the full server path (queue + breaker + worker)
+    srv = serving.ModelServer(queue_max=256, default_deadline_ms=30000)
+    srv.add_generator(cont)  # already compiled: warmup is a no-op
+    rep = serving.gen_tokens_at_slo(
+        srv, "bench_gen", slo_p99_tpot_ms=slo_p99_tpot_ms,
+        start_qps=4.0, max_qps=2000.0, window_s=1.5)
+    srv.drain(timeout_s=15.0)
+
+    # the zero-steady-state-recompile proof: after warmup + A/B + the
+    # full SLO ramp, every plan cell still shows exactly one compile
+    recomp = {k: v["count"]
+              for k, v in diagnostics.recompile_stats().items()
+              if ":bench_gen:" in k}
+    steady_recompiles = sum(c - 1 for c in recomp.values())
+    return {
+        "pipeline": "generation (continuous batching, paged KV cache)",
+        "model": "demo_transformer(L1 d32 h2 v64)",
+        "slo_p99_tpot_ms": slo_p99_tpot_ms,
+        "tokens_per_s_at_slo": rep["tokens_per_s_at_slo"],
+        "tpot_p99_ms_at_slo": rep["tpot_p99_ms_at_slo"],
+        "ttft_p50_ms_at_slo": rep["ttft_p50_ms_at_slo"],
+        "ttft_p99_ms_at_slo": rep["ttft_p99_ms_at_slo"],
+        "continuous_vs_whole_batch": {
+            "requests": len(work),
+            "max_new_mix": [min(m for _, m in work),
+                            max(m for _, m in work)],
+            "whole_batch_tokens_per_s": round(whole_tps, 1),
+            "continuous_tokens_per_s": round(cont_tps, 1),
+            "whole_batch_wall_s": round(whole_s, 3),
+            "continuous_wall_s": round(cont_s, 3),
+            "speedup": round(cont_tps / whole_tps, 2),
+        },
+        "plan": {"prefill_cells": len(cont.prefill_plan),
+                 "decode_cells": len(cont.decode_plan),
+                 "block_tokens": cont.block_tokens,
+                 "num_blocks": cont.kv.num_blocks},
+        "steady_state_recompiles": steady_recompiles,
+        "compile_warmup_s": round(compile_s, 2),
+        "ramp": rep["ramp"],
+    }
+
+
 def _transformer_dims():
     """Transformer bench dims: MXNET_BENCH_TRANSFORMER 'k=v,...' over
     the defaults — sized (like the fit probe) to land inside the 950 s
@@ -1456,7 +1558,7 @@ _STATE = {
     "table": [], "io": None, "fit_loop": None, "bare_jax": [],
     "memory": None, "mfu_attribution": None, "serving": None,
     "transformer": None, "overlap_measured": None,
-    "large_batch_remat": None,
+    "large_batch_remat": None, "generation": None,
     "headline": None, "peak": None, "kind": None, "emitted": False,
 }
 
@@ -1465,7 +1567,7 @@ _STATE = {
 #: same {"skipped": reason} shape a gated phase does
 _PHASE_SLOTS = ("io", "fit_loop", "memory", "mfu_attribution",
                 "serving", "transformer", "overlap_measured",
-                "large_batch_remat")
+                "large_batch_remat", "generation")
 
 
 def _emit_final(reason=None):
@@ -1499,6 +1601,7 @@ def _emit_final(reason=None):
         "transformer": _STATE["transformer"],
         "overlap_measured": _STATE["overlap_measured"],
         "large_batch_remat": _STATE["large_batch_remat"],
+        "generation": _STATE["generation"],
     }
     for slot in _PHASE_SLOTS:
         if out.get(slot) is None:
@@ -2065,6 +2168,23 @@ def main():
         _STATE["large_batch_remat"] = {"pipeline": "large_batch_remat",
                                        "error": repr(exc)}
     _progress({"large_batch_remat": _STATE["large_batch_remat"]})
+
+    # ---- phase 3g: generation serving row (ISSUE 18 tentpole —
+    # tokens/s at a fixed p99 TPOT SLO over the continuous-batched
+    # paged-KV decode path, TTFT percentiles, and the continuous-vs-
+    # whole-batch A/B at mixed output lengths) ------------------------
+    try:
+        if left() < 120:
+            raise _BudgetSkip("time budget spent before generation "
+                              "row (elapsed %.0fs)" % elapsed())
+        _STATE["generation"] = bench_generation()
+    except _BudgetSkip as exc:
+        _STATE["generation"] = {"pipeline": "generation",
+                                "skipped": str(exc)}
+    except Exception as exc:
+        _STATE["generation"] = {"pipeline": "generation",
+                                "error": repr(exc)}
+    _progress({"generation": _STATE["generation"]})
 
     # io comparator: the bf16@32 headline row
     io_compute_ref, io_ref_label = None, None

@@ -15,9 +15,10 @@ flags every seeded fixture violation; ``--audit`` audits the compiled
 paths recorded in the current process.
 """
 from .auditor import (            # noqa: F401
-    AuditReport, Finding, apply_baseline, audit_recorded_steps,
-    audit_step, check_bucket_plan, check_collective_uniformity,
-    check_donation, check_dtype, check_host_sync, collective_signature,
+    AuditReport, Finding, apply_baseline, audit_decode_buckets,
+    audit_recorded_steps, audit_step, check_bucket_plan,
+    check_collective_uniformity, check_decode_buckets, check_donation,
+    check_dtype, check_host_sync, collective_signature,
     iter_eqns, load_baseline, DEFAULT_BASELINE,
 )
 from . import fixtures            # noqa: F401

@@ -72,16 +72,61 @@ def _self_test(args) -> int:
             remat_jx, "fixture.remat_twin", "stage", twin_jaxpr=twin_jx):
         failures.append("effective remat plan wrongly flagged")
 
+    # 7. decode-bucket discipline: the seeded rogue shape + recompile
+    # ledger is flagged (both planted bugs), the fixed twin passes, and
+    # a live generation engine (host-stub plan cells, the instrumented
+    # dispatch path the real runtime shares) driven through
+    # mixed-length decode audits clean (zero steady-state recompiles)
+    plan, observed, counts = fixtures.decode_bucket_violation()
+    hits = expect("decode_buckets", auditor.check_decode_buckets(
+        plan, observed, "fixture.decode_buckets",
+        compile_counts=counts), "decode-buckets")
+    planted = {f.details.get("fingerprint_key", "").split(":")[0]
+               for f in hits}
+    if not {"shape", "total"} <= planted:
+        failures.append("decode_buckets: expected both the rogue-shape"
+                        " and excess-compile findings, got %s"
+                        % sorted(planted))
+    cplan, cobs, ccounts = fixtures.decode_bucket_clean()
+    if auditor.check_decode_buckets(cplan, cobs,
+                                    "fixture.decode_buckets_clean",
+                                    compile_counts=ccounts):
+        failures.append("clean decode-bucket twin wrongly flagged")
+    from mxnet_tpu.serving.generate import (GenRequest,
+                                            StubGenerationRuntime)
+
+    grt = StubGenerationRuntime("audit_gen", slots=2, max_prompt=16,
+                                max_context=32, block_tokens=16,
+                                max_new=8, prefill_batch=2)
+    grt.compile(warmup=True)
+    eng = grt.engine
+    eng.enqueue(GenRequest("audit_gen", [1, 2, 3], 6))
+    eng.enqueue(GenRequest("audit_gen", [4] * 12, 6))
+    eng.enqueue(GenRequest("audit_gen", [5, 6], 4))
+    while not eng.idle():
+        eng.step()
+    rep = auditor.audit_decode_buckets()
+    site = "generate_decode:audit_gen"
+    if rep.n_findings:
+        failures.append("live decode audit flagged a clean engine: %s"
+                        % rep.summary())
+    if site not in rep.sites or \
+            rep.sites[site]["compiles"] != len(grt.decode_plan):
+        failures.append("live decode audit: expected %d warmup "
+                        "compiles at %s, saw %s"
+                        % (len(grt.decode_plan), site,
+                           rep.sites.get(site)))
+
     if failures:
         print("analysis self-test FAILED:")
         for f in failures:
             print("  -", f)
         return 1
-    print("analysis self-test OK: 5 seeded violations flagged, clean "
+    print("analysis self-test OK: 6 seeded violations flagged, clean "
           "step passed (%d eqns, %d collectives), remat twin peak "
-          "%d -> %d bytes" % (meta.get("n_eqns", 0),
-                              meta.get("n_collectives", 0),
-                              twin_peak, peak))
+          "%d -> %d bytes, decode audit clean (%d plan-cell compiles)"
+          % (meta.get("n_eqns", 0), meta.get("n_collectives", 0),
+             twin_peak, peak, rep.sites[site]["compiles"]))
     return 0
 
 

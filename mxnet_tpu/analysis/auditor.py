@@ -54,8 +54,9 @@ __all__ = [
     "Finding", "AuditReport", "iter_eqns", "collective_signature",
     "check_collective_uniformity", "check_bucket_plan", "check_donation",
     "check_dtype", "check_host_sync", "check_remat_effectiveness",
-    "count_remat_eqns", "peak_live_bytes", "audit_step",
-    "audit_recorded_steps", "load_baseline", "apply_baseline",
+    "check_decode_buckets", "count_remat_eqns", "peak_live_bytes",
+    "audit_step", "audit_recorded_steps", "audit_decode_buckets",
+    "load_baseline", "apply_baseline",
     "DEFAULT_BASELINE", "REMAT_PRIMS",
 ]
 
@@ -581,6 +582,121 @@ def audit_step(fn, specs: Sequence, *, site: str,
         findings += don_findings
         meta["donation"] = don_summary
     return findings, meta
+
+
+def check_decode_buckets(plan: Sequence[Sequence[int]],
+                         observed: Sequence[Sequence[int]],
+                         site: str,
+                         compile_counts: Optional[Mapping[str, int]]
+                         = None) -> List[Finding]:
+    """Generation-tier AOT discipline, as a pure check: every
+    ``(batch, cache_len)`` a decode step actually compiled at must be a
+    cell of its DECLARED bucket plan, and the total compile count must
+    not exceed the plan size — anything beyond is a steady-state
+    recompile waiting to stall a decode tick (the generation analogue
+    of check_bucket_plan's padding-ladder contract).
+
+    ``plan``: the declared cells; ``observed``: the traced
+    (batch, cache_len) shapes (from the recompile tracker's recorded
+    specs); ``compile_counts``: per-instrumented-name compile counts —
+    when the integration wraps each plan cell separately (one name per
+    cell, the serving tier's wiring), any single name compiling more
+    than once is flagged even if the total still fits the plan."""
+    findings: List[Finding] = []
+    plan_cells = {tuple(int(v) for v in c) for c in plan}
+    for shape in observed:
+        cell = tuple(int(v) for v in shape)
+        if cell not in plan_cells:
+            findings.append(Finding(
+                "decode-buckets", "error", site,
+                "decode step compiled at (batch=%d, cache_len=%d), "
+                "not a cell of its declared %d-cell plan — an "
+                "undeclared shape IS a steady-state recompile"
+                % (cell[0], cell[1], len(plan_cells)),
+                {"shape": list(cell),
+                 "plan": sorted(list(c) for c in plan_cells),
+                 "fingerprint_key": "shape:%dx%d" % cell}))
+    if compile_counts:
+        total = sum(int(c) for c in compile_counts.values())
+        if total > len(plan_cells):
+            findings.append(Finding(
+                "decode-buckets", "error", site,
+                "%d decode compiles recorded for a %d-cell plan — "
+                "warmup compiles each cell exactly once, so the "
+                "excess happened under traffic (steady-state "
+                "recompiles)" % (total, len(plan_cells)),
+                {"compiles": total, "plan_cells": len(plan_cells),
+                 "counts": dict(compile_counts),
+                 "fingerprint_key": "total:%d" % total}))
+        if len(compile_counts) > 1:  # per-cell wrapper wiring
+            for name, c in sorted(compile_counts.items()):
+                if int(c) > 1:
+                    findings.append(Finding(
+                        "decode-buckets", "error", site,
+                        "plan cell %r compiled %d times — a cell "
+                        "compiles once at warmup; every further "
+                        "compile is a steady-state recompile"
+                        % (name, int(c)),
+                        {"name": name, "count": int(c),
+                         "fingerprint_key": "cell:" + name}))
+    return findings
+
+
+def audit_decode_buckets(names: Optional[Sequence[str]] = None,
+                         baseline: Optional[set] = None
+                         ) -> AuditReport:
+    """Audit every generation decode path the recompile tracker has
+    seen: group the recorded ``generate_decode`` steps by model, pull
+    each one's traced (batch, cache_len) from its recorded specs and
+    its compile count from ``recompile_stats()``, and run
+    :func:`check_decode_buckets` against the plan the runtime declared
+    in its step meta.  Zero findings == zero steady-state recompiles,
+    measured, not assumed."""
+    from .. import diagnostics as _diag
+
+    if baseline is None:
+        baseline = load_baseline()
+    report = AuditReport()
+    recorded = _diag.recorded_steps()
+    stats = _diag.recompile_stats()
+    by_model: Dict[str, Dict[str, Any]] = {}
+    for name in sorted(recorded):
+        if names is not None and name not in names:
+            continue
+        _fn, specs, step_meta = recorded[name]
+        step_meta = step_meta or {}
+        if step_meta.get("kind") != "generate_decode":
+            continue
+        model = str(step_meta.get("model", name))
+        ent = by_model.setdefault(model, {
+            "plan": [tuple(int(v) for v in c)
+                     for c in step_meta.get("decode_plan", [])],
+            "observed": [], "counts": {}})
+        bt = int(step_meta.get("block_tokens", 1))
+        try:
+            # decode signature: (params, tokens, positions, pages,
+            # block_tables) — block_tables is (batch, cache_len // bt)
+            tables = specs[4]
+            ent["observed"].append(
+                (int(tables.shape[0]), int(tables.shape[1]) * bt))
+        except Exception:
+            pass
+        ent["counts"][name] = int(stats.get(name, {}).get("count", 0))
+    for model in sorted(by_model):
+        ent = by_model[model]
+        site = "generate_decode:%s" % model
+        findings = check_decode_buckets(
+            ent["plan"], ent["observed"], site,
+            compile_counts=ent["counts"])
+        new, supp = apply_baseline(findings, baseline)
+        report.findings += new
+        report.suppressed += supp
+        report.sites[site] = {
+            "plan_cells": len(ent["plan"]),
+            "observed": [list(o) for o in ent["observed"]],
+            "compiles": sum(ent["counts"].values()),
+        }
+    return report
 
 
 def audit_recorded_steps(names: Optional[Sequence[str]] = None,

@@ -16,6 +16,7 @@ __all__ = [
     "rank_dependent_traces", "undonated_lowered", "donated_lowered",
     "upcast_jaxpr", "host_sync_jaxpr", "clean_step", "UNDONATED_BYTES",
     "remat_twin_jaxprs", "noop_remat_jaxpr",
+    "decode_bucket_violation", "decode_bucket_clean",
 ]
 
 UNDONATED_BYTES = 100 * 1024 * 1024  # the planted 100MB param
@@ -172,6 +173,31 @@ def noop_remat_jaxpr():
     contains no remat eqns (the policy string matched no block — the
     planted no-op): check_remat_effectiveness must flag it."""
     return _stage_chain_grad(False)
+
+
+def decode_bucket_violation():
+    """A generation decode history with TWO planted bugs for
+    check_decode_buckets: a traced (batch=3, cache_len=48) that is no
+    cell of the declared 2x2 plan (an undeclared shape compiled under
+    traffic), and a compile ledger holding 6 compiles against 4 plan
+    cells (steady-state recompiles).  Returns (plan, observed,
+    compile_counts)."""
+    plan = [(1, 16), (1, 32), (4, 16), (4, 32)]
+    observed = [(1, 16), (4, 32), (3, 48)]   # the rogue shape
+    counts = {"gen_decode:fx:v1:1x16": 1, "gen_decode:fx:v1:1x32": 1,
+              "gen_decode:fx:v1:4x16": 3,   # recompiled under traffic
+              "gen_decode:fx:v1:4x32": 1}
+    return plan, observed, counts
+
+
+def decode_bucket_clean():
+    """The fixed twin: every observed shape is a plan cell and every
+    cell compiled exactly once — zero findings."""
+    plan = [(1, 16), (1, 32), (4, 16), (4, 32)]
+    observed = [(1, 16), (4, 32), (4, 16)]
+    counts = {"gen_decode:fx:v1:1x16": 1, "gen_decode:fx:v1:1x32": 1,
+              "gen_decode:fx:v1:4x16": 1, "gen_decode:fx:v1:4x32": 1}
+    return plan, observed, counts
 
 
 def clean_step():

@@ -51,6 +51,12 @@ hold; ``nth`` skips the first nth-1 candidate events.  Kinds:
     drive the auto-rollback with zero admitted requests dropped (the
     failed canary batch re-executes on the stable version).  Match
     keys: ``model``, ``version``, ``nth``, ``count``.
+  * ``cancel_request`` — a generation client disconnects mid-stream:
+    the matching model's engine marks an active sequence cancelled at
+    its next decode tick — slot and paged-cache blocks must be
+    reclaimed on that tick with co-riding sequences untouched and zero
+    leaked blocks.  Candidate events are (engine tick × active
+    sequence).  Match keys: ``model``, ``nth``, ``count``.
   * ``slow_decode``    — sleep ``ms`` (default 100) in the matching
     decode-pool worker after it decodes a batch (io_pipeline.py) — a
     seeded straggler worker the sharded pipeline must absorb as
@@ -104,6 +110,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["Rule", "rules", "enabled", "fault", "should_kill",
            "maybe_slow_request", "should_fail_execute",
+           "should_cancel_request",
            "maybe_corrupt_shard", "should_fail_version",
            "maybe_slow_decode", "should_kill_rank",
            "should_bitflip_param", "should_bitflip_grad",
@@ -331,6 +338,14 @@ def should_fail_execute(model: str, **ctx) -> bool:
     return fault("fail_execute", model=model, **ctx) is not None
 
 
+def should_cancel_request(model: str, **ctx) -> bool:
+    """cancel_request hook (generation engine, per tick × active
+    sequence): True when the matching model's sequence should be
+    treated as a mid-stream client disconnect — its slot and cache
+    blocks must be reclaimed on this tick, co-riders untouched."""
+    return fault("cancel_request", model=model, **ctx) is not None
+
+
 def maybe_corrupt_shard(path: str, step: int, **ctx) -> bool:
     """corrupt_shard hook (checkpoint._write, AFTER the shard landed
     and its true digest was recorded): flip ``nbytes`` bytes in the
@@ -524,6 +539,23 @@ def _self_test() -> tuple:
         checks["fail_execute_count"] = fires == [True, True, False]
         checks["fail_execute_wrong_model"] = \
             not should_fail_execute("other_model")
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        reset()
+
+    # 5b) the generation serving kind: cancel_request is model-scoped
+    # with the usual nth/count window — the engine asks once per
+    # (tick, active sequence) and exactly one mid-stream disconnect
+    # fires
+    os.environ["MXNET_CHAOS"] = "cancel_request:model=gen,nth=2,count=1"  # mxlint: disable=MXL002
+    reset()
+    try:
+        checks["cancel_wrong_model"] = \
+            not should_cancel_request("other")
+        fires = [should_cancel_request("gen") for _ in range(3)]
+        checks["cancel_nth_count"] = fires == [False, True, False]
+        checks["cancel_injected_total"] = \
+            injected_total("cancel_request") == 1
     finally:
         del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
         reset()

@@ -282,7 +282,8 @@ register("MXNET_CHAOS", "str", None,
          "Fault-injection spec: semicolon-separated rules "
          "'kind:k=v,k=v' with kinds drop_push / delay_collective / "
          "kill / nan_grad / slow_request / fail_execute / "
-         "corrupt_shard / bad_version / slow_decode / kill_rank "
+         "corrupt_shard / bad_version / slow_decode / kill_rank / "
+         "cancel_request "
          "(see mxnet_tpu/chaos.py).  Unset disables all injection.")
 
 # module — non-finite gradient guard
@@ -456,6 +457,34 @@ register("MXNET_SERVE_ROLLBACK_ERR_RATIO", "float", 2.0,
          "error rate exceeds the stable version's error rate over the "
          "same window times this ratio (a canary that errors while "
          "stable is clean always rolls back).")
+
+# serving/generate.py — autoregressive generation (paged KV cache +
+# continuous batching)
+register("MXNET_SERVE_KV_BLOCK_TOKENS", "int", 16,
+         "Tokens per paged-KV-cache block.  Also the rounding unit of "
+         "the prompt/cache bucket ladders, so every compiled shape is "
+         "a whole number of blocks.")
+register("MXNET_SERVE_GEN_SLOTS", "int", 8,
+         "Concurrent sequences per generator (the continuous-batching "
+         "slot count); also the top of the decode batch ladder.")
+register("MXNET_SERVE_GEN_MAX_PROMPT", "int", 64,
+         "Largest admissible prompt (tokens); the top of the compiled "
+         "prefill prompt-length ladder (rounded up to a block).")
+register("MXNET_SERVE_GEN_MAX_CONTEXT", "int", 256,
+         "Largest prompt+output context (tokens); the top of the "
+         "compiled decode cache-length ladder (rounded up to a "
+         "block).")
+register("MXNET_SERVE_GEN_MAX_NEW", "int", 32,
+         "Default (and maximum) new tokens per generation request; "
+         "submits asking for more shed with reason=too_large.")
+register("MXNET_SERVE_GEN_BLOCKS", "int", 0,
+         "KV-cache pool size in blocks (excluding the garbage block); "
+         "0 sizes it so every slot can hold a full max-context "
+         "sequence (no eviction pressure).")
+register("MXNET_SERVE_GEN_PREFILL_BATCH", "int", 4,
+         "Largest batched prefill (sequences admitted per tick); the "
+         "top of the prefill batch ladder.  Bounds prefill's "
+         "head-of-line blocking of in-flight decode ticks.")
 
 # image/image.py — decode pool
 register("MXNET_CPU_WORKER_NTHREADS", "int", 1,

@@ -28,24 +28,55 @@ Quickstart::
     srv.install_preemption_hook()         # SIGTERM -> drain -> exit 83
     out = srv.predict("resnet", batch, deadline_ms=250)
 
+The GENERATION tier (serving/generate.py) extends the same machinery
+to autoregressive decode: prefill/decode split with 2-D bucket-ladder
+plans (zero steady-state recompiles, instrument_jit-verified), a paged
+KV-cache allocator (kvcache.py — fixed token blocks, free list, block
+tables gathered inside the compiled step), continuous per-slot
+batching (a finished sequence's slot refills next tick without
+draining co-riders), token streaming over chunked HTTP, and TTFT/TPOT
+SLO load generation::
+
+    grt = serving.demo_generation_runtime("gen")
+    srv.add_generator(grt)                # warms every plan cell
+    req = srv.submit_generation("gen", prompt_ids, max_new=16,
+                                on_token=print)   # or srv.generate(..)
+    tokens = req.wait(30.0)["tokens"]     # req.cancel() mid-stream ok
+
 ``python -m mxnet_tpu.serving --self-test`` exercises admission,
-deadline expiry, breaker trip/reset, and drain ordering (tier-1 via
-tests/test_serving.py); ``--serve`` runs the HTTP front-end.
+deadline expiry, breaker trip/reset, drain ordering, and the
+generation tier (decode equality, continuous batching, streaming,
+cancel reclaim) — tier-1 via tests/test_serving.py; ``--serve`` runs
+the HTTP front-end.
 """
 from .batching import Request, RequestQueue
-from .errors import (REJECT_REASONS, DeadlineExceeded, ExecutorFailure,
-                     Rejected, ServeError)
+from .bucket_ladder import (bucket_for, bucket_for_2d, ladder,
+                            ladder_2d)
+from .errors import (REJECT_REASONS, Cancelled, DeadlineExceeded,
+                     ExecutorFailure, Rejected, ServeError)
+from .generate import (GenerationEngine, GenerationRuntime, GenRequest,
+                       StubGenerationRuntime, demo_generation_runtime,
+                       stub_greedy_reference)
 from .http import HttpFrontend
-from .loadgen import BackgroundLoad, qps_at_slo, run_load
+from .kvcache import CacheExhausted, PagedKVCache
+from .loadgen import (BackgroundLoad, gen_tokens_at_slo, qps_at_slo,
+                      run_generation_load, run_load)
 from .runtime import (ModelRuntime, demo_params, demo_runtime,
                       plan_batch_buckets)
 from .server import CircuitBreaker, ModelServer
 
 __all__ = [
     "Request", "RequestQueue", "ServeError", "Rejected",
-    "DeadlineExceeded", "ExecutorFailure", "REJECT_REASONS",
+    "DeadlineExceeded", "ExecutorFailure", "Cancelled",
+    "REJECT_REASONS",
     "ModelRuntime", "demo_runtime", "demo_params",
     "plan_batch_buckets",
+    "ladder", "ladder_2d", "bucket_for", "bucket_for_2d",
+    "PagedKVCache", "CacheExhausted",
+    "GenRequest", "GenerationRuntime", "GenerationEngine",
+    "demo_generation_runtime", "StubGenerationRuntime",
+    "stub_greedy_reference",
     "CircuitBreaker", "ModelServer", "HttpFrontend",
-    "run_load", "qps_at_slo", "BackgroundLoad",
+    "run_load", "qps_at_slo", "run_generation_load",
+    "gen_tokens_at_slo", "BackgroundLoad",
 ]

@@ -295,6 +295,161 @@ def _self_test() -> tuple:
     checks["ckpt_verify_cli_exit"] = _ckpt.main(["--verify", ckdir,
                                                  "--json"]) == 1
 
+    # 10) generation tier: greedy decode through the paged-cache
+    # continuous batcher matches the dense reference token for token,
+    # slots refill mid-flight (more requests than slots all complete
+    # in one server life), and every prefill/decode plan cell is
+    # dispatched through its SINGLE instrumented warmup entry (zero
+    # steady-state recompiles).  All five generators here are
+    # StubGenerationRuntime — the real engine/allocator/plans on a
+    # host-only token rule, so the groups run in milliseconds; the
+    # real-model numerics pins live in tests/test_zz_generate_e2e.py.
+    from .generate import StubGenerationRuntime, stub_greedy_reference
+
+    grt = StubGenerationRuntime("gen_st", slots=2, max_prompt=16,
+                                max_context=32, block_tokens=16,
+                                max_new=8, prefill_batch=2)
+    gsrv = ModelServer(queue_max=16, default_deadline_ms=30_000)
+    gsrv.add_generator(grt)
+    checks["gen_ready"] = gsrv.ready()["ready"] is True
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 64, size=n).astype("int32")
+               for n in (3, 10, 6, 14, 2)]  # 5 requests > 2 slots
+    greqs = [gsrv.submit_generation("gen_st", p, max_new=6)
+             for p in prompts]
+    gres = [r.wait(30.0) for r in greqs]
+
+    checks["gen_greedy_matches_dense_reference"] = all(
+        res["tokens"] == stub_greedy_reference(p, 6)
+        for p, res in zip(prompts, gres))
+    checks["gen_continuous_slots_refill"] = \
+        len(gres) == 5 and all(len(r["tokens"]) == 6 for r in gres)
+    gstats = _diag.recompile_stats()
+    gcells = {k: v["count"] for k, v in gstats.items()
+              if ":gen_st:" in k}
+    checks["gen_zero_steady_state_recompiles"] = (
+        len(gcells) == len(grt.prefill_plan) + len(grt.decode_plan)
+        and all(c == 1 for c in gcells.values()))
+    checks["gen_kv_blocks_reclaimed"] = \
+        grt.kv.stats()["blocks_live"] == 0
+
+    # 11) streaming + cancel: tokens cross the on_token callback in
+    # result order (None marks end-of-stream); a cancel mid-stream
+    # resolves the caller with Cancelled and reclaims every cache
+    # block, with the co-riding sequence untouched
+    crt = StubGenerationRuntime("gen_can", slots=2, max_prompt=16,
+                                max_context=64, block_tokens=16,
+                                max_new=32, prefill_batch=2)
+    csrv = ModelServer(queue_max=16, default_deadline_ms=30_000)
+    csrv.add_generator(crt)
+    streamed = []
+    sreq = csrv.submit_generation("gen_can", [1, 2, 3], max_new=5,
+                                  on_token=streamed.append)
+    sres = sreq.wait(30.0)
+    checks["gen_streaming_order"] = \
+        streamed == sres["tokens"] + [None]
+    first_tok = threading.Event()
+    victim = csrv.submit_generation(
+        "gen_can", [4, 5], max_new=32,
+        on_token=lambda t: (first_tok.set(), time.sleep(0.002)))
+    rider = csrv.submit_generation("gen_can", [6, 7, 8], max_new=8)
+    first_tok.wait(10.0)
+    victim.cancel()
+    try:
+        victim.wait(10.0)
+        checks["gen_cancel_resolves"] = False
+    except Exception as ce:
+        checks["gen_cancel_resolves"] = \
+            type(ce).__name__ == "Cancelled"
+    checks["gen_cancel_spares_corider"] = \
+        len(rider.wait(30.0)["tokens"]) == 8
+    deadline = time.monotonic() + 5.0
+    while crt.kv.stats()["blocks_live"] and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    checks["gen_cancel_zero_leaked_blocks"] = \
+        crt.kv.stats()["blocks_live"] == 0
+
+    # 12) the robustness layer carries over: chaos fail_execute on the
+    # generator trips its breaker (submits fast-fail breaker_open),
+    # and a waiting sequence whose deadline passes while the only slot
+    # is busy expires without executing
+    import os
+
+    from .. import chaos as _chaos
+
+    brt = StubGenerationRuntime("gen_brk", slots=1, max_prompt=16,
+                                max_context=32, block_tokens=16,
+                                max_new=8, prefill_batch=1)
+    bsrv = ModelServer(queue_max=16, default_deadline_ms=30_000,
+                       breaker_n=2, breaker_reset_s=30.0)
+    bsrv.add_generator(brt)
+    _kn = "fail_execute:model=gen_brk,count=99"
+    os.environ["MXNET_CHAOS"] = _kn  # mxlint: disable=MXL002
+    _chaos.reset()
+    try:
+        for _ in range(2):
+            fr = bsrv.submit_generation("gen_brk", [1, 2], max_new=2)
+            try:
+                fr.wait(15.0)
+            except ExecutorFailure:
+                pass
+        deadline = time.monotonic() + 5.0
+        while bsrv._get("gen_brk").breaker.state() == "closed" and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
+        checks["gen_breaker_trips"] = \
+            bsrv._get("gen_brk").breaker.state() != "closed"
+        try:
+            bsrv.submit_generation("gen_brk", [1], max_new=1)
+            checks["gen_breaker_fast_fails"] = False
+        except Rejected as e:
+            checks["gen_breaker_fast_fails"] = \
+                e.reason == "breaker_open"
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        _chaos.reset()
+    drt = StubGenerationRuntime("gen_dl", slots=1, max_prompt=16,
+                                max_context=64, block_tokens=16,
+                                max_new=48, prefill_batch=1)
+    dsrv = ModelServer(queue_max=16, default_deadline_ms=30_000)
+    dsrv.add_generator(drt)
+    hog = dsrv.submit_generation(
+        "gen_dl", [1, 2, 3], max_new=48,
+        on_token=lambda t: time.sleep(0.005))  # ~240ms of decode ticks
+    late = dsrv.submit_generation("gen_dl", [4, 5], max_new=2,
+                                  deadline_ms=50)
+    try:
+        late.wait(15.0)
+        checks["gen_waiting_deadline_expires"] = False
+    except DeadlineExceeded:
+        checks["gen_waiting_deadline_expires"] = True
+    except Exception:
+        checks["gen_waiting_deadline_expires"] = False
+    checks["gen_hog_unaffected"] = \
+        len(hog.wait(30.0)["tokens"]) == 48
+
+    # 13) generation drain: queued + in-flight generations all finish,
+    # zero left, post-drain submits shed with reason=draining
+    qrt = StubGenerationRuntime("gen_dr", slots=2, max_prompt=16,
+                                max_context=32, block_tokens=16,
+                                max_new=8, prefill_batch=2)
+    qsrv = ModelServer(queue_max=16, default_deadline_ms=30_000)
+    qsrv.add_generator(qrt)
+    dpend = [qsrv.submit_generation("gen_dr", [i + 1, i + 2],
+                                    max_new=4) for i in range(5)]
+    drep = qsrv.drain(timeout_s=20.0)
+    checks["gen_drain_zero_left"] = \
+        drep["drained"] and drep["left"] == 0
+    checks["gen_drain_completes_admitted"] = all(
+        r.done() and r.error is None and len(r.tokens) == 4
+        for r in dpend)
+    try:
+        qsrv.submit_generation("gen_dr", [1], max_new=1)
+        checks["gen_post_drain_sheds"] = False
+    except Rejected as e:
+        checks["gen_post_drain_sheds"] = e.reason == "draining"
+
     return all(checks.values()), checks
 
 
@@ -303,14 +458,24 @@ def _serve(port: int) -> int:
     SIGTERM-drainable via the shared preemption-hook path."""
     from .http import HttpFrontend
 
+    from .generate import demo_generation_runtime
+
     rt = demo_runtime()
     srv = ModelServer()
     srv.add_model(rt)
+    grt = demo_generation_runtime("demo_gen", n_layers=1, slots=2,
+                                  max_prompt=16, max_context=64,
+                                  max_new=32, prefill_batch=2)
+    grt.compile(warmup=True)
+    srv.add_generator(grt)
     srv.install_preemption_hook()
     fe = HttpFrontend(srv, port=port)
     host, bound = fe.start()
     print(json.dumps({"serving": rt.name, "host": host, "port": bound,
-                      "buckets": list(rt.plan)}), flush=True)
+                      "buckets": list(rt.plan),
+                      "generating": grt.name,
+                      "decode_plan": [list(c) for c in grt.decode_plan]}),
+          flush=True)
     try:
         while srv.live():
             time.sleep(0.5)
@@ -328,7 +493,10 @@ def main(argv=None) -> int:
         description="batching model server: self-test / demo serve")
     ap.add_argument("--self-test", action="store_true",
                     help="exercise queue admission, deadline expiry, "
-                         "breaker trip/reset, drain ordering")
+                         "breaker trip/reset, drain ordering, and the "
+                         "generation tier (paged-cache decode "
+                         "equality, continuous batching, streaming, "
+                         "cancel reclaim)")
     ap.add_argument("--serve", action="store_true",
                     help="serve the demo model over HTTP until SIGTERM")
     ap.add_argument("--port", type=int, default=None,

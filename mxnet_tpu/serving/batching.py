@@ -256,3 +256,22 @@ class RequestQueue:
                 self._cond.wait(min(max(deadline - now, 0.0), poll_s)
                                 or poll_s)
         return batch
+
+    # -- consumer side: continuous-batching admission ------------------
+    def poll(self, max_requests: int) -> Optional[List[Request]]:
+        """Non-blocking per-slot admission for the CONTINUOUS batcher
+        (the generation tier): pop up to ``max_requests`` whole
+        requests RIGHT NOW — the decode loop calls this once per tick
+        with its free-slot count, so a finished sequence's slot refills
+        next tick without draining co-riders.  Expired requests are
+        purged first and never ride.  Returns ``None`` when the queue
+        is closed AND empty (drain complete — same contract as
+        :meth:`take_batch`), else a possibly-empty list."""
+        self.purge_expired()
+        out: List[Request] = []
+        with self._cond:
+            if not self._pending and self._closed:
+                return None
+            while self._pending and len(out) < int(max_requests):
+                out.append(self._pending.popleft())
+        return out

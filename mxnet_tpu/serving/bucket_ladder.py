@@ -1,0 +1,84 @@
+"""Shared bucket-ladder planning: the doubling-ladder idiom behind
+``plan_batch_buckets``, factored out so BOTH fixed-shape predictors and
+the generation tier (prefill per ``(batch, prompt_len)``, decode per
+``(batch, cache_len)``) plan their compiled shapes the same way.
+
+The contract is the one ``parallel/buckets.partition`` set and
+``plan_batch_buckets`` inherited: a plan is deterministic, computed
+once, size-capped, and every payload size maps to exactly ONE bucket
+(the smallest holding it) — at most 2x padding waste, log2(cap)
+compiled programs per axis.  The 2-D extension is a cross product of
+two 1-D ladders: a decode step at ``n`` active slots over ``L`` cached
+tokens lands in exactly one ``(batch_bucket, len_bucket)`` cell, so the
+steady-state compile count is bounded at plan time and
+``analysis.check_decode_buckets`` can audit every traced shape against
+the declared plan.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+__all__ = ["ladder", "ladder_2d", "bucket_for", "bucket_for_2d"]
+
+
+def ladder(cap: int, sizes: Optional[Sequence[int]] = None, *,
+           min_size: int = 1) -> Tuple[int, ...]:
+    """One doubling ladder: explicit ``sizes`` (sorted, deduped,
+    capped, cap appended) or ``min_size, 2*min_size, ..., cap``.  With
+    ``min_size=1`` this is bit-for-bit the historical
+    ``plan_batch_buckets`` plan — fixed-shape predictors keep their
+    exact ladders (pinned by test_plan_batch_buckets).  ``min_size``
+    exists for the generation axes, where a floor (e.g. one cache
+    block) bounds the compile count without a useless bucket-of-1."""
+    cap = max(int(cap), 1)
+    if sizes:
+        out = sorted({int(s) for s in sizes if 0 < int(s) <= cap})
+        if not out or out[-1] != cap:
+            out.append(cap)
+        return tuple(out)
+    lo = max(int(min_size), 1)
+    if lo > cap:
+        return (cap,)
+    out = []
+    b = lo
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(cap)
+    return tuple(out)
+
+
+def ladder_2d(cap_a: int, cap_b: int, *,
+              sizes_a: Optional[Sequence[int]] = None,
+              sizes_b: Optional[Sequence[int]] = None,
+              min_a: int = 1, min_b: int = 1
+              ) -> Tuple[Tuple[int, int], ...]:
+    """The 2-D plan: cross product of two 1-D ladders, row-major.  A
+    payload ``(n_a, n_b)`` maps to exactly one cell (smallest bucket
+    per axis, independently), so the compile budget is
+    ``len(ladder_a) * len(ladder_b)`` — known before the first
+    request, never grown by traffic."""
+    la = ladder(cap_a, sizes_a, min_size=min_a)
+    lb = ladder(cap_b, sizes_b, min_size=min_b)
+    return tuple((a, b) for a in la for b in lb)
+
+
+def bucket_for(plan: Sequence[int], n: int) -> int:
+    """Smallest bucket in ``plan`` holding ``n`` — the single-bucket
+    mapping every size-capped plan guarantees."""
+    for b in plan:
+        if n <= b:
+            return int(b)
+    raise ValueError("%d > plan cap %d" % (n, max(plan)))
+
+
+def bucket_for_2d(plan: Sequence[Tuple[int, int]], n_a: int, n_b: int
+                  ) -> Tuple[int, int]:
+    """Smallest ``(a, b)`` cell of a 2-D plan holding ``(n_a, n_b)`` —
+    axes resolve independently, so the cell is unique."""
+    ba = bucket_for(sorted({a for a, _ in plan}), n_a)
+    bb = bucket_for(sorted({b for _, b in plan}), n_b)
+    if (ba, bb) not in set((int(a), int(b)) for a, b in plan):
+        raise ValueError("(%d, %d) not a cell of the declared plan"
+                         % (ba, bb))
+    return ba, bb

@@ -8,12 +8,12 @@ from __future__ import annotations
 from typing import Optional
 
 __all__ = ["ServeError", "Rejected", "DeadlineExceeded",
-           "ExecutorFailure", "REJECT_REASONS"]
+           "ExecutorFailure", "Cancelled", "REJECT_REASONS"]
 
 #: the closed set of admission-rejection reasons (metric label values)
 REJECT_REASONS = ("queue_full", "breaker_open", "draining", "too_large",
                   "unknown_model", "bad_input", "deadline",
-                  "reload_in_progress")
+                  "reload_in_progress", "cancelled")
 
 
 class ServeError(RuntimeError):
@@ -48,3 +48,10 @@ class ExecutorFailure(ServeError):
     """The compiled executor raised while running the batch this
     request rode in.  Consecutive failures trip the model's circuit
     breaker."""
+
+
+class Cancelled(ServeError):
+    """The caller abandoned a generation mid-stream (client disconnect,
+    explicit ``GenRequest.cancel()``, or the chaos ``cancel_request``
+    kind).  The sequence's slot and cache blocks are reclaimed on the
+    next decode tick; co-riding sequences are untouched."""

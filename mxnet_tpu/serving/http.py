@@ -16,7 +16,16 @@ Routes:
   * ``POST /v1/models/<name>:reload`` — body ``{"directory": "...",
     "step": N?, "wait_s": S?}``; kicks the zero-downtime reload
     (verify -> compile+warm -> canary -> promote/rollback) and
-    responds 202 with the reload state (200 terminal when waited).
+    responds 202 with the reload state (200 terminal when waited);
+  * ``POST /v1/models/<name>:generate`` — body ``{"prompt": [ids...],
+    "max_new": N?, "deadline_ms": D?, "stream": bool?}``.  Non-stream:
+    one JSON reply ``{"tokens": [...], "prompt_len": P}``.  Stream:
+    ``Transfer-Encoding: chunked``, one JSON line per token flushed as
+    it is decoded (``{"token": id, "index": i}``, then a terminal
+    ``{"done": true, ...}`` line) — a client that disconnects
+    mid-stream CANCELS the generation (slot + cache blocks reclaimed
+    next decode tick, co-riding sequences untouched, 499 in the
+    rejection ledger).
 
 Status mapping is the load-shedding contract made visible: 429 +
 ``Retry-After`` for a shed (queue_full), 503 + ``Retry-After`` for an
@@ -28,10 +37,12 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
-from .errors import DeadlineExceeded, ExecutorFailure, Rejected
+from .errors import (Cancelled, DeadlineExceeded, ExecutorFailure,
+                     Rejected)
 
 __all__ = ["HttpFrontend", "REASON_STATUS"]
 
@@ -42,6 +53,9 @@ REASON_STATUS = {
     "queue_full": 429, "breaker_open": 503, "draining": 503,
     "too_large": 413, "unknown_model": 404, "bad_input": 400,
     "deadline": 504, "reload_in_progress": 409,
+    # nginx's "client closed request" — never sent on the wire (the
+    # client is gone), but it keeps the rejection ledger uniform
+    "cancelled": 499,
 }
 
 
@@ -114,6 +128,9 @@ class _Handler(BaseHTTPRequestHandler):
         if verb == "reload":
             self._do_reload(model)
             return
+        if verb == "generate":
+            self._do_generate(model)
+            return
         try:
             length = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(length) or b"{}")
@@ -180,9 +197,127 @@ class _Handler(BaseHTTPRequestHandler):
             _log.exception("http: reload failed")
             self._reply(500, {"error": repr(e)})
 
+    def _do_generate(self, model: str) -> None:
+        """``POST /v1/models/<name>:generate``.  The streaming path is
+        where continuous batching meets the transport: tokens cross
+        from the engine thread over a queue and are flushed chunk by
+        chunk as they decode; a write failure (client gone) cancels
+        the generation at the server."""
+        import queue as _q
+
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object, got %s"
+                                 % type(payload).__name__)
+            prompt = payload["prompt"]
+            max_new = payload.get("max_new")
+            if max_new is not None:
+                max_new = int(max_new)
+            stream = bool(payload.get("stream", False))
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(400, {"error": "bad generate body: %r" % e})
+            return
+        deadline_ms = payload.get("deadline_ms", "default")
+        tokens_q: "_q.Queue" = _q.Queue()
+        try:
+            req = self._srv.submit_generation(
+                model, prompt, max_new=max_new,
+                deadline_ms=deadline_ms,
+                on_token=(tokens_q.put if stream else None))
+        except Rejected as e:
+            self._reply(REASON_STATUS.get(e.reason, 503),
+                        {"error": str(e), "reason": e.reason},
+                        retry_after=e.retry_after_s)
+            return
+        except Exception as e:
+            _log.exception("http: generate submit failed")
+            self._reply(500, {"error": repr(e)})
+            return
+        if not stream:
+            self._finish_generate_blocking(req)
+            return
+        # streaming: chunked transfer, one JSON line per token,
+        # flushed the moment the engine decodes it
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonlines")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        idx = 0
+        try:
+            while True:
+                try:
+                    tok = tokens_q.get(timeout=0.25)
+                except _q.Empty:
+                    if req.done():  # error/cancel with no end marker
+                        break
+                    continue
+                if tok is None:  # engine's end-of-stream marker
+                    break
+                self._write_chunk({"token": int(tok), "index": idx})
+                idx += 1
+            req.wait(0.0 if req.done() else 5.0)
+            self._write_chunk({"done": True, "tokens": idx,
+                              "prompt_len": len(req.prompt)})
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client went away mid-stream: reclaim the slot + blocks
+            req.cancel()
+            self._count_cancel()
+            return
+        except Cancelled:
+            self._count_cancel()
+            self._write_chunk_quiet({"done": False,
+                                     "reason": "cancelled"})
+        except (DeadlineExceeded, ExecutorFailure, Rejected) as e:
+            self._write_chunk_quiet({"done": False, "error": str(e)})
+        try:
+            self.wfile.write(b"0\r\n\r\n")  # terminal chunk
+            self.wfile.flush()
+        except OSError:
+            req.cancel()
+
+    def _finish_generate_blocking(self, req) -> None:
+        try:
+            timeout_s = 30.0 if req.deadline_ts is None else \
+                max(req.deadline_ts - time.monotonic(), 0.0) + 5.0
+            self._reply(200, req.wait(timeout_s))
+        except Rejected as e:
+            self._reply(REASON_STATUS.get(e.reason, 503),
+                        {"error": str(e), "reason": e.reason},
+                        retry_after=e.retry_after_s)
+        except DeadlineExceeded as e:
+            self._reply(504, {"error": str(e), "reason": "deadline"})
+        except Cancelled as e:
+            self._count_cancel()
+            self._reply(REASON_STATUS["cancelled"],
+                        {"error": str(e), "reason": "cancelled"})
+        except ExecutorFailure as e:
+            self._reply(500, {"error": str(e), "reason": "executor"})
+        except Exception as e:
+            _log.exception("http: generate failed")
+            self._reply(500, {"error": repr(e)})
+
+    def _write_chunk(self, obj: dict) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self.wfile.flush()  # per-token flush IS the streaming contract
+
+    def _write_chunk_quiet(self, obj: dict) -> None:
+        try:
+            self._write_chunk(obj)
+        except OSError:
+            pass
+
+    def _count_cancel(self) -> None:
+        try:
+            self._srv._count_rejected("cancelled")
+        except Exception:
+            pass
+
     def _route_model(self) -> Tuple[Optional[str], Optional[str]]:
         prefix = "/v1/models/"
-        for verb in ("predict", "reload"):
+        for verb in ("predict", "reload", "generate"):
             suffix = ":" + verb
             if self.path.startswith(prefix) and \
                     self.path.endswith(suffix):

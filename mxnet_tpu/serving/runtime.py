@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
+from .bucket_ladder import ladder as _ladder
 from .errors import ExecutorFailure
 
 __all__ = ["plan_batch_buckets", "ModelRuntime", "demo_runtime",
@@ -41,20 +42,11 @@ def plan_batch_buckets(max_batch: int,
     planning contract as ``parallel/buckets.partition``: deterministic,
     size-capped, and every request batch maps to exactly one bucket
     (the smallest holding it) — at most 2x padding waste, log2(max)
-    compiled programs."""
-    cap = max(int(max_batch), 1)
-    if batch_sizes:
-        sizes = sorted({int(b) for b in batch_sizes if 0 < int(b) <= cap})
-        if not sizes or sizes[-1] != cap:
-            sizes.append(cap)
-        return tuple(sizes)
-    out = []
-    b = 1
-    while b < cap:
-        out.append(b)
-        b *= 2
-    out.append(cap)
-    return tuple(out)
+    compiled programs.  Delegates to the shared
+    :mod:`~mxnet_tpu.serving.bucket_ladder` helper (min_size=1), whose
+    1-D plan is bit-for-bit this function's historical output — the
+    fixed-shape predictors' ladders are pinned."""
+    return _ladder(max_batch, batch_sizes, min_size=1)
 
 
 class ModelRuntime:

@@ -225,6 +225,36 @@ class ModelServer:
             self._models[runtime.name] = sm
         sm.worker.start()
 
+    def add_generator(self, runtime, warmup: bool = True) -> None:
+        """Register + AOT-compile a GENERATION runtime
+        (:class:`~mxnet_tpu.serving.generate.GenerationRuntime`) and
+        start its continuous-batching engine loop.  Everything else —
+        queue, breaker, drain, canary reload, readiness — is the same
+        machinery the predictor tier uses; only the worker differs
+        (per-slot admission + decode ticks instead of take_batch +
+        dispatch)."""
+        if runtime.name in self._models:
+            raise ValueError("model %r already served" % runtime.name)
+        runtime.version = getattr(runtime, "version", 1) or 1
+        sm = _ServedModel(runtime, self.queue_max, self._breaker_n,
+                          self._breaker_reset_s,
+                          on_expired=lambda r: self._count_outcome(
+                              runtime.name, "expired",
+                              self._version_of(runtime.name)))
+        sm.is_generator = True
+        #: promoted-away runtimes whose engines still hold riders —
+        #: they keep ticking (no new admissions) until empty, so a hot
+        #: swap never drops an in-flight generation
+        sm.gen_retired = []
+        if hasattr(runtime, "compile") and not runtime.compiled:
+            runtime.compile(warmup=warmup)
+        sm.worker = threading.Thread(
+            target=self._gen_worker_loop, args=(sm,), daemon=True,
+            name="mx-serve-%s" % runtime.name)
+        with self._lock:
+            self._models[runtime.name] = sm
+        sm.worker.start()
+
     def models(self) -> List[str]:
         with self._lock:
             return sorted(self._models)
@@ -301,6 +331,90 @@ class ModelServer:
                 (req.deadline_ts - time.monotonic()) + slack
         return req.wait(timeout_s)
 
+    # -- generation submission ----------------------------------------
+    def submit_generation(self, model: str, prompt, *,
+                          max_new: Optional[int] = None,
+                          deadline_ms: Any = "default",
+                          on_token=None,
+                          request_id: Optional[str] = None):
+        """Admit one generation request (``prompt``: 1-D int token
+        ids) or shed it — the same admission gates as :meth:`submit`
+        (draining, shape, breaker, bounded queue, deadline), plus the
+        generation-specific feasibility gates: prompt within the
+        compiled prompt ladder, ``prompt + max_new`` within the cache
+        ladder AND the block pool.  Returns the
+        :class:`~mxnet_tpu.serving.generate.GenRequest` future;
+        ``wait()`` it, stream via ``on_token``, abandon via
+        ``.cancel()``."""
+        import numpy as np
+
+        from .generate import GenRequest
+
+        sm = self._get(model)
+        rt = sm.runtime
+        if not getattr(sm, "is_generator", False):
+            self._count_rejected("bad_input")
+            raise Rejected("bad_input",
+                           "model %r is a predictor, not a generator"
+                           % model)
+        if self._draining:
+            self._count_rejected("draining")
+            raise Rejected("draining", "server is draining")
+        arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if arr.size < 1:
+            self._count_rejected("bad_input")
+            raise Rejected("bad_input", "empty prompt")
+        mn = rt.max_new if max_new is None else max(int(max_new), 1)
+        if arr.size > rt.max_prompt:
+            self._count_rejected("too_large")
+            raise Rejected("too_large",
+                           "prompt of %d tokens > max prompt %d"
+                           % (arr.size, rt.max_prompt))
+        need_blocks = -(-(arr.size + mn) // rt.block_tokens)
+        if arr.size + mn > rt.max_context or \
+                need_blocks > rt.kv.num_blocks - 1:
+            self._count_rejected("too_large")
+            raise Rejected(
+                "too_large",
+                "%d prompt + %d new tokens exceeds max context %d "
+                "(or the %d-block cache pool)"
+                % (arr.size, mn, rt.max_context, rt.kv.num_blocks - 1))
+        if not sm.breaker.admit():
+            self._count_rejected("breaker_open")
+            raise Rejected(
+                "breaker_open",
+                "model %r breaker is open after consecutive executor "
+                "failures" % model,
+                retry_after_s=sm.breaker.retry_after_s())
+        deadline_s = self.default_deadline_s \
+            if deadline_ms == "default" else (
+                None if deadline_ms is None else float(deadline_ms) / 1e3)
+        req = GenRequest(model, arr, mn, deadline_s=deadline_s,
+                         request_id=request_id, on_token=on_token)
+        try:
+            sm.queue.offer(req, retry_after_s=self._retry_after(sm))
+        except Rejected as e:
+            sm.breaker.abort_probe()
+            self._count_rejected(e.reason)
+            raise
+        self._gauge_depth(sm)
+        return req
+
+    def generate(self, model: str, prompt, *,
+                 max_new: Optional[int] = None,
+                 deadline_ms: Any = "default",
+                 timeout_s: Optional[float] = None):
+        """submit_generation + wait.  Returns the result dict
+        ``{tokens, prompt_len}``."""
+        req = self.submit_generation(model, prompt, max_new=max_new,
+                                     deadline_ms=deadline_ms)
+        if timeout_s is None:
+            sm = self._get(model)
+            slack = max(sm.ewma_batch_s * 4 * req.max_new, 5.0)
+            timeout_s = slack if req.deadline_ts is None else \
+                (req.deadline_ts - time.monotonic()) + slack
+        return req.wait(timeout_s)
+
     def _retry_after(self, sm: _ServedModel) -> float:
         """Shed hint: how long until a full queue's worth of work
         drains at the current batch rate."""
@@ -349,6 +463,138 @@ class ModelServer:
                 # so queue-depth/deadline behavior is what's exercised
                 _chaos.maybe_slow_request(sm.runtime.name)
             self._dispatch(sm, live)
+
+    # -- generation worker: the continuous-batching engine loop --------
+    def _gen_worker_loop(self, sm: _ServedModel) -> None:
+        """One tick per iteration: admit per-slot (queue.poll with the
+        engines' free-slot count), reap/prefill/decode every engine —
+        stable, canary (per-SEQUENCE Bresenham routing), and any
+        promoted-away runtime still finishing riders — then feed the
+        breaker/canary evidence exactly as the predictor dispatch path
+        does.  Exits when the queue reports drain-complete and every
+        engine is empty: the SIGTERM drain finishes every admitted
+        generation."""
+        from .. import diagnostics as _diag
+
+        prev_stable = sm.runtime
+        prev_canary = None
+        while True:
+            _diag.touch_heartbeat()
+            stable = sm.runtime
+            with sm._lock:
+                canary = sm.canary
+            # reload transitions since last tick
+            if prev_canary is not None and canary is None:
+                if stable is prev_canary:
+                    # promoted: the old stable's riders finish on it
+                    if not prev_stable.engine.idle():
+                        sm.gen_retired.append(prev_stable)
+                else:
+                    # rolled back: the canary's riders are aborted —
+                    # a bad version must not keep streaming tokens
+                    outs = prev_canary.engine.abort_all(
+                        lambda r: ExecutorFailure(
+                            "version v%d rolled back mid-generation"
+                            % prev_canary.version))
+                    for req, outcome, _ in outs:
+                        self._count_outcome(stable.name, outcome,
+                                            prev_canary.version)
+                    with sm._lock:
+                        sm.failed += len(outs)
+            prev_stable, prev_canary = stable, canary
+            # per-slot admission, routed per sequence
+            free = stable.engine.free_slots() + \
+                (canary.engine.free_slots() if canary else 0)
+            polled = sm.queue.poll(free)
+            self._gauge_depth(sm)
+            for req in (polled or []):
+                eng = stable.engine
+                if canary is not None:
+                    with sm._lock:
+                        sm._canary_seq += 1
+                        seq = sm._canary_seq
+                    pct = max(min(self.canary_pct, 100.0), 0.0)
+                    if int(seq * pct) // 100 > \
+                            int((seq - 1) * pct) // 100:
+                        eng = canary.engine
+                eng.enqueue(req)
+            # tick every engine
+            worked = bool(polled)
+            engines = [(stable, False)]
+            if canary is not None:
+                engines.append((canary, True))
+            for rt, is_canary in engines:
+                worked |= self._gen_tick(sm, rt, is_canary)
+            for rt in list(sm.gen_retired):
+                worked |= self._gen_tick(sm, rt, False)
+                if rt.engine.idle():
+                    sm.gen_retired.remove(rt)
+            self._maybe_decide_canary(sm)
+            with sm._lock:
+                sm.inflight = sum(
+                    len(e.engine.active) + len(e.engine.waiting)
+                    for e in [stable] + ([canary] if canary else [])
+                    + sm.gen_retired)
+            self._gauge_inflight(sm)
+            if polled is None and sm.inflight == 0 and \
+                    not sm.gen_retired:
+                return  # drained: queue closed+empty, engines empty
+            if not worked:
+                time.sleep(0.001)  # idle tick: don't spin a core
+
+    def _gen_tick(self, sm: _ServedModel, rt, is_canary: bool) -> bool:
+        """step() one engine and account the report: outcomes ->
+        requests_total/latency, tokens -> tokens_total, executor
+        failures -> breaker (stable only) + canary evidence — the same
+        accounting split _dispatch applies to predictor batches."""
+        name = sm.runtime.name
+        t0 = time.monotonic()
+        rep = rt.engine.step(is_canary=is_canary)
+        tick_s = time.monotonic() - t0
+        for req, outcome, _err in rep["outcomes"]:
+            self._count_outcome(name, outcome, rt.version)
+            if outcome == "ok":
+                self._observe_latency(req)
+                with sm._lock:
+                    sm.completed += 1
+            elif outcome == "error":
+                with sm._lock:
+                    sm.failed += 1
+        if rep["tokens"]:
+            self._count_gen_tokens(name, rt.version, rep["tokens"])
+        if rep["exec_error"] is not None:
+            if is_canary:
+                self._record_version_result(sm, rt.version, ok=False)
+            else:
+                if sm.canary is not None:
+                    self._record_version_result(sm, rt.version,
+                                                ok=False)
+                if sm.breaker.on_failure():
+                    self._on_breaker_trip(sm)
+        elif rep["ticked"]:
+            sm.ewma_batch_s = 0.8 * sm.ewma_batch_s + 0.2 * tick_s
+            if is_canary:
+                self._record_version_result(sm, rt.version, ok=True)
+            else:
+                sm.breaker.on_success()
+                if sm.canary is not None:
+                    self._record_version_result(sm, rt.version, ok=True)
+        return bool(rep["ticked"] or rep["outcomes"])
+
+    def _count_gen_tokens(self, model: str, version: Optional[int],
+                          n: int) -> None:
+        try:
+            from .. import diagnostics as _diag
+
+            _diag.metrics.counter(
+                "mxnet_serve_gen_tokens_total",
+                help="generated tokens streamed to callers",
+                labels={"model": model,
+                        "version": "v%d" % version if version
+                        else "unknown"}).inc(n)
+            _diag.metrics.maybe_flush()
+        except Exception:
+            pass
 
     def _route(self, sm: _ServedModel):
         """Pick the runtime for THIS batch: the stable version, or —
@@ -783,6 +1029,9 @@ class ModelServer:
                 if canary is not None else None,
                 "reload": dict(sm.reload_state),
             }
+            if getattr(sm, "is_generator", False):
+                out[name]["kv"] = sm.runtime.kv.stats()
+                out[name]["tokens_out"] = sm.runtime.engine.tokens_out
         return out
 
     # -- metrics feeds (all guarded: telemetry never fails serving) ----

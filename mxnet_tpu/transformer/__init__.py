@@ -9,12 +9,15 @@ chaos / flight-recorder stack applies unchanged.  See README
 """
 from .data import LMTokenIter, make_corpus
 from .model import (ATTENTION_IMPLS, TransformerConfig, apply,
-                    attention_impl, init_params, lm_loss, make_attn_fn,
-                    param_shapes)
+                    apply_decode, apply_prefill, attention_impl,
+                    dense_causal_attn, gather_kv, init_params, lm_loss,
+                    make_attn_fn, param_shapes)
 from .train import TransformerTrainStep
 
 __all__ = [
     "ATTENTION_IMPLS", "TransformerConfig", "TransformerTrainStep",
-    "LMTokenIter", "make_corpus", "apply", "attention_impl",
-    "init_params", "lm_loss", "make_attn_fn", "param_shapes",
+    "LMTokenIter", "make_corpus", "apply", "apply_decode",
+    "apply_prefill", "attention_impl", "dense_causal_attn",
+    "gather_kv", "init_params", "lm_loss", "make_attn_fn",
+    "param_shapes",
 ]

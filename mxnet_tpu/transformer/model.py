@@ -42,6 +42,7 @@ from ..remat import checkpoint_scope, remat_policy
 __all__ = [
     "TransformerConfig", "ATTENTION_IMPLS", "attention_impl",
     "make_attn_fn", "param_shapes", "init_params", "apply", "lm_loss",
+    "dense_causal_attn", "gather_kv", "apply_prefill", "apply_decode",
 ]
 
 ATTENTION_IMPLS = ("flash", "ring", "ulysses")
@@ -185,16 +186,23 @@ def _rmsnorm(x, gain, eps):
 
 def _rope(x, positions, base):
     """Rotary position embedding over (B, T, H, Dh) with GLOBAL
-    ``positions`` (T,) — under sequence sharding each shard passes its
-    own global offsets, so rotation angles are placement-invariant."""
+    ``positions`` — (T,) shared across the batch (training / sequence
+    sharding: each shard passes its own global offsets, so rotation
+    angles are placement-invariant) or (B, T) per-sequence (decode:
+    every slot sits at its OWN cache cursor).  The (T,) path is
+    bit-for-bit the historical rotation."""
     import jax.numpy as jnp
 
     Dh = x.shape[-1]
     half = Dh // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(ang)[None, :, None, :]  # (1, T, 1, half)
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * freqs
+    if ang.ndim == 2:                     # (T, half)
+        cos = jnp.cos(ang)[None, :, None, :]  # (1, T, 1, half)
+        sin = jnp.sin(ang)[None, :, None, :]
+    else:                                 # (B, T, half)
+        cos = jnp.cos(ang)[:, :, None, :]     # (B, T, 1, half)
+        sin = jnp.sin(ang)[:, :, None, :]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :half], xf[..., half:]
     return jnp.concatenate(
@@ -266,3 +274,175 @@ def lm_loss(logits, labels):
     gold = jnp.take_along_axis(
         logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
     return jnp.mean(logz - gold)
+
+
+# ---------------------------------------------------------------------------
+# generation forwards: prefill/decode over a PAGED KV cache
+#
+# The cache is a per-layer pool of fixed-size token blocks
+# ``{"k<i>"|"v<i>": (num_blocks, block_tokens, H, Dh)}`` plus a
+# per-sequence block table (serving/kvcache.py owns allocation; block 0
+# is the GARBAGE block — every write from a padded position or an
+# inactive slot is routed there, so the compiled step never branches on
+# liveness).  Scatter runs BEFORE gather inside the decode step, so the
+# new token attends to itself through the same cache path as its
+# history — one code path, pinned by the greedy-equality tests.
+# ---------------------------------------------------------------------------
+def _masked_attn(q, k, v, mask):
+    """Naive dense attention with an explicit boolean ``mask``
+    (B, Tq, Tk): f32 scores/softmax, output cast back to q's dtype.
+    This single formulation IS the generation tier's reference math —
+    prefill, paged decode, and the equality tests all call it, so
+    "gather == dense" reduces to "the gathered inputs are identical"."""
+    import jax
+    import jax.numpy as jnp
+
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = jnp.where(mask[:, None, :, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def dense_causal_attn(q, k, v):
+    """Dense causal attention over (B, T, H, Dh) in the generation
+    tier's reference formulation — pass as ``attn_fn`` to :func:`apply`
+    to build the single-sequence reference the paged/continuous decode
+    must match token-for-token."""
+    import jax.numpy as jnp
+
+    t = q.shape[1]
+    causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+    return _masked_attn(q, k, v,
+                        jnp.broadcast_to(causal[None], (q.shape[0], t, t)))
+
+
+def _scatter_tokens(pool, x, block_tables, pos, block_tokens,
+                    valid=None):
+    """Write per-token K or V rows ``x`` (B, T, H, Dh) into the block
+    ``pool`` (N, block_tokens, H, Dh) at token positions ``pos``
+    (B, T), addressed through ``block_tables`` (B, W).  Positions with
+    ``valid`` False — prompt padding, inactive slots — collapse to flat
+    index 0: block 0 is the garbage block, its contents never read."""
+    import jax.numpy as jnp
+
+    bt = int(block_tokens)
+    blk = jnp.take_along_axis(block_tables, pos // bt, axis=1)
+    flat = blk * bt + pos % bt
+    if valid is not None:
+        flat = jnp.where(valid, flat, 0)
+    flat_pool = pool.reshape((-1,) + pool.shape[2:])
+    flat_pool = flat_pool.at[flat.reshape(-1)].set(
+        x.reshape((-1,) + x.shape[2:]).astype(pool.dtype))
+    return flat_pool.reshape(pool.shape)
+
+
+def gather_kv(pages, block_tables, layer):
+    """Gather one layer's cached K/V through the block tables:
+    ``(B, W)`` tables over ``(N, bt, H, Dh)`` pools -> two
+    ``(B, W*bt, H, Dh)`` dense views.  This is the read path INSIDE the
+    compiled decode step; the bitwise test drives it standalone."""
+    k = pages["k%d" % layer][block_tables]
+    v = pages["v%d" % layer][block_tables]
+    b, w, bt = k.shape[:3]
+    return (k.reshape((b, w * bt) + k.shape[3:]),
+            v.reshape((b, w * bt) + v.shape[3:]))
+
+
+def apply_prefill(params, tokens, prompt_lens, cfg: TransformerConfig,
+                  *, pages, block_tables, block_tokens):
+    """Prefill forward: right-padded prompts ``tokens`` (B, T) with
+    real lengths ``prompt_lens`` (B,) -> (last-real-token logits
+    (B, vocab) f32, new_pages).  Dense causal attention over the
+    padded length (causality makes the padding rows invisible to every
+    real row), with each layer's roped K and raw V scattered into the
+    paged cache so decode starts from a populated history.
+    ``block_tables`` is (B, T // block_tokens)."""
+    import jax.numpy as jnp
+
+    compute = jnp.dtype(cfg.dtype)
+    b, t = tokens.shape
+    positions = jnp.arange(t)
+    pos2 = jnp.broadcast_to(positions[None, :], (b, t))
+    valid = pos2 < prompt_lens[:, None]
+    causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+    mask = jnp.broadcast_to(causal[None], (b, t, t))
+    embed = params["embed"]
+    h = embed.astype(compute)[tokens]
+    new_pages = dict(pages)
+    for i in range(cfg.n_layers):
+        p = "blk%d." % i
+        a = _rmsnorm(h, params[p + "attn_norm"], cfg.eps)
+        qkv = a @ params[p + "wqkv"].astype(a.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, t, cfg.n_heads, cfg.head_dim)
+        q = _rope(q.reshape(shape), positions, cfg.rope_base)
+        k = _rope(k.reshape(shape), positions, cfg.rope_base)
+        v = v.reshape(shape)
+        for nm, val in (("k%d" % i, k), ("v%d" % i, v)):
+            new_pages[nm] = _scatter_tokens(
+                new_pages[nm], val, block_tables, pos2, block_tokens,
+                valid=valid)
+        o = _masked_attn(q, k, v, mask)
+        h = h + o.reshape(b, t, cfg.d_model) @ \
+            params[p + "wo"].astype(o.dtype)
+        m = _rmsnorm(h, params[p + "mlp_norm"], cfg.eps)
+        m = jnp.dot(_gelu(m @ params[p + "w1"].astype(m.dtype)),
+                    params[p + "w2"].astype(m.dtype))
+        h = h + m
+    h = _rmsnorm(h, params["final_norm"], cfg.eps)
+    last = h[jnp.arange(b), jnp.clip(prompt_lens - 1, 0, t - 1)]
+    acc = jnp.promote_types(compute, jnp.float32)
+    logits = jnp.einsum("bd,vd->bv", last.astype(acc),
+                        embed.astype(acc))
+    return logits, new_pages
+
+
+def apply_decode(params, tokens, positions, cfg: TransformerConfig, *,
+                 pages, block_tables, block_tokens):
+    """One decode tick: current tokens (B,) at cache cursors
+    ``positions`` (B,) -> (next-token logits (B, vocab) f32,
+    new_pages).  Per layer: rope q/k at the cursor, scatter k/v into
+    the paged cache, THEN gather (B, W*bt) history through the block
+    tables — the new token reads itself back through the cache — and
+    attend under the inclusive length mask.  Inactive slots ride along
+    with all-zero tables (every write lands in the garbage block) and
+    their logits are sliced off by the engine."""
+    import jax.numpy as jnp
+
+    compute = jnp.dtype(cfg.dtype)
+    b = tokens.shape[0]
+    span = block_tables.shape[1] * int(block_tokens)
+    pos2 = positions[:, None]
+    mask = (jnp.arange(span)[None, :] <= positions[:, None])[:, None, :]
+    mask = jnp.broadcast_to(mask, (b, 1, span))
+    embed = params["embed"]
+    h = embed.astype(compute)[tokens][:, None, :]
+    new_pages = dict(pages)
+    for i in range(cfg.n_layers):
+        p = "blk%d." % i
+        a = _rmsnorm(h, params[p + "attn_norm"], cfg.eps)
+        qkv = a @ params[p + "wqkv"].astype(a.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, 1, cfg.n_heads, cfg.head_dim)
+        q = _rope(q.reshape(shape), pos2, cfg.rope_base)
+        k = _rope(k.reshape(shape), pos2, cfg.rope_base)
+        v = v.reshape(shape)
+        for nm, val in (("k%d" % i, k), ("v%d" % i, v)):
+            new_pages[nm] = _scatter_tokens(
+                new_pages[nm], val, block_tables, pos2, block_tokens)
+        kc, vc = gather_kv(new_pages, block_tables, i)
+        o = _masked_attn(q, kc, vc, mask)
+        h = h + o.reshape(b, 1, cfg.d_model) @ \
+            params[p + "wo"].astype(o.dtype)
+        m = _rmsnorm(h, params[p + "mlp_norm"], cfg.eps)
+        m = jnp.dot(_gelu(m @ params[p + "w1"].astype(m.dtype)),
+                    params[p + "w2"].astype(m.dtype))
+        h = h + m
+    h = _rmsnorm(h, params["final_norm"], cfg.eps)
+    acc = jnp.promote_types(compute, jnp.float32)
+    logits = jnp.einsum("bd,vd->bv", h[:, 0].astype(acc),
+                        embed.astype(acc))
+    return logits, new_pages
