@@ -1056,6 +1056,122 @@ def _transformer_zero1_memory_probe(timeout=240):
         return {"error": repr(exc)}
 
 
+def _recommender_dims():
+    """Recommender bench dims: MXNET_BENCH_RECOMMENDER 'k=v,...' over
+    the defaults — vocab sized so the dense control's full-table pulls
+    are visibly expensive while the whole phase stays inside the
+    budget on a CPU box."""
+    from mxnet_tpu import env as _mxenv
+
+    dims = {"fields": 8, "vocab": 16384, "dim": 16, "batch": 128,
+            "steps": 10, "shards": 4}
+    spec = _mxenv.get_str("MXNET_BENCH_RECOMMENDER")
+    for part in (spec or "").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            if k.strip() in dims:
+                dims[k.strip()] = int(v)
+    return dims
+
+
+def bench_recommender():
+    """The ISSUE 19 acceptance row: embedding-dominated CTR training
+    samples/s, PS-sharded hot-row tier vs the dense full-table control
+    on the SAME Zipf clickstream, with the pulled-bytes ratio measured
+    from mxnet_kvstore_bytes_total counter deltas.
+
+    Wire accounting: both runs move the identical dense MLP-head
+    traffic under op=pull, so the control's TABLE traffic is
+    pull_delta(dense) - pull_delta(sparse); the sparse tier's table
+    traffic is the op=row_sparse_pull delta.  Their ratio must land
+    within 2x of the ideal unique_rows/(fields*vocab) (the row-id
+    sideband — 8B per 4*dim value bytes — is the only overhead).  The
+    numerics pin is the lr=0 control: frozen parameters make both
+    forwards gather identical values, so max |loss_sparse - loss_dense|
+    must be ~0."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import diagnostics as _diag
+    from mxnet_tpu.recommender import (ClickstreamIter,
+                                       RecommenderConfig,
+                                       RecommenderTrainStep)
+
+    dims = _recommender_dims()
+    cfg = RecommenderConfig(n_fields=dims["fields"],
+                            vocab=dims["vocab"],
+                            embed_dim=dims["dim"])
+    ctrs = {op: _diag.metrics.counter("mxnet_kvstore_bytes_total",
+                                      labels={"op": op})
+            for op in ("row_sparse_pull", "row_sparse_push", "pull")}
+
+    def run(sparse, lr, steps):
+        it = ClickstreamIter(
+            batch_size=dims["batch"], n_fields=dims["fields"],
+            vocab=dims["vocab"],
+            num_samples=dims["batch"] * (dims["steps"] + 2), seed=7)
+        kv = mx.kv.create("local")
+        trainer = RecommenderTrainStep(
+            cfg, kv,
+            optimizer=mx.optimizer.SGD(learning_rate=lr, momentum=0.0,
+                                       wd=0.0),
+            n_shards=dims["shards"] if sparse else 1, seed=0,
+            sparse=sparse)
+        base = {op: c.value for op, c in ctrs.items()}
+        out = trainer.fit(it, steps)
+        out["counter_deltas"] = {op: c.value - base[op]
+                                 for op, c in ctrs.items()}
+        return out
+
+    s = run(True, 0.05, dims["steps"])
+    d = run(False, 0.05, dims["steps"])
+
+    pulled_sparse = s["counter_deltas"]["row_sparse_pull"]
+    pulled_dense_tables = (d["counter_deltas"]["pull"]
+                           - s["counter_deltas"]["pull"])
+    measured_ratio = pulled_sparse / max(pulled_dense_tables, 1)
+    ideal = (s["mean_unique_rows_per_batch"]
+             / (dims["fields"] * dims["vocab"]))
+    assert measured_ratio <= 2 * ideal, \
+        "pulled-bytes ratio %.6f exceeds 2x ideal %.6f" \
+        % (measured_ratio, ideal)
+
+    # lr=0 numerics pin: sparse == dense, bitwise expected
+    s0 = run(True, 0.0, 4)
+    d0 = run(False, 0.0, 4)
+    lr0_diff = float(max(abs(a - b)
+                         for a, b in zip(s0["losses"], d0["losses"])))
+    assert lr0_diff <= 1e-6, "lr0 pin broke: %g" % lr0_diff
+
+    return {
+        "pipeline": "recommender_sparse",
+        "model": "ctr_mlp_sharded_embeddings",
+        "dims": dims,
+        "samples_per_sec_sparse": round(s["samples_per_s"], 1),
+        "samples_per_sec_dense_control": round(d["samples_per_s"], 1),
+        "speedup_vs_dense": round(
+            s["samples_per_s"] / max(d["samples_per_s"], 1e-9), 2),
+        "mean_unique_rows_per_batch": round(
+            s["mean_unique_rows_per_batch"], 1),
+        "pulled_bytes_sparse": int(pulled_sparse),
+        "pulled_bytes_dense_tables": int(pulled_dense_tables),
+        "pulled_bytes_ratio": round(measured_ratio, 6),
+        "ideal_ratio_unique_over_vocab": round(ideal, 6),
+        "ratio_vs_ideal": round(measured_ratio / max(ideal, 1e-12), 3),
+        "row_sparse_push_bytes": int(
+            s["counter_deltas"]["row_sparse_push"]),
+        "final_loss_sparse": round(s["losses"][-1], 6),
+        "final_loss_dense_control": round(d["losses"][-1], 6),
+        "lr0_max_abs_loss_diff": lr0_diff,
+        "note": ("hot-row tier: per-batch np.unique dedup, "
+                 "row_sparse_pull of only those rows across %d shard "
+                 "keys per table, row-sparse push with server-side "
+                 "sparse SGD on touched rows; measured on the "
+                 "in-process local store, where the dense control's "
+                 "full-table pulls are memcpys — the wire claim is "
+                 "the pulled-bytes ratio, which is what a real PS "
+                 "network pays" % dims["shards"]),
+    }
+
+
 def _sym_resnet50(num_classes=1000):
     """Symbolic ResNet-50 v1 (bottleneck 3-4-6-3, He et al. 2015 table 1)
     for the Module.fit path — built on mx.sym so the fit-loop bench
@@ -1558,7 +1674,7 @@ _STATE = {
     "table": [], "io": None, "fit_loop": None, "bare_jax": [],
     "memory": None, "mfu_attribution": None, "serving": None,
     "transformer": None, "overlap_measured": None,
-    "large_batch_remat": None, "generation": None,
+    "large_batch_remat": None, "generation": None, "recommender": None,
     "headline": None, "peak": None, "kind": None, "emitted": False,
 }
 
@@ -1567,7 +1683,7 @@ _STATE = {
 #: same {"skipped": reason} shape a gated phase does
 _PHASE_SLOTS = ("io", "fit_loop", "memory", "mfu_attribution",
                 "serving", "transformer", "overlap_measured",
-                "large_batch_remat", "generation")
+                "large_batch_remat", "generation", "recommender")
 
 
 def _emit_final(reason=None):
@@ -1602,6 +1718,7 @@ def _emit_final(reason=None):
         "overlap_measured": _STATE["overlap_measured"],
         "large_batch_remat": _STATE["large_batch_remat"],
         "generation": _STATE["generation"],
+        "recommender": _STATE["recommender"],
     }
     for slot in _PHASE_SLOTS:
         if out.get(slot) is None:
@@ -2185,6 +2302,23 @@ def main():
         _STATE["generation"] = {"pipeline": "generation",
                                 "error": repr(exc)}
     _progress({"generation": _STATE["generation"]})
+
+    # ---- phase 3h: recommender sparse-training row (ISSUE 19 tentpole
+    # — PS-sharded embedding tables, hot-row-only wire traffic:
+    # samples/s sparse vs dense control + the pulled-bytes ratio
+    # against the ideal unique_rows/vocab, lr0 numerics pin) -----------
+    try:
+        if left() < 120:
+            raise _BudgetSkip("time budget spent before recommender "
+                              "row (elapsed %.0fs)" % elapsed())
+        _STATE["recommender"] = bench_recommender()
+    except _BudgetSkip as exc:
+        _STATE["recommender"] = {"pipeline": "recommender_sparse",
+                                 "skipped": str(exc)}
+    except Exception as exc:
+        _STATE["recommender"] = {"pipeline": "recommender_sparse",
+                                 "error": repr(exc)}
+    _progress({"recommender": _STATE["recommender"]})
 
     # io comparator: the bf16@32 headline row
     io_compute_ref, io_ref_label = None, None

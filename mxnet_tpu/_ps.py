@@ -330,22 +330,29 @@ class Client:
         — mode=request loses the request before it is sent,
         mode=response (default, the hard case) delivers the request but
         loses the reply, so the caller's retry RESENDS and the server
-        must dedupe the duplicate via pseq."""
+        must dedupe the duplicate via pseq.  'drop_sparse_pull' is the
+        same fault against a pull_rows exchange: the read is
+        side-effect-free server-side, so the retry just re-reads — the
+        invariant is that training stays bitwise identical."""
         from . import chaos as _chaos
 
-        if not isinstance(msg, dict) or msg.get("op") != "push":
+        if not isinstance(msg, dict):
             return
-        rule = _chaos.fault("drop_push", rank=msg.get("worker"),
+        kind = {"push": "drop_push",
+                "pull_rows": "drop_sparse_pull"}.get(msg.get("op"))
+        if kind is None:
+            return
+        rule = _chaos.fault(kind, rank=msg.get("worker"),
                             key=msg.get("key"))
         if rule is None:
             return
         mode = str(rule.params.get("mode", "response"))
         if mode != "request":
-            send_msg(self.sock, msg)  # the server DID get this push
+            send_msg(self.sock, msg)  # the server DID get this request
         self.broken = True
         raise ConnectionError(
-            "chaos: dropped push %s for key %r (rank %s)"
-            % (mode, msg.get("key"), msg.get("worker")))
+            "chaos: dropped %s %s for key %r (rank %s)"
+            % (msg.get("op"), mode, msg.get("key"), msg.get("worker")))
 
     def request(self, msg: Any, timeout: Optional[float] = None) -> Any:
         t = timeout if timeout is not None else (

@@ -117,12 +117,50 @@ def _self_test(args) -> int:
                         % (len(grt.decode_plan), site,
                            rep.sites.get(site)))
 
+    # 8. sparse-gradient discipline: the dense-scatter fixture (full
+    # table into the jit -> vocab-sized scatter-add in backward) is
+    # flagged at its planted vocab; the pulled-rows twin — whose
+    # scatter lives in (batch, dim) space — passes at the SAME vocab;
+    # and the real recommender sparse step's traced program passes too
+    hits = expect("sparse_gradients", auditor.check_sparse_gradients(
+        fixtures.sparse_gradient_violation(), "fixture.sparse_grad",
+        fixtures.SPARSE_FIXTURE_VOCAB,
+        embed_dim=fixtures.SPARSE_FIXTURE_DIM), "sparse-gradients")
+    if hits and hits[0].details["n_dense_scatters"] < 1:
+        failures.append("sparse_gradients: flagged without a scatter")
+    if auditor.check_sparse_gradients(
+            fixtures.sparse_gradient_clean(), "fixture.sparse_grad_clean",
+            fixtures.SPARSE_FIXTURE_VOCAB,
+            embed_dim=fixtures.SPARSE_FIXTURE_DIM):
+        failures.append("clean sparse-gradient twin wrongly flagged")
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.recommender import (RecommenderConfig,
+                                       make_sparse_train_step, model)
+    rcfg = RecommenderConfig(n_fields=2, vocab=256, embed_dim=4,
+                             mlp_hidden=(8,))
+    rparams = model.init_params(jax.random.PRNGKey(0), rcfg)
+    B = 16
+    rjx = jax.make_jaxpr(
+        lambda rows, inv, dense, y: make_sparse_train_step(rcfg)(
+            rows, inv, dense, y))(
+        tuple(jnp.zeros((B, rcfg.embed_dim), jnp.float32)
+              for _ in range(rcfg.n_fields)),
+        tuple(jnp.zeros((B,), jnp.int32) for _ in range(rcfg.n_fields)),
+        {n: rparams[n] for n in model.dense_param_names(rcfg)},
+        jnp.zeros((B,), jnp.float32))
+    if auditor.check_sparse_gradients(rjx, "recommender.sparse_step",
+                                      rcfg.vocab,
+                                      embed_dim=rcfg.embed_dim):
+        failures.append("recommender sparse step wrongly flagged as "
+                        "materializing a dense vocab gradient")
+
     if failures:
         print("analysis self-test FAILED:")
         for f in failures:
             print("  -", f)
         return 1
-    print("analysis self-test OK: 6 seeded violations flagged, clean "
+    print("analysis self-test OK: 7 seeded violations flagged, clean "
           "step passed (%d eqns, %d collectives), remat twin peak "
           "%d -> %d bytes, decode audit clean (%d plan-cell compiles)"
           % (meta.get("n_eqns", 0), meta.get("n_collectives", 0),

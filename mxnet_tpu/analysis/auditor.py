@@ -54,7 +54,8 @@ __all__ = [
     "Finding", "AuditReport", "iter_eqns", "collective_signature",
     "check_collective_uniformity", "check_bucket_plan", "check_donation",
     "check_dtype", "check_host_sync", "check_remat_effectiveness",
-    "check_decode_buckets", "count_remat_eqns", "peak_live_bytes",
+    "check_decode_buckets", "check_sparse_gradients",
+    "count_remat_eqns", "peak_live_bytes",
     "audit_step", "audit_recorded_steps", "audit_decode_buckets",
     "load_baseline", "apply_baseline",
     "DEFAULT_BASELINE", "REMAT_PRIMS",
@@ -470,6 +471,64 @@ def check_remat_effectiveness(jaxpr, site: str,
                  "peak_live_bytes": peak,
                  "twin_peak_live_bytes": twin_peak}))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# check 6: sparse gradients (recommender tier)
+# ---------------------------------------------------------------------------
+# the primitive spellings jax's gather VJP lowers its scatter to
+SCATTER_PRIMS = frozenset({"scatter-add", "scatter_add", "scatter"})
+
+
+def check_sparse_gradients(jaxpr, site: str, vocab: int,
+                           embed_dim: Optional[int] = None
+                           ) -> List[Finding]:
+    """A step DECLARED sparse over a ``(vocab, dim)`` embedding table
+    must never materialize a vocab-sized gradient buffer.
+
+    The failure mode: the builder passed the full table into the jit
+    (instead of the minibatch's pulled unique rows), so jax's gather
+    VJP scatter-adds the batch cotangents into ``zeros((vocab, dim))``
+    — an O(vocab) dense buffer per step that the PS wire protocol then
+    ships whole, silently erasing the samples/s and pulled-bytes win
+    the sparse tier exists for (ROADMAP item 3).  The well-formed
+    sparse step's scatter lives in ``(unique_rows<=batch, dim)`` space,
+    which this check walks past: only scatter eqns whose OUTPUT leading
+    dim equals ``vocab`` (and second dim ``embed_dim``, when given) are
+    findings."""
+    if not vocab or int(vocab) <= 0:
+        return []
+    vocab = int(vocab)
+    hits: List[Dict[str, Any]] = []
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name not in SCATTER_PRIMS:
+            continue
+        av = _aval(eqn.outvars[0]) if eqn.outvars else None
+        shape = tuple(getattr(av, "shape", ()) or ())
+        if len(shape) < 1 or int(shape[0]) != vocab:
+            continue
+        if embed_dim is not None and \
+                (len(shape) < 2 or int(shape[1]) != int(embed_dim)):
+            continue
+        hits.append({"prim": eqn.primitive.name, "shape": shape,
+                     "dtype": str(getattr(av, "dtype", "?")),
+                     "nbytes": _nbytes(av)})
+    if not hits:
+        return []
+    wasted = sum(h["nbytes"] for h in hits)
+    return [Finding(
+        "sparse-gradients", "perf", site,
+        "%d scatter eqn(s) materialize a full (vocab=%d, ...) gradient "
+        "buffer (%.1f MiB) inside a step declared row-sparse — the "
+        "gather VJP is running over the whole table instead of the "
+        "minibatch's pulled unique rows, so every step pays O(vocab) "
+        "memory and the PS wire ships dense bytes (first: %s -> %s %s)"
+        % (len(hits), vocab, wasted / 2**20, hits[0]["prim"],
+           hits[0]["shape"], hits[0]["dtype"]),
+        {"fingerprint_key": "dense-vocab-scatter:%d" % vocab,
+         "vocab": vocab, "embed_dim": embed_dim,
+         "n_dense_scatters": len(hits), "wasted_bytes": wasted,
+         "examples": hits[:8]})]
 
 
 # ---------------------------------------------------------------------------

@@ -17,9 +17,13 @@ __all__ = [
     "upcast_jaxpr", "host_sync_jaxpr", "clean_step", "UNDONATED_BYTES",
     "remat_twin_jaxprs", "noop_remat_jaxpr",
     "decode_bucket_violation", "decode_bucket_clean",
+    "sparse_gradient_violation", "sparse_gradient_clean",
+    "SPARSE_FIXTURE_VOCAB", "SPARSE_FIXTURE_DIM",
 ]
 
 UNDONATED_BYTES = 100 * 1024 * 1024  # the planted 100MB param
+SPARSE_FIXTURE_VOCAB = 512   # the planted dense-scatter table dims
+SPARSE_FIXTURE_DIM = 8
 
 
 def _mesh():
@@ -198,6 +202,49 @@ def decode_bucket_clean():
     counts = {"gen_decode:fx:v1:1x16": 1, "gen_decode:fx:v1:1x32": 1,
               "gen_decode:fx:v1:4x16": 1, "gen_decode:fx:v1:4x32": 1}
     return plan, observed, counts
+
+
+def sparse_gradient_violation():
+    """A 'sparse' embedding step built WRONG: the full (vocab, dim)
+    table is a jit input, so jax's gather VJP scatter-adds the batch
+    cotangents into a vocab-sized zeros — the dense gradient buffer
+    check_sparse_gradients(vocab=512) must flag."""
+    import jax
+    import jax.numpy as jnp
+
+    V, D = SPARSE_FIXTURE_VOCAB, SPARSE_FIXTURE_DIM
+
+    def loss(table, ids):
+        return jnp.sum(jnp.take(table, ids, axis=0) ** 2)
+
+    def grad_fn(table, ids):
+        return jax.grad(loss)(table, ids)
+
+    return jax.make_jaxpr(grad_fn)(
+        jax.ShapeDtypeStruct((V, D), jnp.float32),
+        jax.ShapeDtypeStruct((32,), jnp.int32))
+
+
+def sparse_gradient_clean():
+    """The fixed twin, shaped like recommender/model.py's sparse step:
+    the jit sees only the PULLED (unique_rows<=batch, dim) block plus
+    the host-computed inverse map, so the gather VJP's scatter stays in
+    batch space and the (vocab, dim) table exists nowhere — zero
+    findings at the same vocab."""
+    import jax
+    import jax.numpy as jnp
+
+    D = SPARSE_FIXTURE_DIM
+
+    def loss(rows_data, inverse):
+        return jnp.sum(jnp.take(rows_data, inverse, axis=0) ** 2)
+
+    def grad_fn(rows_data, inverse):
+        return jax.grad(loss)(rows_data, inverse)
+
+    return jax.make_jaxpr(grad_fn)(
+        jax.ShapeDtypeStruct((32, D), jnp.float32),
+        jax.ShapeDtypeStruct((32,), jnp.int32))
 
 
 def clean_step():

@@ -20,6 +20,13 @@ hold; ``nth`` skips the first nth-1 candidate events.  Kinds:
     delivery — the hard case: retry must resend and the server must
     dedupe via pseq; ``mode=request`` drops the request itself).
     Match keys: ``rank``, ``key``, ``nth``, ``count``, ``mode``.
+  * ``drop_sparse_pull`` — the PS transport loses a ``pull_rows``
+    (row_sparse_pull) exchange on the matching rank — ``mode=response``
+    (default) delivers the request but drops the server's reply, so
+    the bounded retry reconnects and re-reads; the pull is a
+    side-effect-free read, so absorbing it must leave training bitwise
+    identical to a fault-free run.  Match keys: ``rank``, ``key``,
+    ``nth``, ``count``, ``mode``.
   * ``delay_collective`` — sleep ``ms`` (default 200) before the
     matching collective is recorded/issued.  Match keys: ``rank``,
     ``op``, ``nth``, ``count``, ``ms``.
@@ -519,6 +526,26 @@ def _self_test() -> tuple:
         c = _diag.metrics.counter("mxnet_chaos_injected_total",
                                   labels={"kind": "nan_grad"})
         checks["metric_fed"] = c.value >= 1
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        reset()
+
+    # 4b) drop_sparse_pull: same transport fault grammar against the
+    # pull_rows exchange — key-scoped, nth window, injection counter
+    spec = "drop_sparse_pull:rank=1,key=emb:s0,nth=2"
+    os.environ["MXNET_CHAOS"] = spec  # mxlint: disable=MXL002
+    reset()
+    try:
+        checks["sparse_pull_wrong_key"] = fault(
+            "drop_sparse_pull", rank=1, key="emb:s1") is None
+        checks["sparse_pull_nth_skips"] = fault(
+            "drop_sparse_pull", rank=1, key="emb:s0") is None
+        checks["sparse_pull_fires"] = fault(
+            "drop_sparse_pull", rank=1, key="emb:s0") is not None
+        checks["sparse_pull_consumed"] = fault(
+            "drop_sparse_pull", rank=1, key="emb:s0") is None
+        checks["sparse_pull_injected_total"] = \
+            injected_total("drop_sparse_pull") == 1
     finally:
         del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
         reset()

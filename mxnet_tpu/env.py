@@ -231,6 +231,10 @@ register("MXNET_BENCH_TRANSFORMER", "str", None,
          "Transformer bench row dims as 'k=v,k=v' over layers/d_model/"
          "heads/seq/batch/ff/vocab (bench.bench_transformer); unset "
          "uses the budget-sized defaults.")
+register("MXNET_BENCH_RECOMMENDER", "str", None,
+         "Recommender bench row dims as 'k=v,k=v' over fields/vocab/"
+         "dim/batch/steps/shards (bench.bench_recommender); unset uses "
+         "the budget-sized defaults.")
 
 # profiler.py — trace autostart (worker subprocess contract)
 register("MXNET_PROFILER_AUTOSTART", "bool", False,
@@ -280,10 +284,10 @@ register("MXNET_PS_RETRY_BACKOFF_S", "float", 0.1,
 # chaos.py — fault injection for the chaos harness
 register("MXNET_CHAOS", "str", None,
          "Fault-injection spec: semicolon-separated rules "
-         "'kind:k=v,k=v' with kinds drop_push / delay_collective / "
-         "kill / nan_grad / slow_request / fail_execute / "
-         "corrupt_shard / bad_version / slow_decode / kill_rank / "
-         "cancel_request "
+         "'kind:k=v,k=v' with kinds drop_push / drop_sparse_pull / "
+         "delay_collective / kill / nan_grad / slow_request / "
+         "fail_execute / corrupt_shard / bad_version / slow_decode / "
+         "kill_rank / cancel_request "
          "(see mxnet_tpu/chaos.py).  Unset disables all injection.")
 
 # module — non-finite gradient guard

@@ -31,6 +31,12 @@ class GradientCompression:
         self.type = type
         self.threshold = float(threshold)
         self._residual: Dict = {}
+        # sparse pushes: residual keyed per (key, row id) so error
+        # feedback FOLLOWS the row across batches — a hot row pushed in
+        # batch t and batch t+5 carries its quantization error between
+        # them even though its position in the (rows, values) payload
+        # changed (the dense per-key buffer cannot express this).
+        self._row_residual: Dict = {}
 
     def get_params(self) -> Dict[str, str]:
         return {"type": self.type, "threshold": str(self.threshold)}
@@ -68,6 +74,48 @@ class GradientCompression:
         packed = (codes[0::4] | (codes[1::4] << 2) | (codes[2::4] << 4)
                   | (codes[3::4] << 6))
         return packed.tobytes(), tuple(grad.shape)
+
+    @staticmethod
+    def rows_wire_nbytes(n_rows: int, row_elements: int) -> int:
+        """On-wire payload of one compressed ROW-SPARSE push: 8-byte
+        int64 row ids (uncompressed — they are exact coordinates, not
+        quantizable) + 2-bit codes for the row values.  Deterministic,
+        mirroring wire_nbytes for the dense path."""
+        return int(n_rows) * 8 + GradientCompression.wire_nbytes(
+            int(n_rows) * int(row_elements))
+
+    def compress_rows(self, key, rows, values) -> Tuple[bytes, tuple]:
+        """Row-sparse (rows, values) gradient (+ per-row carried
+        residual) → packed 2-bit codes for the values.  Row ids travel
+        uncompressed alongside.  Returns (codes_bytes, shape) with
+        shape == values.shape; decode with :meth:`decompress`."""
+        rows = np.asarray(rows, dtype=np.int64).reshape(-1)
+        values = np.asarray(values, dtype=np.float32)
+        values = values.reshape(rows.size, -1)
+        store = self._row_residual.setdefault(key, {})
+        work = values.copy()
+        for i, r in enumerate(rows):
+            res = store.get(int(r))
+            if res is not None:
+                work[i] += res
+        codes = np.zeros(work.shape, dtype=np.uint8)
+        pos = work >= self.threshold
+        neg = work <= -self.threshold
+        codes[pos] = 1
+        codes[neg] = 2
+        decoded = np.zeros_like(work)
+        decoded[pos] = self.threshold
+        decoded[neg] = -self.threshold
+        err = work - decoded
+        for i, r in enumerate(rows):
+            store[int(r)] = err[i]
+        flat = codes.ravel()
+        pad = (-flat.size) % 4
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, np.uint8)])
+        packed = (flat[0::4] | (flat[1::4] << 2) | (flat[2::4] << 4)
+                  | (flat[3::4] << 6))
+        return packed.tobytes(), tuple(values.shape)
 
     def decompress(self, codes: bytes, shape: tuple) -> np.ndarray:
         packed = np.frombuffer(codes, dtype=np.uint8)

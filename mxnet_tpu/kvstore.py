@@ -106,6 +106,50 @@ def _payload_nbytes(value) -> int:
     return 0
 
 
+def _all_row_sparse(value) -> bool:
+    """True when every leaf of a push payload is row-sparse — those
+    pushes account under op=row_sparse_push so wire-pressure dashboards
+    can separate hot-row traffic from dense traffic.  Telemetry only."""
+    try:
+        from .ndarray import sparse as _sp
+
+        if isinstance(value, _sp.RowSparseNDArray):
+            return True
+        if isinstance(value, (list, tuple)) and value:
+            return all(_all_row_sparse(v) for v in value)
+    except Exception:
+        pass
+    return False
+
+
+def _rsp_pull_wire_nbytes(key, out, row_ids) -> int:
+    """Deterministic wire bytes of one row_sparse_pull: per key, only
+    the DEDUPED requested rows travel — unique_rows * (row payload +
+    8-byte int64 row id) — independent of vocab.  This is the number
+    ``mxnet_kvstore_bytes_total{op=row_sparse_pull}`` accumulates, the
+    counter the hot-row claim is audited against.  Telemetry only —
+    never raises."""
+    try:
+        keys, outs = _key_value(key, out)
+        rids = _as_list(row_ids)
+        if len(rids) == 1 and len(keys) > 1:
+            rids = rids * len(keys)
+        total = 0
+        for olist, rid in zip(outs, rids):
+            o = _as_list(olist)[0]
+            rows = _np.unique(
+                (rid.asnumpy() if isinstance(rid, NDArray)
+                 else _np.asarray(rid)).astype(_np.int64).ravel())
+            row_elems = 1
+            for d in o.shape[1:]:
+                row_elems *= int(d)
+            row_bytes = row_elems * _np.dtype(o.dtype).itemsize
+            total += int(rows.size) * (row_bytes + 8)
+        return total
+    except Exception:
+        return 0
+
+
 class KVStore:
     """ref: python/mxnet/kvstore.py KVStore."""
 
@@ -153,14 +197,18 @@ class KVStore:
         from . import profiler as _profiler
 
         prof = _profiler.is_running()
+        # all-row-sparse pushes account separately: their wire payload
+        # is rows-touched-sized, and the hot-row claim needs the counter
+        # to witness that independent of dense traffic
+        op = "row_sparse_push" if _all_row_sparse(value) else "push"
         if not prof and not _diag.flight_enabled():
             # the byte counter is independent of profiler/flight state:
             # a scraped MXNET_METRICS_FILE must still see comms traffic
             self._do_push(key, value, priority)
-            _feed_bytes_metric("push", self._push_wire_nbytes(key, value))
+            _feed_bytes_metric(op, self._push_wire_nbytes(key, value))
             return
         nbytes = self._push_wire_nbytes(key, value)
-        with _diag.record_collective("push", keys=key, nbytes=nbytes,
+        with _diag.record_collective(op, keys=key, nbytes=nbytes,
                                      dtype=_payload_dtype(value),
                                      args={"type": self._kind}), \
                 _comms_span(prof, "KVStore::Push",
@@ -168,7 +216,7 @@ class KVStore:
             self._do_push(key, value, priority)
         if prof:
             _profiler.record_bytes("kvstore:push_bytes", nbytes)
-        _feed_bytes_metric("push", nbytes)
+        _feed_bytes_metric(op, nbytes)
 
     def _push_wire_nbytes(self, key, value) -> int:
         """Bytes one push puts on the wire — the figure
@@ -232,14 +280,22 @@ class KVStore:
         from . import profiler as _profiler
 
         prof = _profiler.is_running()
+        nbytes = _rsp_pull_wire_nbytes(key, out, row_ids)
         if not prof and not _diag.flight_enabled():
-            return self._do_row_sparse_pull(key, out, priority, row_ids)
+            self._do_row_sparse_pull(key, out, priority, row_ids)
+            _feed_bytes_metric("row_sparse_pull", nbytes)
+            return
         with _diag.record_collective("row_sparse_pull", keys=key,
+                                     nbytes=nbytes,
                                      dtype=_payload_dtype(out),
                                      args={"type": self._kind}), \
                 _comms_span(prof, "KVStore::PullRowSparse",
-                            {"type": self._kind}):
+                            {"bytes": nbytes, "type": self._kind}):
             self._do_row_sparse_pull(key, out, priority, row_ids)
+        if prof:
+            _profiler.record_bytes("kvstore:row_sparse_pull_bytes",
+                                   nbytes)
+        _feed_bytes_metric("row_sparse_pull", nbytes)
 
     def _do_push(self, key, value, priority: int = 0) -> None:
         from .ndarray import sparse as _sp
@@ -784,11 +840,20 @@ class KVStoreDist(KVStore):
             if isinstance(merged, _sp.RowSparseNDArray):
                 # only touched rows travel (ref: kvstore_dist.h:444
                 # EncodeRowSparseKey push)
-                msg.update(sparse=True,
-                           rows=_np.asarray(merged.indices.asnumpy(),
-                                            dtype=_np.int64),
-                           data=merged.data.asnumpy(),
+                rows = _np.asarray(merged.indices.asnumpy(),
+                                   dtype=_np.int64)
+                msg.update(sparse=True, rows=rows,
                            shape=tuple(merged.shape))
+                if self._gc is not None and rows.size:
+                    # sparse-aware 2-bit encode: the values compress,
+                    # the row ids travel exact, and the error feedback
+                    # is PER ROW so a hot row's residual follows it
+                    # across batches (gradient_compression.compress_rows)
+                    codes, _vshape = self._gc.compress_rows(
+                        k, rows, merged.data.asnumpy())
+                    msg.update(compressed=True, data=codes)
+                else:
+                    msg["data"] = merged.data.asnumpy()
             elif self._gc is not None:
                 codes, shape = self._gc.compress(k, merged.asnumpy())
                 msg.update(compressed=True, data=codes, shape=shape)
@@ -888,12 +953,13 @@ class KVStoreDist(KVStore):
     def _push_wire_nbytes(self, key, value) -> int:
         """With compression on, what travels is the packed 2-bit codes
         of ONE merged array per key — ceil(n/4) bytes — not the dense
-        float payload; sparse pushes keep the rows+data accounting
-        (they stay uncompressed, matching _do_push).  This is the
-        number mxnet_kvstore_bytes_total{op=push} must report for the
-        wire-pressure claim to be auditable."""
-        if self._gc is None:
-            return _payload_nbytes(value)
+        float payload.  Row-sparse pushes account deterministically as
+        rows-on-wire: n_rows * (8-byte int64 id + row payload), or the
+        exact row ids + 2-bit value codes when compression is on
+        (GradientCompression.rows_wire_nbytes) — matching _do_push byte
+        for byte.  These are the numbers
+        mxnet_kvstore_bytes_total{op=push|row_sparse_push} must report
+        for the wire-pressure claim to be auditable."""
         try:
             from .gradient_compression import GradientCompression
             from .ndarray import sparse as _sp
@@ -906,7 +972,20 @@ class KVStoreDist(KVStore):
                     continue
                 merged = vs[0]
                 if isinstance(merged, _sp.RowSparseNDArray):
-                    total += _payload_nbytes(merged)
+                    n_rows = int(merged.indices.shape[0])
+                    row_elems = 1
+                    for d in merged.shape[1:]:
+                        row_elems *= int(d)
+                    if self._gc is not None and n_rows:
+                        total += GradientCompression.rows_wire_nbytes(
+                            n_rows, row_elems)
+                    else:
+                        row_bytes = (row_elems *
+                                     _np.dtype(merged.dtype).itemsize)
+                        total += n_rows * (row_bytes + 8)
+                    continue
+                if self._gc is None:
+                    total += _payload_nbytes(vlist)
                     continue
                 n = 1
                 for d in merged.shape:

@@ -37,6 +37,46 @@ class _KeyState:
         self.applied = 0  # completed aggregation rounds
 
 
+class _RspGrad:
+    """A row-sparse gradient in flight through aggregation: only the
+    touched rows exist server-side (ref: row-sparse handler,
+    kvstore_dist_server.h:223 — the dense (vocab, dim) buffer the old
+    fallback materialized per push is exactly what a sharded table too
+    large for one node cannot afford)."""
+
+    __slots__ = ("rows", "vals", "shape")
+
+    def __init__(self, rows, vals, shape):
+        self.rows = np.asarray(rows, dtype=np.int64).reshape(-1)
+        row_elems = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        self.vals = np.asarray(vals, dtype=np.float32).reshape(
+            self.rows.size, row_elems)
+        self.shape = tuple(int(d) for d in shape)
+
+    def dedup(self) -> "_RspGrad":
+        """Sum duplicate rows (defensive: clients dedup before the wire,
+        but aggregation correctness must not depend on it)."""
+        if self.rows.size == 0:
+            return self
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+        if uniq.size == self.rows.size:
+            return self
+        out = np.zeros((uniq.size, self.vals.shape[1]), np.float32)
+        np.add.at(out, inv, self.vals)
+        return _RspGrad(uniq, out, self.shape)
+
+    def merged_with(self, other: "_RspGrad") -> "_RspGrad":
+        return _RspGrad(np.concatenate([self.rows, other.rows]),
+                        np.concatenate([self.vals, other.vals], axis=0),
+                        self.shape).dedup()
+
+    def todense(self) -> np.ndarray:
+        dense = np.zeros(self.shape, np.float32)
+        np.add.at(dense, self.rows,
+                  self.vals.reshape((self.rows.size,) + self.shape[1:]))
+        return dense
+
+
 class KVStoreServer:
     """One PS shard (ref: KVStoreDistServer, kvstore_dist_server.h:113)."""
 
@@ -234,17 +274,29 @@ class KVStoreServer:
         """Fold one push into the aggregation round; returns False for
         a deduplicated resend (nothing applied)."""
         key = msg["key"]
-        if msg.get("compressed"):
+        if msg.get("sparse"):
+            # row-sparse wire format: only touched rows travel, and the
+            # server KEEPS them sparse end-to-end — aggregation, dedup
+            # and the optimizer update all live in touched-rows space
+            # (ref: EncodeRowSparseKey push, kvstore_dist.h:444)
+            rows = np.asarray(msg["rows"], np.int64).reshape(-1)
+            shape = tuple(msg["shape"])
+            row_elems = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+            if msg.get("compressed"):
+                if self.gc is None:
+                    raise RuntimeError("compressed push without "
+                                       "set_compression")
+                vals = self.gc.decompress(msg["data"],
+                                          (rows.size, row_elems))
+            else:
+                vals = np.asarray(msg["data"], np.float32)
+            grad = _RspGrad(rows, vals, shape).dedup()
+        elif msg.get("compressed"):
             grad = self.gc.decompress(msg["data"], msg["shape"]) \
                 if self.gc else None
             if grad is None:
                 raise RuntimeError("compressed push without "
                                    "set_compression")
-        elif msg.get("sparse"):
-            # row-sparse wire format: only touched rows travel
-            # (ref: EncodeRowSparseKey push, kvstore_dist.h:444)
-            grad = np.zeros(msg["shape"], np.float32)
-            grad[np.asarray(msg["rows"], np.int64)] = msg["data"]
         else:
             grad = np.asarray(msg["data"])
         with self.lock:
@@ -267,9 +319,10 @@ class KVStoreServer:
                 self.lock.notify_all()
                 return True
             if st.agg is None:
-                st.agg = grad.astype(np.float32).copy()
+                st.agg = (grad if isinstance(grad, _RspGrad)
+                          else grad.astype(np.float32).copy())
             else:
-                st.agg = st.agg + grad
+                st.agg = self._agg_add(st.agg, grad)
             st.parts += 1
             if st.parts >= self.num_workers:
                 # ref: ApplyUpdates once NumWorkers parts arrived
@@ -283,7 +336,44 @@ class KVStoreServer:
                 self.lock.notify_all()
         return True
 
+    @staticmethod
+    def _agg_add(agg, grad):
+        """Fold one more push into the round's aggregate, sparse-aware:
+        two row-sparse parts merge in touched-rows space; a mixed
+        sparse/dense round densifies defensively (workers disagreeing on
+        storage type is legal, just not the fast path)."""
+        if isinstance(agg, _RspGrad) and isinstance(grad, _RspGrad):
+            return agg.merged_with(grad)
+        if isinstance(agg, _RspGrad):
+            return agg.todense() + grad
+        if isinstance(grad, _RspGrad):
+            return agg + grad.todense()
+        return agg + grad
+
     def _apply(self, key, merged):
+        if isinstance(merged, _RspGrad):
+            if key not in self.store:
+                raise RuntimeError("push before init on %r" % key)
+            if merged.rows.size == 0:
+                return  # a round that touched no rows updates no rows
+            stored = self.store[key]
+            vals = merged.vals.reshape(
+                (merged.rows.size,) + stored.shape[1:])
+            if self.updater is not None:
+                # server-side sparse SGD/Adagrad: hand the optimizer a
+                # RowSparseNDArray so its lazy update path touches ONLY
+                # the rows this round carried (optimizer.py _rsp_grad)
+                from .ndarray import sparse as _sparse
+
+                g = _sparse.row_sparse_array(
+                    (vals, merged.rows), shape=stored.shape,
+                    dtype=np.float32)
+                self.updater_np(key, g, stored)
+            else:
+                # no optimizer: the aggregate replaces the touched rows
+                # only — untouched rows keep their stored values
+                stored[merged.rows] = vals.astype(stored.dtype)
+            return
         if self.updater is not None:
             if key not in self.store:
                 raise RuntimeError("push before init on %r" % key)
@@ -298,7 +388,7 @@ class KVStoreServer:
         """Run the python Updater over numpy views via NDArray wrappers."""
         from .ndarray import NDArray, array
 
-        g = array(grad)
+        g = grad if isinstance(grad, NDArray) else array(grad)
         w = array(stored)
         self.updater(int(key) if str(key).isdigit() else key, g, w)
         self.store[key] = w.asnumpy()

@@ -263,7 +263,6 @@ def _register_aliases():
     alias("BatchNorm", "CuDNNBatchNorm")  # cudnn variant = same math
     alias("square_sum", "_square_sum")
     alias("identity", "_CrossDeviceCopy")  # device moves are XLA's job
-    alias("Embedding", "_contrib_SparseEmbedding")  # dense-grad fallback
     alias("_minus_scalar", "_scatter_minus_scalar")
     alias("_plus_scalar", "_scatter_plus_scalar")
     # gradient-accumulation add (ref: elemwise_binary_op_basic.cc
@@ -297,6 +296,62 @@ def _cast_storage_op(data, stype="default", **_):
     if stype not in ("default", "row_sparse", "csr"):
         raise ValueError("cast_storage: unknown stype %r" % (stype,))
     return data
+
+
+# ------------------------------------------ row-sparse embedding gradient
+def row_sparse_embedding_grad(ids, cotangent, vocab):
+    """Row-sparse ``(rows, values)`` gradient of an embedding gather.
+
+    Dedups the minibatch ids with a STATIC-size unique (workspace is the
+    flat batch length B, never vocab) and segment-sums the per-sample
+    output cotangents over the <= B unique rows, so the dense
+    ``(vocab, dim)`` buffer the naive take-VJP scatters into never
+    exists.  Padding slots carry row id == vocab (one past the table)
+    and zero values; callers either drop them host-side (the recommender
+    PS push path) or scatter with ``mode="drop"``.
+
+    Returns ``(rows (B,) int32, values (B, dim))``.
+    """
+    flat = ids.reshape(-1).astype(jnp.int32)
+    cot = cotangent.reshape(flat.shape[0], -1)
+    rows, inv = jnp.unique(flat, return_inverse=True,
+                           size=flat.shape[0], fill_value=vocab)
+    values = jax.ops.segment_sum(cot, inv.reshape(-1),
+                                 num_segments=flat.shape[0])
+    return rows, values
+
+
+@register("_contrib_SparseEmbedding", input_names=["data", "weight"])
+def _sparse_embedding(data, weight, input_dim=0, output_dim=0,
+                      dtype="float32", **_):
+    """ref: src/operator/contrib/ — SparseEmbeddingOpForwardEx; forward
+    is the same row gather as Embedding, but the backward computes the
+    weight gradient ROW-SPARSELY (custom VJP emitting (rows, values) via
+    dedup + segment-sum in <= batch space).  The imperative autograd
+    contract still hands back a dense array cotangent, so the sparse
+    (rows, values) pair is scattered exactly once at that boundary; the
+    recommender functional tier calls row_sparse_embedding_grad directly
+    and keeps the pair sparse end-to-end."""
+    vocab, dim = weight.shape
+    ids = data.astype(weight.dtype)  # float carrier: well-typed cotangent
+
+    @jax.custom_vjp
+    def gather(w, idx_f):
+        idx = jnp.clip(idx_f.astype(jnp.int32), 0, w.shape[0] - 1)
+        return jnp.take(w, idx, axis=0)
+
+    def gather_fwd(w, idx_f):
+        return gather(w, idx_f), idx_f
+
+    def gather_bwd(idx_f, g):
+        idx = jnp.clip(idx_f.astype(jnp.int32), 0, vocab - 1)
+        rows, values = row_sparse_embedding_grad(idx, g, vocab)
+        dw = jnp.zeros((vocab, dim), g.dtype).at[rows].add(
+            values, mode="drop")
+        return dw, jnp.zeros_like(idx_f)
+
+    gather.defvjp(gather_fwd, gather_bwd)
+    return gather(weight, ids)
 
 
 @register("_sparse_retain", aliases=("sparse_retain",))

@@ -1,0 +1,25 @@
+"""mxnet_tpu.recommender — the recommender-scale sparse workload tier.
+
+Embedding-dominated CTR training whose tables live SHARDED across PS
+servers (crc32 key rule over row-block shard keys) and whose compiled
+train step emits row-sparse embedding gradients — unique-ids dedup +
+segment-sum inside the jit, never a dense ``(vocab, dim)`` buffer.
+Wire traffic per step is proportional to the minibatch's unique rows
+(``mxnet_kvstore_bytes_total{op=row_sparse_pull|row_sparse_push}``),
+not vocab; server-side sparse SGD/Adagrad touches only those rows.
+See README "Sparse & recommender" and ROADMAP item 3.
+"""
+from .data import ClickstreamIter, make_clickstream
+from .model import (RecommenderConfig, apply, apply_rows,
+                    dense_param_names, init_params, logloss,
+                    make_dense_train_step, make_sparse_train_step,
+                    param_shapes, table_names)
+from .train import RecommenderTrainStep, ShardedEmbeddingTable
+
+__all__ = [
+    "RecommenderConfig", "RecommenderTrainStep",
+    "ShardedEmbeddingTable", "ClickstreamIter", "make_clickstream",
+    "apply", "apply_rows", "dense_param_names", "init_params",
+    "logloss", "make_dense_train_step", "make_sparse_train_step",
+    "param_shapes", "table_names",
+]

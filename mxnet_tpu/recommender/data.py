@@ -1,0 +1,92 @@
+"""Synthetic Zipf clickstream on the ``io.py`` iterator contract.
+
+The recommender tier's premise — a minibatch touches a SMALL hot row
+set of each embedding table — is only real if the id distribution is
+heavy-tailed, so ids draw from a Zipf(alpha) law over each field's
+vocab: a handful of head ids dominate every batch while the tail keeps
+unique-rows-per-batch well below both batch size and vocab.  Labels
+come from a seeded per-field score table (click = sum of the sampled
+ids' scores crosses zero), so the data is learnable, fully determined
+by the spec scalars, and regenerable bit-for-bit anywhere.
+
+Riding ``NDArrayIter`` buys the whole input/robustness stack
+unchanged: ``num_parts``/``part_index`` strided sharding for
+per-worker disjoint slices, host-only ``next_raw`` for the decode
+pool, and cursor semantics the checkpoint replay path fast-forwards.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..io import NDArrayIter
+
+__all__ = ["ClickstreamIter", "make_clickstream"]
+
+
+def make_clickstream(num_samples: int, n_fields: int, vocab: int,
+                     alpha: float = 1.05, seed: int = 0):
+    """``(ids (N, n_fields) int32, clicks (N,) float32)`` — Zipf ids
+    and score-table labels, deterministic per (args, seed)."""
+    rng = _np.random.RandomState(seed)
+    ranks = _np.arange(1, vocab + 1, dtype=_np.float64)
+    p = ranks ** -float(alpha)
+    p /= p.sum()
+    ids = rng.choice(vocab, size=(int(num_samples), int(n_fields)),
+                     p=p).astype(_np.int32)
+    scores = rng.randn(int(n_fields), vocab).astype(_np.float32)
+    raw = scores[_np.arange(int(n_fields))[None, :], ids].sum(axis=1)
+    clicks = (raw > 0).astype(_np.float32)
+    return ids, clicks
+
+
+class ClickstreamIter(NDArrayIter):
+    """CTR batches: ``data`` (B, n_fields) int32 categorical ids,
+    ``label`` (B,) float32 clicks.  Padding, sharding, ``next_raw``
+    and reset semantics are inherited from ``NDArrayIter`` — the point
+    of the contract: checkpoint/resume, the decode pool and the flight
+    recorder treat this like any other workload's iterator."""
+
+    def __init__(self, batch_size: int = 32, n_fields: int = 8,
+                 vocab: int = 65536, num_samples: int = 1024,
+                 alpha: float = 1.05, seed: int = 0,
+                 shuffle: bool = False,
+                 last_batch_handle: str = "discard",
+                 num_parts: int = 1, part_index: int = 0):
+        ids, clicks = make_clickstream(num_samples, n_fields, vocab,
+                                       alpha=alpha, seed=seed)
+        self.n_fields = int(n_fields)
+        self.vocab = int(vocab)
+        self.num_samples = int(num_samples)
+        self.alpha = float(alpha)
+        self.seed = int(seed)
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
+        super().__init__(
+            ids, label=clicks, batch_size=batch_size, shuffle=shuffle,
+            last_batch_handle=last_batch_handle, data_name="ids",
+            label_name="click", num_parts=num_parts,
+            part_index=part_index)
+
+    def replay_spec(self) -> dict:
+        """Reconstruction spec: the stream is fully determined by these
+        scalars, so an offline audit or a resumed worker re-creates
+        THIS exact sequence of batches."""
+        return {
+            "kind": "clickstream_iter",
+            "batch_size": int(self.batch_size),
+            "n_fields": self.n_fields,
+            "vocab": self.vocab,
+            "num_samples": self.num_samples,
+            "alpha": self.alpha,
+            "seed": self.seed,
+            "num_parts": self.num_parts,
+            "part_index": self.part_index,
+        }
+
+    def skip_batches(self, n: int) -> None:
+        """Fast-forward ``n`` batches (cursor moves, nothing
+        materializes) — the exact-resume replay path."""
+        for _ in range(int(n)):
+            if not self.iter_next():
+                self.reset()
+                self.iter_next()

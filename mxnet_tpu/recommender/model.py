@@ -1,0 +1,178 @@
+"""Embedding-dominated CTR model — the recommender workload tier.
+
+Wide sparse categorical features → one embedding table per field →
+concat → small dense MLP head → click logit.  The parameter budget is
+overwhelmingly the tables (ROADMAP item 3: tables too large for one
+chip live sharded across PS servers), so the train step must never
+materialize a dense ``(vocab, dim)`` gradient: the SPARSE step below
+takes the minibatch's already-pulled unique rows as inputs and its
+embedding gradients come back in ``(unique_rows, dim)`` space — the
+fancy-index VJP is a segment-sum over at most ``batch`` rows, audited
+by ``analysis.auditor.check_sparse_gradients``.
+
+The model is a PURE param-tree function (flat ``{name: array}`` dict
+in forward order, like ``transformer/model.py``), not a Module: the
+embedding tables are simply the entries whose storage lives on the PS
+(``recommender/train.py`` pulls/pushes them row-sparsely), and the
+DENSE twin (full tables in-jit, vocab-sized scatter in backward) is
+kept as the numerics control and the auditor's violating shape.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Tuple
+
+__all__ = [
+    "RecommenderConfig", "param_shapes", "table_names",
+    "dense_param_names", "init_params", "apply", "apply_rows",
+    "logloss", "make_sparse_train_step", "make_dense_train_step",
+]
+
+
+class RecommenderConfig(NamedTuple):
+    """Dimensions of the clickstream model.  ``vocab`` is rows PER
+    FIELD table — the hot-row premise (Zipf ids) makes
+    ``unique_rows_per_batch / vocab`` the ideal pulled-bytes ratio."""
+    n_fields: int = 8
+    vocab: int = 65536
+    embed_dim: int = 16
+    mlp_hidden: Tuple[int, ...] = (64, 32)
+    dtype: str = "float32"
+
+
+def table_names(cfg: RecommenderConfig) -> List[str]:
+    return ["emb%d" % f for f in range(cfg.n_fields)]
+
+
+def param_shapes(cfg: RecommenderConfig) -> List[Tuple[str, tuple, str]]:
+    """``(name, shape, dtype)`` in forward order: tables first, then
+    the MLP head — the split ``train.py`` uses to decide which entries
+    shard row-sparsely across PS servers and which replicate densely."""
+    D = cfg.embed_dim
+    out = [(n, (cfg.vocab, D), cfg.dtype) for n in table_names(cfg)]
+    fan_in = cfg.n_fields * D
+    for i, h in enumerate(cfg.mlp_hidden):
+        out += [("mlp%d_w" % i, (fan_in, int(h)), cfg.dtype),
+                ("mlp%d_b" % i, (int(h),), cfg.dtype)]
+        fan_in = int(h)
+    out += [("out_w", (fan_in, 1), cfg.dtype), ("out_b", (1,), cfg.dtype)]
+    return out
+
+
+def dense_param_names(cfg: RecommenderConfig) -> List[str]:
+    tables = set(table_names(cfg))
+    return [n for n, _s, _d in param_shapes(cfg) if n not in tables]
+
+
+def init_params(key, cfg: RecommenderConfig) -> Dict:
+    """Flat param dict: N(0, 0.01) tables (the reference recommender
+    convention of tiny embedding init), He-ish scaled MLP matrices,
+    zero biases.  Deterministic per (key, cfg)."""
+    import jax
+    import jax.numpy as jnp
+
+    params: Dict = {}
+    for idx, (name, shape, dtype) in enumerate(param_shapes(cfg)):
+        sub = jax.random.fold_in(key, idx)
+        if name.endswith("_b"):
+            params[name] = jnp.zeros(shape, dtype)
+        elif name.startswith("emb"):
+            params[name] = (0.01 * jax.random.normal(
+                sub, shape, jnp.float32)).astype(dtype)
+        else:
+            scale = (2.0 / shape[0]) ** 0.5
+            params[name] = (scale * jax.random.normal(
+                sub, shape, jnp.float32)).astype(dtype)
+    return params
+
+
+def _mlp(x, params: Dict, cfg: RecommenderConfig):
+    import jax
+    import jax.numpy as jnp
+
+    h = x
+    for i in range(len(cfg.mlp_hidden)):
+        h = jax.nn.relu(h @ params["mlp%d_w" % i] + params["mlp%d_b" % i])
+    return jnp.squeeze(h @ params["out_w"] + params["out_b"], axis=-1)
+
+
+def apply(params: Dict, ids, cfg: RecommenderConfig):
+    """Dense forward (full tables in the param tree): ``ids``
+    (B, n_fields) int → click logits (B,).  The CONTROL path — its
+    backward scatter-adds into vocab-sized buffers, which is exactly
+    what the sparse step exists to avoid."""
+    import jax.numpy as jnp
+
+    embs = [jnp.take(params[n],
+                     jnp.clip(ids[:, f].astype(jnp.int32), 0,
+                              cfg.vocab - 1), axis=0)
+            for f, n in enumerate(table_names(cfg))]
+    return _mlp(jnp.concatenate(embs, axis=-1), params, cfg)
+
+
+def apply_rows(rows_data, inverse, dense_params: Dict,
+               cfg: RecommenderConfig):
+    """Sparse forward over PULLED rows: per field, ``rows_data[f]`` is
+    the (U_pad, dim) block of unique embedding rows the PS pull
+    delivered and ``inverse[f]`` (B,) maps each sample back into it —
+    the ``np.unique(..., return_inverse=True)`` factorization computed
+    host-side.  The full (vocab, dim) table exists NOWHERE in this
+    program, so its gradient cannot either."""
+    import jax.numpy as jnp
+
+    embs = [jnp.take(rows_data[f], inverse[f].astype(jnp.int32), axis=0)
+            for f in range(cfg.n_fields)]
+    return _mlp(jnp.concatenate(embs, axis=-1), dense_params, cfg)
+
+
+def logloss(logits, labels):
+    """Numerically-stable sigmoid binary cross-entropy, mean over the
+    batch."""
+    import jax.numpy as jnp
+
+    z = logits.astype(jnp.float32)
+    y = labels.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(z, 0.0) - z * y
+                    + jnp.log1p(jnp.exp(-jnp.abs(z))))
+
+
+def make_sparse_train_step(cfg: RecommenderConfig):
+    """Jitted ``step(rows_data, inverse, dense_params, labels) ->
+    (loss, d_rows, d_dense)``.
+
+    ``rows_data``/``inverse`` are tuples over fields with HOST-PADDED
+    static shapes (train.py pads unique rows up to batch size so the
+    program compiles once); ``d_rows[f]`` comes back in the same
+    (U_pad, dim) space — jax's gather VJP is a segment-sum there, and
+    ``check_sparse_gradients`` holds this jaxpr to that claim."""
+    import jax
+
+    def loss_fn(rows_data, dense_params, inverse, labels):
+        return logloss(apply_rows(rows_data, inverse, dense_params, cfg),
+                       labels)
+
+    @jax.jit
+    def step(rows_data, inverse, dense_params, labels):
+        loss, (d_rows, d_dense) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(tuple(rows_data), dense_params,
+                                     tuple(inverse), labels)
+        return loss, d_rows, d_dense
+
+    return step
+
+
+def make_dense_train_step(cfg: RecommenderConfig):
+    """Jitted dense-control ``step(params, ids, labels) -> (loss,
+    grads)`` with full tables in the param tree.  Its embedding
+    gradients ARE dense (vocab, dim) scatter-adds — the control the
+    bench row measures pulled bytes and numerics against, and the
+    violating shape the sparse-gradient audit flags."""
+    import jax
+
+    def loss_fn(params, ids, labels):
+        return logloss(apply(params, ids, cfg), labels)
+
+    @jax.jit
+    def step(params, ids, labels):
+        return jax.value_and_grad(loss_fn)(params, ids, labels)
+
+    return step

@@ -1,0 +1,260 @@
+"""Recommender training over PS-sharded embedding tables.
+
+The tables are too large for one chip/server by construction, so each
+logical ``(vocab, dim)`` table splits into row-block SHARD KEYS
+(``emb0:s0``, ``emb0:s1``, ...) that the existing crc32 key rule
+(kvstore.py ``_server_idx``) spreads across PS servers — no new
+placement machinery, the sharding IS the key naming.  Each step:
+
+  1. host-side ``np.unique(ids, return_inverse=True)`` per field —
+     the dedup that makes wire traffic ∝ unique rows;
+  2. ``row_sparse_pull`` of ONLY those rows, fanned out per shard key
+     (``mxnet_kvstore_bytes_total{op=row_sparse_pull}`` witnesses the
+     hot-row bytes);
+  3. the jitted sparse step (model.make_sparse_train_step) over the
+     pulled rows — embedding grads come back in (unique_rows, dim)
+     space, never (vocab, dim);
+  4. row-sparse push of those grads per shard key
+     (``op=row_sparse_push``); the server's sparse handler applies
+     SGD/Adagrad to the touched rows only.  EVERY shard key is pushed
+     every step — possibly with zero rows — so sync-mode aggregation
+     rounds stay aligned across workers;
+  5. dense push + pull of the small MLP head through the same store.
+
+Unique-row counts vary per batch, so the pulled row blocks are padded
+host-side to the batch size before entering the jit: the program
+compiles once, while the WIRE carries only the true unique rows —
+padding is a compute-side convenience, never traffic.
+
+``sparse=False`` builds the dense-embedding control on the same store
+and data: full tables pulled/pushed per step (the pulled-bytes
+denominator) and a vocab-sized scatter in backward (the numerics
+control the lr0 pin compares against).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from .. import ndarray as nd
+from ..ndarray import sparse as _sp
+from . import model as _model
+from .model import RecommenderConfig
+
+__all__ = ["ShardedEmbeddingTable", "RecommenderTrainStep"]
+
+
+class ShardedEmbeddingTable:
+    """One logical ``(vocab, dim)`` embedding table row-block-sharded
+    into ``n_shards`` PS keys.  Global row ``r`` lives in shard
+    ``r // rows_per_shard`` at local row ``r % rows_per_shard``; pulls
+    and pushes fan out per shard carrying only that shard's rows."""
+
+    def __init__(self, name: str, vocab: int, dim: int,
+                 n_shards: int = 1, dtype=_np.float32):
+        if n_shards < 1 or n_shards > vocab:
+            raise ValueError("n_shards %d outside [1, vocab=%d]"
+                             % (n_shards, vocab))
+        self.name = name
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.n_shards = int(n_shards)
+        self.dtype = _np.dtype(dtype)
+        self.rows_per_shard = -(-self.vocab // self.n_shards)
+        self.keys = ["%s:s%d" % (name, s) for s in range(self.n_shards)]
+
+    def shard_rows(self, s: int) -> int:
+        lo = s * self.rows_per_shard
+        return min(self.rows_per_shard, self.vocab - lo)
+
+    def shard_shape(self, s: int) -> tuple:
+        return (self.shard_rows(s), self.dim)
+
+    def init(self, kv, table_np: _np.ndarray) -> None:
+        """Register every shard's row block (kv.init is set-if-absent,
+        so every worker can call this with the same seeded table)."""
+        if table_np.shape != (self.vocab, self.dim):
+            raise ValueError("table shape %s != (%d, %d)"
+                             % (table_np.shape, self.vocab, self.dim))
+        for s, key in enumerate(self.keys):
+            lo = s * self.rows_per_shard
+            kv.init(key, nd.array(
+                _np.ascontiguousarray(table_np[lo:lo + self.shard_rows(s)],
+                                      dtype=self.dtype)))
+
+    def pull_rows(self, kv, rows: _np.ndarray) -> _np.ndarray:
+        """Gather the listed global rows (sorted unique int64) into a
+        dense ``(len(rows), dim)`` host block — only those rows travel,
+        per shard, via ``row_sparse_pull``."""
+        rows = _np.asarray(rows, dtype=_np.int64).reshape(-1)
+        out = _np.zeros((rows.size, self.dim), self.dtype)
+        for s, key in enumerate(self.keys):
+            mask = (rows // self.rows_per_shard) == s
+            if not mask.any():
+                continue  # reads need no round alignment — skip the RPC
+            local = rows[mask] - s * self.rows_per_shard
+            o = _sp.zeros("row_sparse", self.shard_shape(s),
+                          dtype=self.dtype)
+            kv.row_sparse_pull(key, out=o, row_ids=nd.array(local))
+            # rows[mask] is sorted, so the pulled (sorted-unique) rows
+            # line up positionally with the mask's True slots
+            out[mask] = o.data.asnumpy()
+        return out
+
+    def push_rows(self, kv, rows: _np.ndarray, values: _np.ndarray,
+                  always_all_shards: bool = True) -> None:
+        """Push a row-sparse gradient, fanned out per shard.  With
+        ``always_all_shards`` every shard key is pushed even when this
+        batch touched none of its rows (an empty row-sparse grad): in
+        sync mode the server counts parts per key, so every worker must
+        contribute to every key every round."""
+        rows = _np.asarray(rows, dtype=_np.int64).reshape(-1)
+        values = _np.asarray(values, dtype=self.dtype).reshape(
+            rows.size, self.dim)
+        for s, key in enumerate(self.keys):
+            mask = (rows // self.rows_per_shard) == s
+            if not mask.any() and not always_all_shards:
+                continue
+            local = rows[mask] - s * self.rows_per_shard
+            grad = _sp.row_sparse_array(
+                (values[mask], local), shape=self.shard_shape(s),
+                dtype=self.dtype)
+            kv.push(key, grad)
+
+
+class RecommenderTrainStep:
+    """One worker's PS-backed recommender step (sparse tier or the
+    dense-embedding control — same data, same store, same optimizer
+    placement, so counter deltas between the two ARE the wire claim)."""
+
+    def __init__(self, cfg: RecommenderConfig, kv, optimizer=None,
+                 n_shards: int = 2, seed: int = 0, sparse: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.kv = kv
+        self.sparse = bool(sparse)
+        params = _model.init_params(jax.random.PRNGKey(seed), cfg)
+        host = {n: _np.asarray(v) for n, v in params.items()}
+        self._dense_names = _model.dense_param_names(cfg)
+        self.tables: Dict[str, ShardedEmbeddingTable] = {}
+        if self.sparse:
+            for name in _model.table_names(cfg):
+                t = ShardedEmbeddingTable(name, cfg.vocab, cfg.embed_dim,
+                                          n_shards=n_shards)
+                t.init(kv, host[name])
+                self.tables[name] = t
+            self._step_fn = _model.make_sparse_train_step(cfg)
+        else:
+            for name in _model.table_names(cfg):
+                kv.init("rec:" + name, nd.array(host[name]))
+            self._step_fn = _model.make_dense_train_step(cfg)
+        for name in self._dense_names:
+            kv.init("rec:" + name, nd.array(host[name]))
+        if optimizer is not None:
+            kv.set_optimizer(optimizer)
+        self.dense_params = {n: jnp.asarray(host[n])
+                             for n in self._dense_names}
+        # dense control keeps full tables worker-side between pulls
+        self._full_tables = (None if self.sparse else
+                             {n: jnp.asarray(host[n])
+                              for n in _model.table_names(cfg)})
+
+    # -- one step ------------------------------------------------------
+    def step(self, ids_np: _np.ndarray, clicks_np: _np.ndarray) -> dict:
+        if self.sparse:
+            return self._step_sparse(ids_np, clicks_np)
+        return self._step_dense(ids_np, clicks_np)
+
+    def _push_pull_dense_head(self, grads) -> None:
+        import jax.numpy as jnp
+
+        for n in self._dense_names:
+            self.kv.push("rec:" + n, nd.array(_np.asarray(grads[n])))
+        for n in self._dense_names:
+            o = nd.zeros(self.dense_params[n].shape)
+            self.kv.pull("rec:" + n, out=o)
+            self.dense_params[n] = jnp.asarray(o.asnumpy())
+
+    def _step_sparse(self, ids_np, clicks_np) -> dict:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        B = ids_np.shape[0]
+        uniqs: List[_np.ndarray] = []
+        rows_pad: List = []
+        inverse: List = []
+        for f, name in enumerate(_model.table_names(cfg)):
+            uniq, inv = _np.unique(ids_np[:, f].astype(_np.int64),
+                                   return_inverse=True)
+            pulled = self.tables[name].pull_rows(self.kv, uniq)
+            # pad unique rows to batch size: ONE compiled program for
+            # every batch, while the wire carried only uniq.size rows
+            pad = _np.zeros((B, cfg.embed_dim), _np.float32)
+            pad[:uniq.size] = pulled
+            uniqs.append(uniq)
+            rows_pad.append(jnp.asarray(pad))
+            inverse.append(jnp.asarray(inv.astype(_np.int32)))
+        loss, d_rows, d_dense = self._step_fn(
+            tuple(rows_pad), tuple(inverse), self.dense_params,
+            jnp.asarray(clicks_np))
+        for f, name in enumerate(_model.table_names(cfg)):
+            vals = _np.asarray(d_rows[f])[:uniqs[f].size]
+            self.tables[name].push_rows(self.kv, uniqs[f], vals)
+        self._push_pull_dense_head(d_dense)
+        return {"loss": float(loss),
+                "unique_rows": int(sum(u.size for u in uniqs)),
+                "batch": int(B)}
+
+    def _step_dense(self, ids_np, clicks_np) -> dict:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        # the control pays the full-table wire price every step: pull
+        # every (vocab, dim) table, push every dense (vocab, dim) grad
+        for n in _model.table_names(cfg):
+            o = nd.zeros((cfg.vocab, cfg.embed_dim))
+            self.kv.pull("rec:" + n, out=o)
+            self._full_tables[n] = jnp.asarray(o.asnumpy())
+        params = dict(self._full_tables)
+        params.update(self.dense_params)
+        loss, grads = self._step_fn(params, jnp.asarray(ids_np),
+                                    jnp.asarray(clicks_np))
+        for n in _model.table_names(cfg):
+            self.kv.push("rec:" + n, nd.array(_np.asarray(grads[n])))
+        self._push_pull_dense_head(
+            {n: grads[n] for n in self._dense_names})
+        uniq = sum(_np.unique(ids_np[:, f]).size
+                   for f in range(cfg.n_fields))
+        return {"loss": float(loss), "unique_rows": int(uniq),
+                "batch": int(ids_np.shape[0])}
+
+    # -- loop ----------------------------------------------------------
+    def fit(self, it, num_steps: int) -> dict:
+        """Run ``num_steps`` batches off the iterator; returns losses,
+        samples/s and the mean unique-rows-per-batch the pulled-bytes
+        ratio is idealized against."""
+        losses: List[float] = []
+        uniq = 0
+        samples = 0
+        t0 = time.perf_counter()
+        for _ in range(int(num_steps)):
+            try:
+                data, label, _pad = it.next_raw()
+            except StopIteration:
+                it.reset()
+                data, label, _pad = it.next_raw()
+            out = self.step(data[0], label[0])
+            losses.append(out["loss"])
+            uniq += out["unique_rows"]
+            samples += out["batch"]
+        dt = time.perf_counter() - t0
+        return {
+            "losses": losses,
+            "samples_per_s": samples / dt if dt > 0 else float("inf"),
+            "mean_unique_rows_per_batch": uniq / max(len(losses), 1),
+            "steps": len(losses),
+        }

@@ -338,6 +338,184 @@ def run_compression_env():
     print("worker %d OK" % rank)
 
 
+def run_sparse_wire():
+    """Hot-row wire acceptance (ISSUE 19): row-sparse pull/push bytes on
+    a 2-server cluster are ∝ UNIQUE ROWS (exact formulas, counter
+    deltas), and sparse 2-bit compression round-trips BITWISE against
+    the uncompressed control with per-row error feedback.
+
+    Byte formulas under test (kvstore._rsp_pull_wire_nbytes /
+    KVStoreDist._push_wire_nbytes / GradientCompression.
+    rows_wire_nbytes): pull and uncompressed push move
+    U * (row_bytes + 8B id); a compressed push moves U * 8 id bytes +
+    ceil(U*dim/4) code bytes.  Numerics follow the fp64/lr0
+    methodology: representable {-t, 0, +t} gradients make the encode
+    lossless (bitwise aggregate), and power-of-two sub-threshold
+    pushes make the error-feedback trajectory land bitwise on the
+    uncompressed SGD control."""
+    from mxnet_tpu import diagnostics as _diag
+    from mxnet_tpu.ndarray import sparse as sp
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2
+    pull_ctr = _diag.metrics.counter("mxnet_kvstore_bytes_total",
+                                     labels={"op": "row_sparse_pull"})
+    push_ctr = _diag.metrics.counter("mxnet_kvstore_bytes_total",
+                                     labels={"op": "row_sparse_push"})
+
+    vocab, dim = 64, 4
+    table = np.arange(vocab * dim, dtype=np.float32).reshape(vocab, dim)
+    # two shard-style keys so the crc32 rule spreads them over both
+    # servers (the ShardedEmbeddingTable naming)
+    kv.init("emb:s0", nd.array(table))
+    kv.init("emb:s1", nd.array(table + 1.0))
+    kv.barrier()
+
+    # pull bytes ∝ unique rows — 3 rows from a 64-row table cost
+    # 3*(dim*4 + 8), vocab nowhere in the formula
+    rows = np.array([3, 9, 31], np.int64)
+    base = pull_ctr.value
+    out = sp.zeros("row_sparse", (vocab, dim))
+    kv.row_sparse_pull("emb:s0", out=out, row_ids=nd.array(rows))
+    d_pull = pull_ctr.value - base
+    assert d_pull == rows.size * (dim * 4 + 8), d_pull
+    np.testing.assert_array_equal(out.todense().asnumpy()[rows],
+                                  table[rows])
+
+    # uncompressed sparse push: U*(dim*4 + 8) on the wire per key; the
+    # sync round aggregates both workers' rows, untouched rows intact
+    rows_p = np.array([1, 4], np.int64)
+    base = push_ctr.value
+    kv.push("emb:s1", sp.row_sparse_array(
+        (np.full((2, dim), float(rank + 1), np.float32), rows_p),
+        shape=(vocab, dim)))
+    d_push = push_ctr.value - base
+    assert d_push == rows_p.size * (dim * 4 + 8), d_push
+    out = sp.zeros("row_sparse", (vocab, dim))
+    kv.row_sparse_pull("emb:s1", out=out,
+                       row_ids=nd.array([0, 1, 4]))
+    o = out.todense().asnumpy()
+    np.testing.assert_array_equal(o[[1, 4]], float(sum(range(1, nw + 1))))
+    np.testing.assert_array_equal(o[0], table[0] + 1.0)
+    kv.barrier()
+
+    # phase 1a: uncompressed representable-gradient control (replace
+    # semantics — no optimizer yet)
+    n_rows_c = 8
+    rows_c = np.arange(n_rows_c, dtype=np.int64)
+    vals_c = (((np.arange(n_rows_c * dim) % 3).astype(np.float32) - 1.0)
+              * 0.5).reshape(n_rows_c, dim)   # every value in {-t, 0, +t}
+    kv.init("gcs", nd.zeros((16, dim)))
+    base = push_ctr.value
+    kv.push("gcs", sp.row_sparse_array((vals_c, rows_c),
+                                       shape=(16, dim)))
+    d_unc = push_ctr.value - base
+    assert d_unc == n_rows_c * (dim * 4 + 8), d_unc
+    out = sp.zeros("row_sparse", (16, dim))
+    kv.row_sparse_pull("gcs", out=out, row_ids=nd.array(rows_c))
+    unc_rows = out.todense().asnumpy()[rows_c].copy()
+    np.testing.assert_array_equal(unc_rows, nw * vals_c)
+
+    # per-row error-feedback control BEFORE compression is enabled
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.0,
+                                      rescale_grad=1.0 / nw, wd=0.0))
+    ef_rows = np.array([2, 5], np.int64)
+    kv.init("efs_raw", nd.zeros((8, dim)))
+    for _ in range(4):
+        kv.push("efs_raw", sp.row_sparse_array(
+            (np.full((2, dim), 0.25, np.float32), ef_rows),
+            shape=(8, dim)))
+        out = sp.zeros("row_sparse", (8, dim))
+        kv.row_sparse_pull("efs_raw", out=out, row_ids=nd.array(ef_rows))
+    w_raw = out.todense().asnumpy()[ef_rows].copy()
+    np.testing.assert_array_equal(w_raw, -0.5)
+    kv.set_optimizer(None)
+    kv.barrier()
+
+    # phase 1b: compressed representable push — row ids travel
+    # uncompressed (8B each) + 2-bit codes; aggregate BITWISE equal
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    base = push_ctr.value
+    kv.push("gcs", sp.row_sparse_array((vals_c, rows_c),
+                                       shape=(16, dim)))
+    d_comp = push_ctr.value - base
+    assert d_comp == n_rows_c * 8 + (n_rows_c * dim + 3) // 4, d_comp
+    assert d_comp < d_unc
+    out = sp.zeros("row_sparse", (16, dim))
+    kv.row_sparse_pull("gcs", out=out, row_ids=nd.array(rows_c))
+    np.testing.assert_array_equal(out.todense().asnumpy()[rows_c],
+                                  unc_rows)
+
+    # phase 2: compressed per-row error feedback lands bitwise on the
+    # uncompressed control's weights
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.0,
+                                      rescale_grad=1.0 / nw, wd=0.0))
+    kv.init("efs", nd.zeros((8, dim)))
+    for _ in range(4):
+        kv.push("efs", sp.row_sparse_array(
+            (np.full((2, dim), 0.25, np.float32), ef_rows),
+            shape=(8, dim)))
+        out = sp.zeros("row_sparse", (8, dim))
+        kv.row_sparse_pull("efs", out=out, row_ids=nd.array(ef_rows))
+    np.testing.assert_array_equal(out.todense().asnumpy()[ef_rows],
+                                  w_raw)
+    kv.barrier()
+    kv.close()
+    print("worker %d OK pull=%d push=%d unc=%d comp=%d"
+          % (rank, d_pull, d_push, d_unc, d_comp))
+
+
+def run_sparse_chaos():
+    """drop_sparse_pull absorption (ISSUE 19): the test sets
+    MXNET_CHAOS=drop_sparse_pull:rank=1,nth=2 — rank 1's second
+    row_sparse_pull is served but its RESPONSE is lost.  pull_rows is a
+    side-effect-free read in _RETRY_OPS, so the transport must back
+    off, reconnect and resend, and every pulled value must stay
+    BITWISE identical to the fault-free schedule."""
+    from mxnet_tpu.ndarray import sparse as sp
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2
+    vocab, dim = 16, 2
+    table = np.arange(vocab * dim, dtype=np.float32).reshape(vocab, dim)
+    kv.init("emb:s0", nd.array(table))
+    rows = np.array([1, 7, 12], np.int64)
+    for rnd in range(1, 4):
+        out = sp.zeros("row_sparse", (vocab, dim))
+        kv.row_sparse_pull("emb:s0", out=out, row_ids=nd.array(rows))
+        # round 1 sees the seeded table; later rounds see the previous
+        # round's replace-aggregate (no optimizer) — exact either way
+        want = table[rows] if rnd == 1 else \
+            np.full((rows.size, dim), float(sum(range(1, nw + 1))),
+                    np.float32)
+        np.testing.assert_array_equal(out.todense().asnumpy()[rows],
+                                      want)
+        kv.push("emb:s0", sp.row_sparse_array(
+            (np.full((rows.size, dim), float(rank + 1), np.float32),
+             rows), shape=(vocab, dim)))
+        out2 = sp.zeros("row_sparse", (vocab, dim))
+        kv.row_sparse_pull("emb:s0", out=out2, row_ids=nd.array(rows))
+        np.testing.assert_array_equal(
+            out2.todense().asnumpy()[rows],
+            float(sum(range(1, nw + 1))))
+    from mxnet_tpu import chaos as _chaos
+    from mxnet_tpu import diagnostics as _diag
+
+    if rank == 1:
+        assert _chaos.injected_total("drop_sparse_pull") == 1
+        retries = _diag.metrics.counter("mxnet_ps_retries_total",
+                                        labels={"op": "pull_rows"})
+        assert retries.value >= 1, \
+            "dropped sparse pull absorbed without a retry?"
+    else:
+        assert _chaos.injected_total() == 0
+    kv.barrier()
+    kv.close()
+    print("worker %d OK" % rank)
+
+
 def main():
     kind = sys.argv[1] if len(sys.argv) > 1 else "dist_sync"
     if kind == "flight":
@@ -348,6 +526,10 @@ def main():
         return run_compression_wire()
     if kind == "compression_env":
         return run_compression_env()
+    if kind == "sparse_wire":
+        return run_sparse_wire()
+    if kind == "sparse_chaos":
+        return run_sparse_chaos()
     kv = mx.kv.create(kind)
     assert kv.num_workers >= 1
     if kind == "dist_sync":

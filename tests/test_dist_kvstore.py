@@ -122,6 +122,27 @@ def test_dist_compression_env_toggle():
         "MXNET_GRADIENT_COMPRESSION_THRESHOLD": "0.5"})
 
 
+def test_dist_sparse_wire_bytes_and_compression():
+    """ISSUE 19 acceptance: on a 2-worker/2-server cluster (crc32
+    spreads the emb:sN shard keys across both servers), row-sparse
+    pull/push wire bytes are ∝ UNIQUE ROWS with exact formulas
+    (U*(row_bytes+8) uncompressed, U*8 + ceil(U*dim/4) compressed) in
+    mxnet_kvstore_bytes_total{op=row_sparse_pull|row_sparse_push}, and
+    sparse 2-bit compression with per-row error feedback round-trips
+    BITWISE against the uncompressed control (all assertions live in
+    dist_worker.run_sparse_wire)."""
+    _run_cluster("sparse_wire", 2, 2)
+
+
+def test_dist_sparse_chaos_drop_pull():
+    """ISSUE 19 chaos kind: rank 1's second row_sparse_pull response is
+    dropped (drop_sparse_pull:rank=1,nth=2); the retry path must absorb
+    it with every pulled value bitwise identical to the fault-free
+    schedule (assertions in dist_worker.run_sparse_chaos)."""
+    _run_cluster("sparse_chaos", 2, 1, extra_env={
+        "MXNET_CHAOS": "drop_sparse_pull:rank=1,nth=2"})  # mxlint: disable=MXL002
+
+
 def test_local_set_gradient_compression_raises():
     """Satellite bugfix: the local store used to SILENTLY store the
     params and never compress anything.  Every in-process spelling now
