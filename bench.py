@@ -893,11 +893,54 @@ def bench_generation(slo_p99_tpot_ms=200.0):
     cont_tps = ab_tokens / cont_s
 
     # SLO ramp through the full server path (queue + breaker + worker)
+    # with the recorder live: the row's p99 attribution comes from the
+    # ramp's own slowest requests
+    from mxnet_tpu.serving import reqtrace as _reqtrace
+
+    _reqtrace.reset(capacity=512, topk=16)
     srv = serving.ModelServer(queue_max=256, default_deadline_ms=30000)
     srv.add_generator(cont)  # already compiled: warmup is a no-op
     rep = serving.gen_tokens_at_slo(
         srv, "bench_gen", slo_p99_tpot_ms=slo_p99_tpot_ms,
         start_qps=4.0, max_qps=2000.0, window_s=1.5)
+    slowest = _reqtrace.top_slowest()
+    p99_attribution = _reqtrace.attribution_shares(slowest)
+    slowest_line = (_reqtrace.attribution(slowest[0])
+                    if slowest else None)
+
+    # recorder overhead at the operating point the row reports: replay
+    # the best met-SLO window (same qps, same seeded workload) with the
+    # recorder on vs MXNET_SERVE_REQTRACE_SIZE=0 and compare delivered
+    # tokens/s — the acceptance bound is <=1% on the row's headline
+    # metric.  (A saturated bare-engine drive is the wrong denominator:
+    # there a whole request is ~1 ms of toy-model compute, so fixed
+    # per-request bookkeeping reads as percent-scale overhead no real
+    # serving rate would see.)  Interleaved best-of-3 so machine drift
+    # hits both recorder states alike.
+    best_qps = max((s["offered_qps"] for s in rep["ramp"]
+                    if s["met_slo"]), default=0.0)
+    rec_on_tps = rec_off_tps = rec_overhead_pct = 0.0
+    if best_qps > 0:
+        for _ in range(3):
+            _reqtrace.reset(capacity=512, topk=16)
+            w = serving.run_generation_load(
+                srv, "bench_gen", qps=best_qps, duration_s=1.5, seed=0)
+            rec_on_tps = max(rec_on_tps, w["tokens_per_s"])
+            _reqtrace.reset(capacity=0)
+            w = serving.run_generation_load(
+                srv, "bench_gen", qps=best_qps, duration_s=1.5, seed=0)
+            rec_off_tps = max(rec_off_tps, w["tokens_per_s"])
+        if rec_off_tps > 0:
+            rec_overhead_pct = max(
+                0.0, (rec_off_tps - rec_on_tps) / rec_off_tps * 100.0)
+    reqtrace_row = {
+        "p99_attribution": p99_attribution,
+        "slowest": slowest_line,
+        "recorder_overhead_pct": round(rec_overhead_pct, 2),
+        "tokens_per_s_recorder_on": round(rec_on_tps, 1),
+        "tokens_per_s_recorder_off": round(rec_off_tps, 1),
+    }
+    _reqtrace.reset()  # back to the env-configured recorder
     srv.drain(timeout_s=15.0)
 
     # the zero-steady-state-recompile proof: after warmup + A/B + the
@@ -930,6 +973,7 @@ def bench_generation(slo_p99_tpot_ms=200.0):
                  "num_blocks": cont.kv.num_blocks},
         "steady_state_recompiles": steady_recompiles,
         "compile_warmup_s": round(compile_s, 2),
+        "reqtrace": reqtrace_row,
         "ramp": rep["ramp"],
     }
 

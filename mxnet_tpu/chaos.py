@@ -86,6 +86,14 @@ hold; ``nth`` skips the first nth-1 candidate events.  Kinds:
     (``python -m mxnet_tpu.sdc --replay``) must catch.  Match keys:
     ``rank``, ``step``, ``nth``, ``count``; selectors ``param``/
     ``bit`` as above.
+  * ``stall_decode_tick`` — sleep ``ms`` (default 50) inside the
+    matching model's generation-engine decode tick, before the
+    compiled decode step runs — a seeded per-tick stall every rider
+    of that tick absorbs.  The engine tags the stalled spans
+    ``injected=true`` in the request recorder
+    (serving/reqtrace.py), so the tail-latency autopsy names it
+    "stall:injected:stall_decode_tick", never an organic slow
+    decode.  Match keys: ``model``, ``nth``, ``count``, ``ms``.
   * ``kill_rank``      — SUPERVISOR-level kill: the elastic
     supervisor (mxnet_tpu.elastic) SIGKILLs its child worker ``rank``
     mid-run — the machine-went-away failure the automatic
@@ -117,7 +125,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["Rule", "rules", "enabled", "fault", "should_kill",
            "maybe_slow_request", "should_fail_execute",
-           "should_cancel_request",
+           "should_cancel_request", "maybe_stall_decode_tick",
            "maybe_corrupt_shard", "should_fail_version",
            "maybe_slow_decode", "should_kill_rank",
            "should_bitflip_param", "should_bitflip_grad",
@@ -329,13 +337,33 @@ def should_kill(step: int, **ctx) -> None:
         os._exit(KILL_EXIT_CODE)
 
 
-def maybe_slow_request(model: str, **ctx) -> None:
+def maybe_slow_request(model: str, **ctx) -> Optional[dict]:
     """slow_request hook (serving batcher dispatch): sleep ms when a
     rule fires — the seeded straggler executor the overload e2e test
-    drives load against."""
+    drives load against.  Returns ``{"kind", "ms"}`` when it fired
+    (None otherwise) so the dispatcher can tag the batch's reqtrace
+    spans ``injected=true`` — same contract as maybe_delay."""
     r = fault("slow_request", model=model, **ctx)
-    if r is not None:
-        time.sleep(float(r.params.get("ms", 50.0)) / 1e3)
+    if r is None:
+        return None
+    ms = float(r.params.get("ms", 50.0))
+    time.sleep(ms / 1e3)
+    return {"kind": "slow_request", "ms": ms}
+
+
+def maybe_stall_decode_tick(model: str, **ctx) -> Optional[dict]:
+    """stall_decode_tick hook (generation engine, once per decode
+    tick, BEFORE the compiled decode step): sleep ms when a rule
+    matches the model — a seeded tick-wide stall that every rider of
+    the tick absorbs.  Returns ``{"kind", "ms"}`` when it fired so
+    the engine tags the stalled reqtrace spans ``injected=true``
+    (the tail autopsy must report it as chaos, never organic)."""
+    r = fault("stall_decode_tick", model=model, **ctx)
+    if r is None:
+        return None
+    ms = float(r.params.get("ms", 50.0))
+    time.sleep(ms / 1e3)
+    return {"kind": "stall_decode_tick", "ms": ms}
 
 
 def should_fail_execute(model: str, **ctx) -> bool:
@@ -583,6 +611,30 @@ def _self_test() -> tuple:
         checks["cancel_nth_count"] = fires == [False, True, False]
         checks["cancel_injected_total"] = \
             injected_total("cancel_request") == 1
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        reset()
+
+    # 5c) stall_decode_tick: model-scoped per-tick stall with the
+    # usual nth/count window; the fired dict carries kind+ms so the
+    # engine can tag the reqtrace spans injected=true
+    os.environ["MXNET_CHAOS"] = (  # mxlint: disable=MXL002
+        "stall_decode_tick:model=gen,ms=1,nth=2,count=2")
+    reset()
+    try:
+        checks["stall_tick_wrong_model"] = \
+            maybe_stall_decode_tick("other") is None
+        checks["stall_tick_nth_skips"] = \
+            maybe_stall_decode_tick("gen") is None
+        fired = maybe_stall_decode_tick("gen")
+        checks["stall_tick_fires"] = (
+            fired is not None
+            and fired["kind"] == "stall_decode_tick"
+            and fired["ms"] == 1.0)
+        maybe_stall_decode_tick("gen")
+        checks["stall_tick_count"] = \
+            maybe_stall_decode_tick("gen") is None and \
+            injected_total("stall_decode_tick") == 2
     finally:
         del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
         reset()

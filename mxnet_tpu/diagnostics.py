@@ -68,7 +68,9 @@ __all__ = [
     "validate_prom_text", "EXIT_PREEMPTED", "EXIT_WATCHDOG_ABORT",
     "EXIT_DIVERGED",
     "register_preemption_hook", "unregister_preemption_hook",
-    "run_preemption_hooks", "set_dead_peers", "dead_peers",
+    "run_preemption_hooks", "register_dump_hook",
+    "unregister_dump_hook", "run_dump_hooks",
+    "set_dead_peers", "dead_peers",
     "generation", "touch_heartbeat", "DivergenceError",
     "DivergenceGuard", "loss_signal",
 ]
@@ -163,6 +165,45 @@ def run_preemption_hooks(reason: str) -> int:
             ran += 1
         except Exception:
             _log.exception("preemption hook %r failed (%s)", key, reason)
+    return ran
+
+
+_dump_hooks_lock = threading.RLock()
+_dump_hooks: "Dict[Any, Any]" = {}
+
+
+def register_dump_hook(fn, key: Any = None) -> Any:
+    """Register ``fn(reason)`` to run whenever this process dumps
+    evidence on a signal (SIGUSR1/SIGTERM) — the way the serving
+    request recorder rides the flight recorder's shutdown path.
+    Unlike preemption hooks, dump hooks have NO exit semantics: they
+    only persist artifacts.  Also arms the signal handlers, same as
+    :func:`register_preemption_hook`."""
+    key = key if key is not None else id(fn)
+    with _dump_hooks_lock:
+        _dump_hooks[key] = fn
+    if not recorder._signals_installed:
+        recorder.install_signal_handlers()
+    return key
+
+
+def unregister_dump_hook(key: Any) -> None:
+    with _dump_hooks_lock:
+        _dump_hooks.pop(key, None)
+
+
+def run_dump_hooks(reason: str) -> int:
+    """Run every registered dump hook; returns how many ran without
+    raising.  Never raises — this runs inside signal handlers."""
+    with _dump_hooks_lock:
+        hooks = list(_dump_hooks.items())
+    ran = 0
+    for key, fn in hooks:
+        try:
+            fn(reason)
+            ran += 1
+        except Exception:
+            _log.exception("dump hook %r failed (%s)", key, reason)
     return ran
 
 
@@ -630,6 +671,7 @@ class FlightRecorder:
 
             def _usr1(signum, frame):
                 self.dump(reason="SIGUSR1")
+                run_dump_hooks("SIGUSR1")
                 # SIG_DFL/SIG_IGN are not callable: only a handler the
                 # app actually installed runs after the dump
                 if callable(prev_usr1):
@@ -645,6 +687,7 @@ class FlightRecorder:
                 # worker's real dump) with a useless artifact
                 if self.n_recorded():
                     self.dump(reason="SIGTERM")                # 1. dump
+                run_dump_hooks("SIGTERM")  # serving autopsy et al.
                 from . import env as _envmod
 
                 try:

@@ -287,7 +287,7 @@ register("MXNET_CHAOS", "str", None,
          "'kind:k=v,k=v' with kinds drop_push / drop_sparse_pull / "
          "delay_collective / kill / nan_grad / slow_request / "
          "fail_execute / corrupt_shard / bad_version / slow_decode / "
-         "kill_rank / cancel_request "
+         "kill_rank / cancel_request / stall_decode_tick "
          "(see mxnet_tpu/chaos.py).  Unset disables all injection.")
 
 # module — non-finite gradient guard
@@ -489,6 +489,20 @@ register("MXNET_SERVE_GEN_PREFILL_BATCH", "int", 4,
          "Largest batched prefill (sequences admitted per tick); the "
          "top of the prefill batch ladder.  Bounds prefill's "
          "head-of-line blocking of in-flight decode ticks.")
+register("MXNET_SERVE_REQTRACE_SIZE", "int", 256,
+         "Request-trace recorder ring capacity (completed/rejected "
+         "request records kept; serving/reqtrace.py).  0 disables "
+         "recording entirely — the disabled path allocates nothing "
+         "per token.")
+register("MXNET_SERVE_REQTRACE_TOPK", "int", 8,
+         "Slowest completed requests kept per sliding window for the "
+         "tail-latency autopsy (reqtrace_rank{K}.json 'slowest' "
+         "section + bench attribution shares).")
+register("MXNET_SERVE_REQTRACE_WINDOW_S", "float", 60.0,
+         "Sliding-window length (s) for the reqtrace top-K autopsy "
+         "pool and the worst-sample latency/TPOT exemplars; also "
+         "rate-limits the blown-deadline auto-dump to one per "
+         "window.")
 
 # image/image.py — decode pool
 register("MXNET_CPU_WORKER_NTHREADS", "int", 1,

@@ -48,7 +48,15 @@ deadline expiry, breaker trip/reset, drain ordering, and the
 generation tier (decode equality, continuous batching, streaming,
 cancel reclaim) — tier-1 via tests/test_serving.py; ``--serve`` runs
 the HTTP front-end.
+
+Per-request observability (serving/reqtrace.py): every request's
+lifecycle is recorded as monotonic-clock spans into a ring
+(``MXNET_SERVE_REQTRACE_SIZE``; 0 disables), with a sliding-window
+tail-latency autopsy (``reqtrace.dump()`` / SIGUSR1 / blown
+deadlines), a per-slot occupancy timeline merge_traces.py renders,
+and worst-sample exemplars in /stats and the prom exposition.
 """
+from . import reqtrace
 from .batching import Request, RequestQueue
 from .bucket_ladder import (bucket_for, bucket_for_2d, ladder,
                             ladder_2d)
@@ -79,4 +87,5 @@ __all__ = [
     "CircuitBreaker", "ModelServer", "HttpFrontend",
     "run_load", "qps_at_slo", "run_generation_load",
     "gen_tokens_at_slo", "BackgroundLoad",
+    "reqtrace",
 ]

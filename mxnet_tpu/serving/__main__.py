@@ -450,6 +450,103 @@ def _self_test() -> tuple:
     except Rejected as e:
         checks["gen_post_drain_sheds"] = e.reason == "draining"
 
+    # 14) request tracing: ring wraparound, window top-K ordering,
+    # injected-span tagging, prom exemplar validity, slot timeline —
+    # then the E2E attribution pin: under stall_decode_tick chaos the
+    # autopsy's slowest request names the injected phase dominant
+    from . import reqtrace as _reqtrace
+
+    class _FakeReq:
+        def __init__(self, rid, err=None):
+            self.id = rid
+            self.error = err
+
+    tr = _reqtrace.RequestTraceRecorder(capacity=4, topk=2,
+                                        window_s=60.0)
+    for i in range(6):
+        rid = "r%d" % i
+        tr.begin(rid, "m")
+        tr.phase(rid, "execute", 0.01 * (i + 1))
+        with tr._lock:  # age the record so totals are distinct
+            tr._open[rid]["t0"] -= 0.01 * (i + 1)
+        tr.finish(_FakeReq(rid))
+    checks["reqtrace_ring_wraps"] = (
+        len(tr._ring) == 4
+        and [r["id"] for r in tr._ring] == ["r2", "r3", "r4", "r5"])
+    rtop = tr.top_slowest()
+    checks["reqtrace_topk_ordering"] = (
+        [r["id"] for r in rtop] == ["r5", "r4"]
+        and rtop[0]["total_s"] >= rtop[1]["total_s"])
+    tr.begin("inj", "m")
+    tr.tick("m", 0.05, ["inj"],
+            injected={"kind": "stall_decode_tick", "ms": 40})
+    tr.finish(_FakeReq("inj"))
+    inj_rec = [r for r in tr._ring if r["id"] == "inj"][0]
+    iname, _ishare, iinj = _reqtrace.dominant_phase(inj_rec)
+    checks["reqtrace_injected_tagged"] = (
+        iname == "stall:injected:stall_decode_tick" and iinj
+        and inj_rec["injected_any"]
+        and "[injected]" in _reqtrace.attribution(inj_rec))
+    ex_lines = tr.exemplar_prom_lines()
+    prom_ex = _diag.metrics.to_prom().rstrip("\n") + "\n" + \
+        "\n".join(ex_lines) + "\n"
+    checks["reqtrace_exemplar_prom_valid"] = (
+        bool(ex_lines)
+        and not _diag.validate_prom_text(prom_ex)
+        and any("request_id=r5" in ln for ln in ex_lines))
+    tr.set_slots("m", 2)
+    tr.slot_acquire("m", 0, "r9")
+    tr.slot_release("m", 0)
+    tl = tr.slot_timeline()["traceEvents"]
+    checks["reqtrace_slot_timeline"] = (
+        any(e.get("ph") == "X" and e.get("cat") == "serving_slot"
+            and e["name"] == "seq:r9" for e in tl)
+        and any(e.get("ph") == "M" and e.get("name") == "thread_name"
+                and e["args"]["name"] == "m/slot0" for e in tl))
+
+    _reqtrace.reset(capacity=128, topk=4, window_s=60.0)
+    ert = StubGenerationRuntime("gen_rq", slots=2, max_prompt=16,
+                                max_context=64, block_tokens=16,
+                                max_new=16, prefill_batch=2)
+    esrv = ModelServer(queue_max=32, default_deadline_ms=30_000)
+    esrv.add_generator(ert)
+    _rq_kn = "stall_decode_tick:model=gen_rq,ms=25,count=999"
+    os.environ["MXNET_CHAOS"] = _rq_kn  # mxlint: disable=MXL002
+    _chaos.reset()
+    try:
+        # 2x slot capacity: the second wave queues behind the first,
+        # and decodes long enough that its own injected stall time
+        # dominates the wait it inherited
+        ereqs = [esrv.submit_generation("gen_rq", [i + 1, i + 2],
+                                        max_new=2 if i < 2 else 10)
+                 for i in range(4)]
+        for r in ereqs:
+            r.wait(30.0)
+    finally:
+        del os.environ["MXNET_CHAOS"]  # mxlint: disable=MXL002
+        _chaos.reset()
+    eslow = _reqtrace.top_slowest(1)
+    ename, eshare, einj = _reqtrace.dominant_phase(eslow[0]) \
+        if eslow else (None, 0.0, False)
+    checks["reqtrace_e2e_injected_dominant"] = (
+        bool(eslow) and einj and eshare >= 0.5
+        and ename == "stall:injected:stall_decode_tick")
+    rq_dir = tempfile.mkdtemp(prefix="mx-serve-selftest-rq-")
+    rq_path = _reqtrace.dump(
+        path=os.path.join(rq_dir, "reqtrace_rank0.json"),
+        reason="self_test")
+    rq_payload = None
+    if rq_path:
+        with open(rq_path) as f:
+            rq_payload = json.load(f)
+    checks["reqtrace_dump_payload"] = bool(
+        rq_payload
+        and rq_payload["header"]["format"] == _reqtrace.REQTRACE_FORMAT
+        and rq_payload["header"]["reason"] == "self_test"
+        and any("stall:injected" in (r.get("attribution") or "")
+                for r in rq_payload["slowest"]))
+    _reqtrace.reset()  # back to the env-configured recorder
+
     return all(checks.values()), checks
 
 
@@ -493,10 +590,12 @@ def main(argv=None) -> int:
         description="batching model server: self-test / demo serve")
     ap.add_argument("--self-test", action="store_true",
                     help="exercise queue admission, deadline expiry, "
-                         "breaker trip/reset, drain ordering, and the "
+                         "breaker trip/reset, drain ordering, the "
                          "generation tier (paged-cache decode "
                          "equality, continuous batching, streaming, "
-                         "cancel reclaim)")
+                         "cancel reclaim), and request tracing (ring "
+                         "wraparound, injected-stall attribution, "
+                         "prom exemplars)")
     ap.add_argument("--serve", action="store_true",
                     help="serve the demo model over HTTP until SIGTERM")
     ap.add_argument("--port", type=int, default=None,

@@ -32,6 +32,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Tuple
 
+from . import reqtrace as _reqtrace
 from .errors import DeadlineExceeded, Rejected
 
 __all__ = ["Request", "RequestQueue"]
@@ -60,16 +61,23 @@ class Request:
         self.result = None
         self.error: Optional[BaseException] = None
         self._event = threading.Event()
+        # every request's lifecycle opens here — construction is the
+        # one point both the batch and generation tiers pass through
+        _reqtrace.begin(self.id, model)
 
     # -- completion ----------------------------------------------------
     def set_result(self, result) -> None:
         self.result = result
         self.done_ts = time.monotonic()
+        # terminal reqtrace span BEFORE the waiter wakes: by the time
+        # wait() returns, the autopsy record is final
+        _reqtrace.finish(self)
         self._event.set()
 
     def set_error(self, error: BaseException) -> None:
         self.error = error
         self.done_ts = time.monotonic()
+        _reqtrace.finish(self)
         self._event.set()
 
     def done(self) -> bool:
@@ -124,9 +132,11 @@ class RequestQueue:
         batcher is woken."""
         with self._cond:
             if self._closed:
+                _reqtrace.reject(req.id, req.model, "draining")
                 raise Rejected("draining", "server is draining; "
                                "no new work is admitted")
             if len(self._pending) >= self.maxsize:
+                _reqtrace.reject(req.id, req.model, "queue_full")
                 raise Rejected(
                     "queue_full",
                     "depth %d >= MXNET_SERVE_QUEUE_MAX=%d"
@@ -135,6 +145,7 @@ class RequestQueue:
             if req.expired():
                 # a deadline shorter than the queue's admission path —
                 # reject up front, don't make a batcher discover it
+                _reqtrace.reject(req.id, req.model, "deadline")
                 raise Rejected("deadline",
                                "deadline expired before admission")
             self._pending.append(req)
@@ -182,6 +193,9 @@ class RequestQueue:
             self._pending = keep
             self._next_deadline = nxt
         for r in expired:
+            # the whole life was queue residency: attribute it so the
+            # autopsy says "died waiting", not just "expired"
+            _reqtrace.phase(r.id, "queue", now - r.enqueue_ts)
             r.set_error(DeadlineExceeded(
                 "request %s: deadline expired after %.3fs in queue "
                 "(never dispatched)" % (r.id, now - r.enqueue_ts)))

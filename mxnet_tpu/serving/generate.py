@@ -40,6 +40,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import reqtrace as _reqtrace
 from .batching import Request
 from .bucket_ladder import bucket_for, ladder
 from .errors import Cancelled, DeadlineExceeded, ExecutorFailure
@@ -124,7 +125,8 @@ class GenRequest(Request):
 
 
 class _Slot(object):
-    __slots__ = ("req", "seq_id", "pos", "next_token")
+    __slots__ = ("req", "seq_id", "pos", "next_token", "decode_s",
+                 "ticks")
 
     def __init__(self, req: GenRequest, seq_id: str, pos: int,
                  next_token: int):
@@ -132,6 +134,11 @@ class _Slot(object):
         self.seq_id = seq_id
         self.pos = int(pos)          # cache cursor: where next_token
         self.next_token = int(next_token)  # ...will be written
+        # decode residency accumulates HERE (two float adds per tick)
+        # and flushes to the request recorder once at retire — a
+        # per-tick recorder call would dominate the recorder's cost
+        self.decode_s = 0.0
+        self.ticks = 0
 
 
 class GenerationRuntime:
@@ -369,6 +376,25 @@ class GenerationEngine:
         self.waiting: "deque[GenRequest]" = deque()
         self.ticks = 0
         self.tokens_out = 0
+        # stable physical slot indices (not positions in ``active``):
+        # the reqtrace slot timeline needs one lane per slot, and a
+        # retiring co-rider must not renumber everyone behind it
+        self._slot_idx: Dict[str, int] = {}
+        self._free_idx: List[int] = list(range(runtime.slots))
+        _reqtrace.set_slots(runtime.name, runtime.slots)
+
+    def _slot_on(self, seq_id: str) -> None:
+        idx = self._free_idx.pop(0) if self._free_idx \
+            else len(self._slot_idx)
+        self._slot_idx[seq_id] = idx
+        _reqtrace.slot_acquire(self.rt.name, idx, seq_id)
+
+    def _slot_off(self, seq_id: str) -> None:
+        idx = self._slot_idx.pop(seq_id, None)
+        if idx is not None:
+            self._free_idx.append(idx)
+            self._free_idx.sort()
+            _reqtrace.slot_release(self.rt.name, idx)
 
     # -- server-facing surface ----------------------------------------
     def enqueue(self, req: GenRequest) -> None:
@@ -391,6 +417,8 @@ class GenerationEngine:
         self.waiting.clear()
         for s in list(self.active):
             self.kv.free(s.seq_id)
+            self._slot_off(s.seq_id)
+            self._flush_trace(s)
             self._finish(s.req, "error", make_error(s.req))
             outcomes.append((s.req, "error", None))
         self.active = []
@@ -422,10 +450,21 @@ class GenerationEngine:
                 req.set_error(error)
         req._close_stream()
 
+    def _flush_trace(self, slot: _Slot) -> None:
+        """Fold the slot's accumulated decode residency into the
+        request's trace — must run before the terminal set_result/
+        set_error pops the open record."""
+        if slot.ticks:
+            _reqtrace.phase(slot.req.id, "decode", slot.decode_s)
+            _reqtrace.event(slot.req.id, "decode_ticks", n=slot.ticks)
+            slot.decode_s, slot.ticks = 0.0, 0
+
     def _retire(self, rep, slot: _Slot, outcome: str,
                 error: Optional[BaseException] = None,
                 evicted: bool = False) -> None:
         self.kv.free(slot.seq_id, evicted=evicted)
+        self._slot_off(slot.seq_id)
+        self._flush_trace(slot)
         self._finish(slot.req, outcome, error)
         rep["outcomes"].append((slot.req, outcome, error))
 
@@ -438,10 +477,14 @@ class GenerationEngine:
         keep_w: "deque[GenRequest]" = deque()
         for req in self.waiting:
             if req.cancelled:
+                _reqtrace.phase(req.id, "queue", now - req.enqueue_ts)
                 self._finish(req, "cancelled", Cancelled(
                     "request %s cancelled while waiting" % req.id))
                 rep["outcomes"].append((req, "cancelled", None))
             elif req.expired(now):
+                # the whole life was queue residency: make the autopsy
+                # say "died waiting", not just "expired"
+                _reqtrace.phase(req.id, "queue", now - req.enqueue_ts)
                 self._finish(req, "expired", DeadlineExceeded(
                     "request %s: deadline expired before a slot freed"
                     % req.id))
@@ -481,18 +524,24 @@ class GenerationEngine:
         room = rt.slots - len(self.active)
         group: List[GenRequest] = []
         seqs: List[str] = []
+        admit_t = time.monotonic()
         while self.waiting and len(group) < min(room, rt.prefill_batch):
             req = self.waiting[0]
             seq_id = req.id
             try:
                 self.kv.alloc(seq_id, len(req.prompt))
             except CacheExhausted:
+                # admitted-blocked: start (or keep) the wait marker so
+                # "Nms waiting on CacheExhausted" is a traced phase
+                _reqtrace.cache_wait(req.id)
                 break  # blocks free as riders finish; stay waiting
             self.waiting.popleft()
+            _reqtrace.phase(req.id, "queue", admit_t - req.enqueue_ts)
             group.append(req)
             seqs.append(seq_id)
         if not group:
             return
+        prefill_t0 = time.monotonic()
         try:
             if _chaos.enabled() and \
                     _chaos.should_fail_execute(rt.name):
@@ -527,13 +576,22 @@ class GenerationEngine:
                 rep["outcomes"].append((req, "error", err))
             raise err
         rep["ticked"] = True
+        prefill_dur = time.monotonic() - prefill_t0
+        rider_ids = [r.id for r in group]
         for i, req in enumerate(group):
+            _reqtrace.phase(req.id, "prefill", prefill_dur,
+                            bucket="%dx%d" % (bb, tb))
+            _reqtrace.event(req.id, "batch_formed",
+                            bucket="%dx%d" % (bb, tb),
+                            co_riders=[r for r in rider_ids
+                                       if r != req.id])
             tok = int(first[i])
             req._emit(tok)
             rep["tokens"] += 1
             self.tokens_out += 1
             slot = _Slot(req, seqs[i], pos=len(req.prompt),
                          next_token=tok)
+            self._slot_on(seqs[i])
             if len(req.tokens) >= req.max_new:
                 self._retire(rep, slot, "ok")
             else:
@@ -563,6 +621,9 @@ class GenerationEngine:
         self.active = riders
         if not riders:
             return
+        trace_on = _reqtrace.recorder.enabled
+        tick_t0 = time.monotonic() if trace_on else 0.0
+        injected = None
         if _chaos.enabled():
             if _chaos.should_fail_execute(rt.name):
                 raise self._fail_riders(rep, ExecutorFailure(
@@ -573,6 +634,10 @@ class GenerationEngine:
                 raise self._fail_riders(rep, ExecutorFailure(
                     "chaos bad_version injected for %r v%d"
                     % (rt.name, rt.version)))
+            # a seeded tick stall sleeps HERE (inside the measured
+            # tick) and comes back tagged, so the autopsy pins it on
+            # chaos rather than an organically slow decode step
+            injected = _chaos.maybe_stall_decode_tick(rt.name)
         bb = bucket_for(rt.batch_plan, len(riders))
         need = max(s.pos + 1 for s in riders)
         lb = bucket_for(rt.cache_plan, need)
@@ -595,6 +660,16 @@ class GenerationEngine:
                 "decode tick for %r (bucket %dx%d) failed: %r"
                 % (rt.name, bb, lb, e)))
         rep["ticked"] = True
+        if trace_on:
+            tick_dur = time.monotonic() - tick_t0
+            if injected is not None:
+                _reqtrace.tick(rt.name, tick_dur,
+                               [s.req.id for s in riders],
+                               injected=injected)
+            else:
+                for s in riders:
+                    s.decode_s += tick_dur
+                    s.ticks += 1
         keep: List[_Slot] = []
         for i, s in enumerate(riders):
             tok = int(nxt[i])
@@ -616,6 +691,8 @@ class GenerationEngine:
         caller to raise (the breaker's food)."""
         for s in self.active:
             self.kv.free(s.seq_id)
+            self._slot_off(s.seq_id)
+            self._flush_trace(s)
             self._finish(s.req, "error", err)
             rep["outcomes"].append((s.req, "error", err))
         self.active = []

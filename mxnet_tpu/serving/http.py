@@ -41,6 +41,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
+from . import reqtrace as _reqtrace
 from .errors import (Cancelled, DeadlineExceeded, ExecutorFailure,
                      Rejected)
 
@@ -84,12 +85,26 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet: metrics, not stdout
         _log.debug("http: " + fmt, *args)
 
+    def _trace_ctx(self) -> Optional[str]:
+        """Accept an incoming W3C ``traceparent``: its trace-id becomes
+        the request id (so the caller's trace links to the autopsy
+        record), and every reply echoes a traceparent carrying the same
+        trace-id.  No header -> fresh trace-id, request id generated
+        server-side as usual (returns None)."""
+        tid = _reqtrace.parse_traceparent(
+            self.headers.get("traceparent"))
+        self._tp_header, _ = _reqtrace.make_traceparent(tid)
+        return tid
+
     def _reply(self, status: int, payload: dict,
                retry_after: Optional[float] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        tp = getattr(self, "_tp_header", None)
+        if tp:
+            self.send_header("traceparent", tp)
         if retry_after is not None:
             # RFC 7231: delta-seconds is an integer — round UP so a
             # conformant client never retries before capacity frees
@@ -108,7 +123,13 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             from .. import diagnostics as _diag
 
-            body = _diag.metrics.to_prom().encode()
+            text = _diag.metrics.to_prom()
+            ex = _reqtrace.exemplar_prom_lines()
+            if ex:
+                # comment lines pass validate_prom_text untouched and
+                # point each SLO series at a dumpable request id
+                text = text.rstrip("\n") + "\n" + "\n".join(ex) + "\n"
+            body = text.encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4")
@@ -116,11 +137,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
         elif self.path == "/stats":
-            self._reply(200, self._srv.stats())
+            payload = dict(self._srv.stats())
+            payload["reqtrace"] = _reqtrace.stats_summary()
+            self._reply(200, payload)
         else:
             self._reply(404, {"error": "no route %r" % self.path})
 
     def do_POST(self):
+        trace_id = self._trace_ctx()
         model, verb = self._route_model()
         if model is None:
             self._reply(404, {"error": "no route %r" % self.path})
@@ -129,7 +153,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._do_reload(model)
             return
         if verb == "generate":
-            self._do_generate(model)
+            self._do_generate(model, trace_id)
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -144,7 +168,8 @@ class _Handler(BaseHTTPRequestHandler):
         deadline_ms = payload.get("deadline_ms", "default")
         try:
             result = self._srv.predict(model, instances,
-                                       deadline_ms=deadline_ms)
+                                       deadline_ms=deadline_ms,
+                                       request_id=trace_id)
             self._reply(200, {"predictions": _jsonable(result)})
         except Rejected as e:
             self._reply(REASON_STATUS.get(e.reason, 503),
@@ -197,7 +222,8 @@ class _Handler(BaseHTTPRequestHandler):
             _log.exception("http: reload failed")
             self._reply(500, {"error": repr(e)})
 
-    def _do_generate(self, model: str) -> None:
+    def _do_generate(self, model: str,
+                     trace_id: Optional[str] = None) -> None:
         """``POST /v1/models/<name>:generate``.  The streaming path is
         where continuous batching meets the transport: tokens cross
         from the engine thread over a queue and are flushed chunk by
@@ -224,7 +250,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             req = self._srv.submit_generation(
                 model, prompt, max_new=max_new,
-                deadline_ms=deadline_ms,
+                deadline_ms=deadline_ms, request_id=trace_id,
                 on_token=(tokens_q.put if stream else None))
         except Rejected as e:
             self._reply(REASON_STATUS.get(e.reason, 503),
@@ -243,6 +269,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/jsonlines")
         self.send_header("Transfer-Encoding", "chunked")
+        tp = getattr(self, "_tp_header", None)
+        if tp:
+            self.send_header("traceparent", tp)
         self.end_headers()
         idx = 0
         try:
@@ -256,12 +285,15 @@ class _Handler(BaseHTTPRequestHandler):
                 if tok is None:  # engine's end-of-stream marker
                     break
                 self._write_chunk({"token": int(tok), "index": idx})
+                _reqtrace.event(req.id, "stream_flush")
                 idx += 1
             req.wait(0.0 if req.done() else 5.0)
             self._write_chunk({"done": True, "tokens": idx,
                               "prompt_len": len(req.prompt)})
         except (BrokenPipeError, ConnectionResetError, OSError):
             # client went away mid-stream: reclaim the slot + blocks
+            _reqtrace.event(req.id, "client_disconnect",
+                            tokens_flushed=idx)
             req.cancel()
             self._count_cancel()
             return
@@ -275,6 +307,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(b"0\r\n\r\n")  # terminal chunk
             self.wfile.flush()
         except OSError:
+            _reqtrace.event(req.id, "client_disconnect",
+                            tokens_flushed=idx)
             req.cancel()
 
     def _finish_generate_blocking(self, req) -> None:

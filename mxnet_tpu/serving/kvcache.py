@@ -24,6 +24,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+from . import reqtrace as _reqtrace
+
 __all__ = ["CacheExhausted", "PagedKVCache"]
 
 
@@ -87,7 +89,10 @@ class PagedKVCache:
             got = [self._free.pop() for _ in range(need)]
             self._blocks[seq_id] = got
             self._lengths[seq_id] = int(n_tokens)
-            return list(got)
+        # seq_id IS the request id: KV allocations land in the
+        # request's lifecycle trace
+        _reqtrace.event(seq_id, "kv_alloc", blocks=len(got))
+        return list(got)
 
     def extend(self, seq_id: str, new_len: int) -> List[int]:
         """Grow a sequence's coverage to ``new_len`` tokens, claiming
@@ -104,7 +109,10 @@ class PagedKVCache:
                 held.append(self._free.pop())
             self._lengths[seq_id] = max(self._lengths[seq_id],
                                         int(new_len))
-            return list(held)
+            out = list(held)
+        if need > 0:
+            _reqtrace.event(seq_id, "kv_extend", blocks=need)
+        return out
 
     def free(self, seq_id: str, evicted: bool = False) -> int:
         """Return a sequence's blocks to the free list (idempotent);
@@ -118,7 +126,9 @@ class PagedKVCache:
             self._free.extend(held)
             if evicted:
                 self.evictions += 1
-            return len(held)
+        _reqtrace.event(seq_id, "evicted" if evicted else "kv_free",
+                        blocks=len(held))
+        return len(held)
 
     def block_table(self, seq_id: str, width: int):
         """This sequence's block table padded to ``width`` entries with

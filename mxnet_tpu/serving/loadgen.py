@@ -219,7 +219,21 @@ def run_generation_load(server, model: str, *, qps: float,
     ttft_ms.sort()
     tpot_ms.sort()
     span_s = max((last_done or 0) - (first_enq or 0), 1e-9)
-    return {
+    # when the request recorder is live, the load report carries its
+    # own tail autopsy: the window's slowest request with per-phase
+    # attribution, so a failed SLO step points at dumpable evidence
+    # instead of a bare percentile
+    from . import reqtrace as _reqtrace
+
+    trace_block = None
+    if _reqtrace.recorder.enabled:
+        slow = _reqtrace.top_slowest(3)
+        if slow:
+            trace_block = {
+                "p99_attribution": _reqtrace.attribution_shares(slow),
+                "slowest": _reqtrace.attribution(slow[0]),
+            }
+    out = {
         "model": model, "offered_qps": round(qps, 1),
         "duration_s": round(offered_s, 3),
         "offered": n_total, "admitted": len(admitted),
@@ -234,6 +248,9 @@ def run_generation_load(server, model: str, *, qps: float,
         "tpot_p50_ms": round(_pct(tpot_ms, 0.50) or 0.0, 3),
         "tpot_p99_ms": round(_pct(tpot_ms, 0.99) or 0.0, 3),
     }
+    if trace_block is not None:
+        out["reqtrace"] = trace_block
+    return out
 
 
 def gen_tokens_at_slo(server, model: str, *, slo_p99_tpot_ms: float,
@@ -276,6 +293,7 @@ def gen_tokens_at_slo(server, model: str, *, slo_p99_tpot_ms: float,
         "tpot_p99_ms_at_slo": best["tpot_p99_ms"] if best else None,
         "ttft_p50_ms_at_slo": best["ttft_p50_ms"] if best else None,
         "ttft_p99_ms_at_slo": best["ttft_p99_ms"] if best else None,
+        "reqtrace_at_slo": (best or {}).get("reqtrace"),
         "ramp": steps,
     }
 

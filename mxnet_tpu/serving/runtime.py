@@ -212,8 +212,12 @@ class ModelRuntime:
             with self._lock:
                 exe = self._executables[bucket]
         if _chaos.enabled() and _chaos.should_fail_execute(self.name):
-            raise ExecutorFailure(
+            err = ExecutorFailure(
                 "chaos fail_execute injected for model %r" % self.name)
+            # the request recorder tags the failure span injected=true
+            # so a chaos drill never reads as an organic executor fault
+            err.injected = True
+            raise err
         try:
             out = exe(self._params, self._aux,
                       self._to_device(batch, bucket))

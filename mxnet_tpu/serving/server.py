@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import reqtrace as _reqtrace
 from .batching import Request, RequestQueue
 from .errors import DeadlineExceeded, ExecutorFailure, Rejected
 
@@ -57,6 +58,13 @@ class CircuitBreaker:
         self._opened_ts: Optional[float] = None
         self._probing = False
         self._probe_ts = 0.0
+        # last explicit state transition — /stats surfaces its age so
+        # "open" vs "open for the last 40 minutes" are distinguishable
+        self._state_ts = time.monotonic()
+
+    def state_age_s(self) -> float:
+        with self._lock:
+            return max(time.monotonic() - self._state_ts, 0.0)
 
     def state(self) -> str:
         with self._lock:
@@ -88,6 +96,7 @@ class CircuitBreaker:
             if now - self._opened_ts >= self.reset_s:
                 self._probing = True
                 self._probe_ts = now
+                self._state_ts = now
                 return True
             return False
 
@@ -98,6 +107,7 @@ class CircuitBreaker:
         timeout."""
         with self._lock:
             self._probing = False
+            self._state_ts = time.monotonic()
 
     def retry_after_s(self) -> Optional[float]:
         with self._lock:
@@ -109,6 +119,8 @@ class CircuitBreaker:
     def on_success(self) -> None:
         with self._lock:
             self._consecutive = 0
+            if self._opened_ts is not None or self._probing:
+                self._state_ts = time.monotonic()
             self._opened_ts = None
             self._probing = False
 
@@ -122,6 +134,7 @@ class CircuitBreaker:
                                  and self._opened_ts is None):
                 # closed -> open, or a failed half-open probe re-opening
                 self._opened_ts = time.monotonic()
+                self._state_ts = self._opened_ts
                 self._probing = False
                 return True
             return False
@@ -281,12 +294,14 @@ class ModelServer:
         sm = self._get(model)
         if self._draining:
             self._count_rejected("draining")
+            _reqtrace.reject(request_id, model, "draining")
             raise Rejected("draining", "server is draining")
         arr = np.asarray(data)
         if arr.shape == tuple(sm.runtime.sample_shape):
             arr = arr[None]  # single sample convenience
         if arr.shape[1:] != tuple(sm.runtime.sample_shape):
             self._count_rejected("bad_input")
+            _reqtrace.reject(request_id, model, "bad_input")
             raise Rejected("bad_input",
                            "expected sample shape %s, got %s"
                            % (sm.runtime.sample_shape, arr.shape[1:]))
@@ -294,10 +309,12 @@ class ModelServer:
         max_n = min(self.max_batch, sm.runtime.max_batch)
         if n > max_n:
             self._count_rejected("too_large")
+            _reqtrace.reject(request_id, model, "too_large")
             raise Rejected("too_large",
                            "%d samples > max batch %d" % (n, max_n))
         if not sm.breaker.admit():
             self._count_rejected("breaker_open")
+            _reqtrace.reject(request_id, model, "breaker_open")
             raise Rejected(
                 "breaker_open",
                 "model %r breaker is open after consecutive executor "
@@ -320,10 +337,12 @@ class ModelServer:
         return req
 
     def predict(self, model: str, data, *, deadline_ms: Any = "default",
-                timeout_s: Optional[float] = None):
+                timeout_s: Optional[float] = None,
+                request_id: Optional[str] = None):
         """submit + wait.  The default wait bound is the request's own
         deadline plus one batch-latency of slack."""
-        req = self.submit(model, data, deadline_ms=deadline_ms)
+        req = self.submit(model, data, deadline_ms=deadline_ms,
+                          request_id=request_id)
         if timeout_s is None:
             sm = self._get(model)
             slack = max(sm.ewma_batch_s * 4, 1.0)
@@ -354,19 +373,23 @@ class ModelServer:
         rt = sm.runtime
         if not getattr(sm, "is_generator", False):
             self._count_rejected("bad_input")
+            _reqtrace.reject(request_id, model, "bad_input")
             raise Rejected("bad_input",
                            "model %r is a predictor, not a generator"
                            % model)
         if self._draining:
             self._count_rejected("draining")
+            _reqtrace.reject(request_id, model, "draining")
             raise Rejected("draining", "server is draining")
         arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if arr.size < 1:
             self._count_rejected("bad_input")
+            _reqtrace.reject(request_id, model, "bad_input")
             raise Rejected("bad_input", "empty prompt")
         mn = rt.max_new if max_new is None else max(int(max_new), 1)
         if arr.size > rt.max_prompt:
             self._count_rejected("too_large")
+            _reqtrace.reject(request_id, model, "too_large")
             raise Rejected("too_large",
                            "prompt of %d tokens > max prompt %d"
                            % (arr.size, rt.max_prompt))
@@ -374,6 +397,7 @@ class ModelServer:
         if arr.size + mn > rt.max_context or \
                 need_blocks > rt.kv.num_blocks - 1:
             self._count_rejected("too_large")
+            _reqtrace.reject(request_id, model, "too_large")
             raise Rejected(
                 "too_large",
                 "%d prompt + %d new tokens exceeds max context %d "
@@ -381,6 +405,7 @@ class ModelServer:
                 % (arr.size, mn, rt.max_context, rt.kv.num_blocks - 1))
         if not sm.breaker.admit():
             self._count_rejected("breaker_open")
+            _reqtrace.reject(request_id, model, "breaker_open")
             raise Rejected(
                 "breaker_open",
                 "model %r breaker is open after consecutive executor "
@@ -448,6 +473,7 @@ class ModelServer:
             live = []
             for r in batch:
                 if r.expired(now):
+                    _reqtrace.phase(r.id, "queue", now - r.enqueue_ts)
                     r.set_error(DeadlineExceeded(
                         "request %s: deadline expired at dispatch"
                         % r.id))
@@ -460,8 +486,15 @@ class ModelServer:
             if _chaos.enabled():
                 # chaos 'slow_request': the seeded slow executor the
                 # overload test bounds — injected at the dispatch point
-                # so queue-depth/deadline behavior is what's exercised
-                _chaos.maybe_slow_request(sm.runtime.name)
+                # so queue-depth/deadline behavior is what's exercised;
+                # the tagged phase keeps the seeded stall from reading
+                # as an organically slow executor in the autopsy
+                inj = _chaos.maybe_slow_request(sm.runtime.name)
+                if inj is not None:
+                    for r in live:
+                        _reqtrace.phase(
+                            r.id, "stall:injected:%s" % inj["kind"],
+                            float(inj["ms"]) / 1e3, injected=True)
             self._dispatch(sm, live)
 
     # -- generation worker: the continuous-batching engine loop --------
@@ -517,6 +550,8 @@ class ModelServer:
                     if int(seq * pct) // 100 > \
                             int((seq - 1) * pct) // 100:
                         eng = canary.engine
+                        _reqtrace.event(req.id, "canary_route",
+                                        version=canary.version)
                 eng.enqueue(req)
             # tick every engine
             worked = bool(polled)
@@ -633,6 +668,20 @@ class ModelServer:
         self._gauge_inflight(sm)
         rt, is_canary = self._route(sm)
         t0 = time.monotonic()
+        rider_ids = [r.id for r in live]
+        try:
+            bucket = rt.bucket_for(total)
+        except Exception:
+            bucket = None
+        for r in live:
+            _reqtrace.phase(r.id, "queue", t0 - r.enqueue_ts)
+            _reqtrace.event(r.id, "batch_formed", samples=total,
+                            bucket=bucket,
+                            co_riders=[i for i in rider_ids
+                                       if i != r.id])
+            if is_canary:
+                _reqtrace.event(r.id, "canary_route",
+                                version=rt.version)
         try:
             data = live[0].data if len(live) == 1 else \
                 np.concatenate([r.data for r in live], axis=0)
@@ -663,6 +712,9 @@ class ModelServer:
                 if sm.canary is not None:
                     self._record_version_result(sm, rt.version, ok=True)
             batch_s = time.monotonic() - t0
+            for r in live:
+                # before set_result: finish() pops the open record
+                _reqtrace.phase(r.id, "execute", batch_s)
             self._split_results(live, out, rt.version)
             sm.ewma_batch_s = 0.8 * sm.ewma_batch_s + 0.2 * batch_s
             if not is_canary:
@@ -677,7 +729,14 @@ class ModelServer:
             err = e if isinstance(e, ExecutorFailure) else \
                 ExecutorFailure("dispatch for %r failed: %r"
                                 % (name, e))
+            err_s = time.monotonic() - t0
+            # a chaos-injected executor fault attributes as an injected
+            # stall, not organic execute time (runtime.execute tags it)
+            err_phase = ("stall:injected:fail_execute"
+                         if getattr(err, "injected", False) else
+                         "execute")
             for r in live:
+                _reqtrace.phase(r.id, err_phase, err_s)
                 r.set_error(err)
                 self._count_outcome(name, "error", rt.version)
             with sm._lock:
@@ -1020,6 +1079,8 @@ class ModelServer:
                 "completed": sm.completed,
                 "failed": sm.failed,
                 "breaker": sm.breaker.state(),
+                "breaker_age_s": round(sm.breaker.state_age_s(), 3),
+                "retry_after_hint_s": self._retry_after(sm),
                 "ewma_batch_ms": round(sm.ewma_batch_s * 1e3, 3),
                 "buckets": list(getattr(sm.runtime, "plan", ())),
                 "compiled": sm.runtime.compiled,
@@ -1028,6 +1089,8 @@ class ModelServer:
                 "canary_version": canary.version
                 if canary is not None else None,
                 "reload": dict(sm.reload_state),
+                "reload_phase": "canary" if canary is not None
+                else sm.reload_state.get("state", "idle"),
             }
             if getattr(sm, "is_generator", False):
                 out[name]["kv"] = sm.runtime.kv.stats()

@@ -19,7 +19,8 @@ import sys
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 _ARTIFACT_PATTERNS = ("flightrecorder_rank*", "profile_rank*",
-                      "profile_merged*", "profile.json", "metrics*.prom")
+                      "profile_merged*", "profile.json", "metrics*.prom",
+                      "reqtrace_rank*")
 
 
 def _child_env(extra=None, drop_dump_dir=False):

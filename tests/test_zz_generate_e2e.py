@@ -13,6 +13,9 @@ test_transformer.py so its XLA compile cost lands at the tail of a
 time-boxed tier-1 run — the cheap no-compile generation units live in
 tests/test_generate.py."""
 import json
+import socket
+import struct
+import time
 import urllib.error
 import urllib.request
 
@@ -22,6 +25,7 @@ import pytest
 from mxnet_tpu import chaos
 from mxnet_tpu import diagnostics as diag
 from mxnet_tpu import serving
+from mxnet_tpu.serving import reqtrace
 from mxnet_tpu.transformer import model as tm
 
 
@@ -167,6 +171,7 @@ def test_cancel_storm_zero_leaked_blocks(grt, monkeypatch):
     monkeypatch.setenv("MXNET_CHAOS",
                        "cancel_request:model=gen_t,nth=3,count=4")
     chaos.reset()
+    reqtrace.reset(capacity=32, topk=4)
     try:
         reqs = [serving.GenRequest("gen_t", [i + 1, i + 7, i + 3], 16)
                 for i in range(6)]
@@ -177,6 +182,8 @@ def test_cancel_storm_zero_leaked_blocks(grt, monkeypatch):
     finally:
         monkeypatch.delenv("MXNET_CHAOS")
         chaos.reset()
+        snap = reqtrace.snapshot()
+        reqtrace.reset()
     cancelled = ok = 0
     for r in reqs:
         try:
@@ -188,12 +195,63 @@ def test_cancel_storm_zero_leaked_blocks(grt, monkeypatch):
     assert cancelled == 4 and ok == 2
     assert grt.kv.stats()["blocks_live"] == 0
     assert grt.kv.stats()["blocks_free"] == grt.kv.num_blocks - 1
+    # ...and the storm leaves the request-trace ring CONSISTENT: every
+    # record reached a terminal span (no orphan open records), with the
+    # same 4-cancelled/2-ok split the futures report
+    assert not snap["open"], [r["id"] for r in snap["open"]]
+    outcomes = [r["outcome"] for r in snap["recent"]]
+    assert outcomes.count("cancelled") == 4
+    assert outcomes.count("ok") == 2
+
+
+def test_reqtrace_deadline_expiry_dies_waiting(grt, monkeypatch,
+                                               tmp_path):
+    # two blockers occupy both slots; the doomed request's 5 ms
+    # deadline expires in the waiting line.  Its terminal reqtrace
+    # span must say expired-while-WAITING (queue residency only, no
+    # execute phase), and the blown deadline must auto-dump the
+    # autopsy file
+    monkeypatch.setenv("MXNET_DUMP_DIR", str(tmp_path))
+    reqtrace.reset(capacity=32, topk=4)
+    try:
+        blockers = [serving.GenRequest("gen_t", [1, 2, 3], 12)
+                    for _ in range(2)]
+        for r in blockers:
+            grt.engine.enqueue(r)
+        grt.engine.step()  # both slots now occupied
+        doomed = serving.GenRequest("gen_t", [4, 5], 12,
+                                    deadline_s=0.001)
+        grt.engine.enqueue(doomed)
+        time.sleep(0.01)  # the deadline lapses in the waiting line
+        while not grt.engine.idle():
+            grt.engine.step()
+        with pytest.raises(serving.DeadlineExceeded):
+            doomed.wait(0.1)
+        for r in blockers:
+            assert len(r.wait(0.1)["tokens"]) == 12
+        snap = reqtrace.snapshot()
+        assert not snap["open"]
+        rec = next(r for r in snap["recent"] if r["id"] == doomed.id)
+        assert rec["outcome"] == "expired"
+        assert "queue" in rec["phases"]
+        assert not any(k in rec["phases"]
+                       for k in ("prefill", "decode", "execute"))
+        assert reqtrace.recorder.model_summary()["gen_t"][
+            "died_waiting"] >= 1
+        dumps = sorted(tmp_path.glob("reqtrace_rank*.json"))
+        assert dumps, "a blown deadline must auto-dump the autopsy"
+        payload = json.loads(dumps[0].read_text())
+        assert payload["header"]["reason"] == "deadline"
+        assert payload["header"]["format"] == reqtrace.REQTRACE_FORMAT
+    finally:
+        reqtrace.reset()
+    assert grt.kv.stats()["blocks_live"] == 0
 
 
 # ---------------------------------------------------------------------
 # streaming HTTP e2e: chunked :generate, per-token lines, cancel=499
 # ---------------------------------------------------------------------
-def test_http_generate_streaming_e2e():
+def test_http_generate_streaming_e2e(monkeypatch):
     rt = serving.demo_generation_runtime(
         "gen_http", n_layers=1, slots=1, block_tokens=16,
         max_prompt=16, max_context=32, max_new=8, prefill_batch=1)
@@ -235,6 +293,46 @@ def test_http_generate_streaming_e2e():
                 data=json.dumps({"prompt": list(range(99))}).encode()))
         assert ei.value.code == 413
         assert json.loads(ei.value.read())["reason"] == "too_large"
+        # streaming client-disconnect (the 499 convention): kill the
+        # socket mid-stream under an injected decode stall (so the
+        # engine is still generating when the RST lands); the terminal
+        # reqtrace span must say cancelled — never ok — with the
+        # disconnect event recorded and the stall spans tagged injected
+        monkeypatch.setenv(
+            "MXNET_CHAOS",
+            "stall_decode_tick:model=gen_http,ms=30,count=999")
+        chaos.reset()
+        reqtrace.reset(capacity=32, topk=4)
+        try:
+            body = json.dumps({"prompt": [1, 2, 3], "max_new": 8,
+                               "stream": True})
+            sk = socket.create_connection((host, port), timeout=10)
+            sk.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                          struct.pack("ii", 1, 0))  # close sends RST
+            sk.sendall(("POST /v1/models/gen_http:generate HTTP/1.1\r\n"
+                        "Host: t\r\nContent-Type: application/json\r\n"
+                        "Content-Length: %d\r\n\r\n%s"
+                        % (len(body), body)).encode())
+            assert sk.recv(1)  # response started: the stream is live
+            sk.close()
+            rec, deadline = None, time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                snap = reqtrace.snapshot()
+                done = [r for r in snap["recent"]
+                        if r["model"] == "gen_http"]
+                if done:
+                    rec = done[0]
+                    break
+                time.sleep(0.05)
+            assert rec is not None, "disconnected request never closed"
+            assert rec["outcome"] == "cancelled"
+            assert "client_disconnect" in rec["events"]
+            assert rec["injected_any"]  # chaos stall never reads organic
+            assert not snap["open"]
+        finally:
+            monkeypatch.delenv("MXNET_CHAOS")
+            chaos.reset()
+            reqtrace.reset()
     finally:
         fe.stop()
         srv.drain(timeout_s=10.0)

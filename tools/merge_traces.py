@@ -30,9 +30,18 @@ desync/dead-peer verdict judges the NEWEST incarnation.  Exit code 2
 when a desync, dead peer, plan mismatch or exhausted restart budget
 was detected.
 
+Serving request-trace dumps (``reqtrace_rank{K}.json`` —
+mxnet_tpu/serving/reqtrace.py) join the same glob: ``--health`` adds a
+SERVING section (per-model queue-wait p99, slot utilization, died
+waiting vs died executing, and a stall scan over each dump's slowest
+requests — chaos-injected stalls are labeled, never failed on), and
+the plain merge mode lifts each dump's continuous-batching slot
+timeline into its own process lane next to the training ranks.
+
 Usage:
     tools/merge_traces.py profile_rank0.json profile_rank1.json -o merged.json
     tools/merge_traces.py --health flightrecorder_rank*.json profile_rank*.json
+    tools/merge_traces.py --health reqtrace_rank*.json
     tools/merge_traces.py --self-test
 """
 from __future__ import annotations
@@ -44,6 +53,10 @@ import re
 import sys
 
 _RANK_RE = re.compile(r"rank(\d+)")
+
+#: serving slot-timeline lanes merge at pid 1000+rank so they never
+#: collide with a training rank's pid in the same merged view
+SERVING_PID_BASE = 1000
 
 
 def rank_of(path: str, payload: dict, fallback: int) -> int:
@@ -59,10 +72,31 @@ def rank_of(path: str, payload: dict, fallback: int) -> int:
 
 
 def merge(payloads):
-    """[(path, payload)] -> one chrome-trace dict with per-rank pids."""
+    """[(path, payload)] -> one chrome-trace dict with per-rank pids.
+
+    Serving reqtrace dumps contribute their continuous-batching slot
+    timeline as a ``serving rank K`` process lane (pid 1000+K) so slot
+    churn renders next to the training ranks' lanes."""
     merged = []
     seen_ranks = set()
     for idx, (path, payload) in enumerate(payloads):
+        if is_reqtrace_payload(payload):
+            rank = int(payload["header"].get("rank", idx) or 0)
+            pid = SERVING_PID_BASE + rank
+            if pid in seen_ranks:
+                raise ValueError("duplicate serving reqtrace rank %d "
+                                 "(file %s)" % (rank, path))
+            seen_ranks.add(pid)
+            merged.append({"name": "process_name", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": "serving rank %d" % rank}})
+            timeline = payload.get("slot_timeline") or {}
+            for ev in timeline.get("traceEvents", []):
+                if ev.get("ph") == "M" and \
+                        ev.get("name") == "process_name":
+                    continue
+                merged.append(dict(ev, pid=pid))
+            continue
         rank = rank_of(path, payload, idx)
         if rank in seen_ranks:
             raise ValueError("duplicate rank %d (file %s)" % (rank, path))
@@ -113,22 +147,38 @@ def is_traceview_payload(payload: dict) -> bool:
                 == "mxnet-tpu-traceview-summary")
 
 
+def is_reqtrace_payload(payload: dict) -> bool:
+    """A serving request-trace dump (``reqtrace_rank{K}.json`` —
+    mxnet_tpu/serving/reqtrace.py)."""
+    return bool(isinstance(payload, dict)
+                and payload.get("header", {}).get("format")
+                == "mxnet-tpu-reqtrace")
+
+
 def load_health_inputs_ex(paths):
     """Split input files into ``(flight_by_gen, traces, supervisor,
-    traceviews)``: ``flight_by_gen`` maps generation → {rank:
-    flight_payload} (an elastic supervisor restarts the fleet with a
-    bumped MXNET_ELASTIC_GENERATION, so the SAME rank dumps once per
-    incarnation — duplicates are only an error within one generation),
-    ``traces`` maps rank → trace payload, ``supervisor`` is the
-    supervisor's events journal (or None), ``traceviews`` maps rank →
-    traceview device-timeline summary."""
-    flight_by_gen, traces, traceviews = {}, {}, {}
+    traceviews, reqtraces)``: ``flight_by_gen`` maps generation →
+    {rank: flight_payload} (an elastic supervisor restarts the fleet
+    with a bumped MXNET_ELASTIC_GENERATION, so the SAME rank dumps
+    once per incarnation — duplicates are only an error within one
+    generation), ``traces`` maps rank → trace payload, ``supervisor``
+    is the supervisor's events journal (or None), ``traceviews`` maps
+    rank → traceview device-timeline summary, ``reqtraces`` maps rank
+    → serving request-trace dump."""
+    flight_by_gen, traces, traceviews, reqtraces = {}, {}, {}, {}
     supervisor = None
     for idx, p in enumerate(paths):
         with open(p) as f:
             payload = json.load(f)
         if is_supervisor_payload(payload):
             supervisor = payload
+        elif is_reqtrace_payload(payload):
+            rank = int(payload["header"].get(
+                "rank", rank_of(p, {}, idx)) or 0)
+            if rank in reqtraces:
+                raise ValueError("duplicate serving reqtrace rank %d "
+                                 "(%s)" % (rank, p))
+            reqtraces[rank] = payload
         elif is_flight_payload(payload):
             rank = int(payload["header"].get(
                 "rank", rank_of(p, {}, idx)))
@@ -150,14 +200,14 @@ def load_health_inputs_ex(paths):
             if rank in traces:
                 raise ValueError("duplicate trace rank %d (%s)" % (rank, p))
             traces[rank] = payload
-    return flight_by_gen, traces, supervisor, traceviews
+    return flight_by_gen, traces, supervisor, traceviews, reqtraces
 
 
 def load_health_inputs(paths):
     """Compatibility surface: ({rank: flight_payload} for the NEWEST
     generation, {rank: trace_payload}).  Single-generation inputs (no
     supervisor in play) behave exactly as before."""
-    flight_by_gen, traces, _sup, _tv = load_health_inputs_ex(paths)
+    flight_by_gen, traces, _sup, _tv, _rq = load_health_inputs_ex(paths)
     newest = max(flight_by_gen) if flight_by_gen else None
     return (flight_by_gen.get(newest, {}) if newest is not None
             else {}), traces
@@ -371,6 +421,61 @@ def analyze_phase_skew(traceviews, slow_factor: float = 1.5):
             "detected": any(not f["injected"] for f in findings)}
 
 
+def analyze_serving(reqtraces, stall_share: float = 0.5):
+    """Serving-tier health over request-trace dumps: per-model
+    queue-wait p99 / slot utilization / died-waiting-vs-executing
+    aggregates, plus a stall scan over each dump's slowest requests —
+    a request whose dominant phase is a ``stall:*`` phase above
+    ``stall_share`` of its wall time is a finding.  Chaos-injected
+    stalls (``stall:injected:*`` phases, spans tagged
+    ``injected=true`` by the chaos hooks) are reported loudly but
+    never flip the health verdict — a seeded ``stall_decode_tick`` is
+    the fault-injection campaign working, not a capacity problem."""
+    if not reqtraces:
+        return None
+    models = {}
+    for _rank, payload in sorted(reqtraces.items()):
+        for model, m in (payload.get("models") or {}).items():
+            agg = models.setdefault(model, {
+                "completed": 0, "rejected": 0, "cancelled": 0,
+                "died_waiting": 0, "died_executing": 0,
+                "queue_wait_p99_ms": None, "slot_utilization": None,
+                "slots": None})
+            for k in ("completed", "rejected", "cancelled",
+                      "died_waiting", "died_executing"):
+                agg[k] += int(m.get(k) or 0)
+            # multi-rank worst-case view: the hottest rank's p99 and
+            # utilization are the ones the SLO sees
+            for k in ("queue_wait_p99_ms", "slot_utilization",
+                      "slots"):
+                v = m.get(k)
+                if v is not None:
+                    agg[k] = v if agg[k] is None else max(agg[k], v)
+    findings = []
+    for rank, payload in sorted(reqtraces.items()):
+        for rec in payload.get("slowest") or []:
+            phases = rec.get("phases") or {}
+            if not phases:
+                continue
+            name = max(phases, key=lambda k: phases[k])
+            total = float(rec.get("total_s") or 0.0) or \
+                sum(phases.values()) or 1.0
+            share = phases[name] / total
+            if not name.startswith("stall:") or share < stall_share:
+                continue
+            findings.append({
+                "rank": rank, "request_id": rec.get("id"),
+                "model": rec.get("model"), "phase": name,
+                "share": round(share, 3),
+                "total_ms": round(total * 1e3, 3),
+                "injected": bool(name.startswith("stall:injected")),
+                "attribution": rec.get("attribution"),
+            })
+    return {"n_dumps": len(reqtraces), "models": models,
+            "findings": findings,
+            "detected": any(not f["injected"] for f in findings)}
+
+
 def _merge_intervals(intervals):
     """Sorted union of (start, end) spans as a list of [start, end]."""
     merged = []
@@ -561,7 +666,7 @@ def analyze_generations(flight_by_gen, supervisor):
 
 
 def health_report(flight, traces, flight_by_gen=None, supervisor=None,
-                  traceviews=None):
+                  traceviews=None, reqtraces=None):
     report = {"n_flight_dumps": len(flight), "n_trace_dumps": len(traces),
               "desync": analyze_desync(flight)}
     if flight:
@@ -580,6 +685,9 @@ def health_report(flight, traces, flight_by_gen=None, supervisor=None,
     skew = analyze_phase_skew(traceviews or {})
     if skew is not None:
         report["phase_skew"] = skew
+    serving = analyze_serving(reqtraces or {})
+    if serving is not None:
+        report["serving"] = serving
     return report
 
 
@@ -710,19 +818,55 @@ def format_health(report):
                    if f["injected"] else ""))
         if not skew["findings"]:
             lines.append("no cross-rank phase skew")
+    sv = report.get("serving")
+    if sv:
+        lines.append("serving request traces: %d dump(s)"
+                     % sv["n_dumps"])
+        for model, m in sorted(sv["models"].items()):
+            bits = ["%d completed" % m["completed"]]
+            if m["rejected"]:
+                bits.append("%d rejected" % m["rejected"])
+            if m["cancelled"]:
+                bits.append("%d cancelled" % m["cancelled"])
+            if m["queue_wait_p99_ms"] is not None:
+                bits.append("queue-wait p99 %.1f ms"
+                            % m["queue_wait_p99_ms"])
+            if m["slot_utilization"] is not None:
+                bits.append("slot utilization %.0f%%%s"
+                            % (100.0 * m["slot_utilization"],
+                               "" if not m["slots"]
+                               else " of %d slot(s)" % m["slots"]))
+            if m["died_waiting"] or m["died_executing"]:
+                bits.append("died waiting %d / executing %d"
+                            % (m["died_waiting"],
+                               m["died_executing"]))
+            lines.append("  model %s: %s" % (model, ", ".join(bits)))
+        for f in sv["findings"]:
+            head = "INJECTED STALL (chaos)" if f["injected"] \
+                else "SERVING STALL"
+            lines.append(
+                "%s: request %s (model %s) spent %.0f%% of %.1f ms "
+                "in %s%s"
+                % (head, f["request_id"], f["model"],
+                   100.0 * f["share"], f["total_ms"], f["phase"],
+                   " — chaos-injected, not a capacity problem"
+                   if f["injected"] else ""))
+            if f.get("attribution"):
+                lines.append("  %s" % f["attribution"])
     return lines
 
 
 def run_health(paths, out_path=None) -> int:
     (flight_by_gen, traces, supervisor,
-     traceviews) = load_health_inputs_ex(paths)
+     traceviews, reqtraces) = load_health_inputs_ex(paths)
     # desync/dead-peer/plan analysis judges the NEWEST incarnation —
     # cross-generation seq comparison is meaningless by construction
     newest = max(flight_by_gen) if flight_by_gen else None
     flight = flight_by_gen.get(newest, {}) if newest is not None else {}
     report = health_report(flight, traces, flight_by_gen=flight_by_gen,
                            supervisor=supervisor,
-                           traceviews=traceviews)
+                           traceviews=traceviews,
+                           reqtraces=reqtraces)
     for line in format_health(report):
         print(line)
     if out_path:
@@ -747,7 +891,8 @@ def run_health(paths, out_path=None) -> int:
         report.get("bucket_plans", {}).get("mismatch") or \
         report.get("dead_peers", {}).get("detected") or \
         report.get("elastic", {}).get("budget_exhausted") or \
-        report.get("phase_skew", {}).get("detected")
+        report.get("phase_skew", {}).get("detected") or \
+        report.get("serving", {}).get("detected")
     return 2 if unhealthy else 0
 
 
@@ -953,7 +1098,7 @@ def self_test() -> int:
         sup_path = os.path.join(gen_dir, "supervisor_events.json")
         with open(sup_path, "w") as f:
             json.dump(sup_events, f)
-        fbg, tr, sup, _tv = load_health_inputs_ex(
+        fbg, tr, sup, _tv, _rq = load_health_inputs_ex(
             [g0a, g0b, g1a, sup_path])
         assert set(fbg) == {0, 1} and set(fbg[0]) == {0, 1} \
             and set(fbg[1]) == {0}, fbg
@@ -1012,7 +1157,7 @@ def self_test() -> int:
                 json.dump(tv_summary(rank, slow=2.1 if rank == 2
                                      else 1.0), f)
             tv_paths.append(p)
-        _fbg, _tr2, _sup2, tvs = load_health_inputs_ex(tv_paths)
+        _fbg, _tr2, _sup2, tvs, _rq = load_health_inputs_ex(tv_paths)
         assert set(tvs) == {0, 1, 2}, tvs
         skew = analyze_phase_skew(tvs)
         assert skew["detected"], skew
@@ -1030,7 +1175,7 @@ def self_test() -> int:
         # INJECTED STALL, health verdict stays green
         with open(tv_paths[2], "w") as f:
             json.dump(tv_summary(2, slow=2.1, injected=4), f)
-        _fbg, _tr2, _sup2, tvs = load_health_inputs_ex(tv_paths)
+        _fbg, _tr2, _sup2, tvs, _rq = load_health_inputs_ex(tv_paths)
         skew = analyze_phase_skew(tvs)
         assert not skew["detected"] and skew["findings"], skew
         assert skew["injected_ranks"] == [2], skew
@@ -1058,6 +1203,99 @@ def self_test() -> int:
         text2 = "\n".join(format_health(report2))
         assert "INJECTED STALL (chaos): rank 1 never completed seq 12" \
             in text2, text2
+
+        # --health over a serving request-trace dump: the SERVING
+        # section names the model's queue-wait p99 / slot utilization /
+        # died-waiting split and flags the slowest request's dominant
+        # stall — injected stalls labeled, never failed on
+        def reqtrace_dump(injected):
+            stall = ("stall:injected:stall_decode_tick" if injected
+                     else "stall:cache_exhausted")
+            slow_rec = {
+                "id": "req-slow", "model": "gen", "kind": "generate",
+                "outcome": "ok", "total_s": 0.5, "done_mono": 12.0,
+                "phases": {"queue": 0.01, "prefill": 0.04,
+                           stall: 0.4, "decode": 0.05},
+                "events": {"decode_ticks": 12},
+                "injected_any": bool(injected),
+                "attribution": "request req-slow [ok, 500.0ms "
+                               "total]: 400.0ms %s (80%%)" % stall}
+            return {
+                "header": {"format": "mxnet-tpu-reqtrace", "rank": 0,
+                           "num_workers": 1, "capacity": 256,
+                           "topk": 8, "window_s": 60.0, "begun": 14,
+                           "finished": 14, "spans_dropped": 0},
+                "slowest": [slow_rec], "recent": [slow_rec],
+                "open": [],
+                "models": {"gen": {
+                    "completed": 9, "rejected": 1, "cancelled": 1,
+                    "died_waiting": 2, "died_executing": 1,
+                    "queue_wait_p99_ms": 3.2,
+                    "slot_utilization": 0.74, "slots": 4}},
+                "exemplars": {"gen": {"latency_s": {
+                    "request_id": "req-slow", "value": 0.5,
+                    "age_s": 1.0}}},
+                "slot_timeline": {"traceEvents": [
+                    {"ph": "M", "name": "process_name", "pid": 0,
+                     "tid": 0, "args": {"name": "serving"}},
+                    {"ph": "X", "pid": 0, "tid": 1, "name": "seq:g1",
+                     "cat": "serving_slot", "ts": 0.0, "dur": 1000.0,
+                     "args": {"model": "gen", "slot": 0}},
+                    {"ph": "M", "name": "thread_name", "pid": 0,
+                     "tid": 1, "args": {"name": "gen/slot0"}}]},
+            }
+
+        rq_path = os.path.join(d, "reqtrace_rank0.json")
+        with open(rq_path, "w") as f:
+            json.dump(reqtrace_dump(injected=False), f)
+        _fbg, _tr3, _sup3, _tv3, rq = load_health_inputs_ex([rq_path])
+        assert set(rq) == {0}, rq
+        sv_report = health_report({}, {}, reqtraces=rq)
+        sv = sv_report["serving"]
+        assert sv["n_dumps"] == 1 and sv["detected"], sv
+        gen = sv["models"]["gen"]
+        assert gen["completed"] == 9 and gen["died_waiting"] == 2 \
+            and gen["died_executing"] == 1, gen
+        assert gen["queue_wait_p99_ms"] == 3.2
+        assert gen["slot_utilization"] == 0.74 and gen["slots"] == 4
+        (sf,) = sv["findings"]
+        assert sf["phase"] == "stall:cache_exhausted" and \
+            not sf["injected"] and sf["share"] == 0.8, sf
+        sv_text = "\n".join(format_health(sv_report))
+        assert "queue-wait p99 3.2 ms" in sv_text, sv_text
+        assert "slot utilization 74% of 4 slot(s)" in sv_text, sv_text
+        assert "died waiting 2 / executing 1" in sv_text, sv_text
+        assert "SERVING STALL: request req-slow (model gen) spent " \
+            "80% of 500.0 ms in stall:cache_exhausted" in sv_text, \
+            sv_text
+        rc = run_health([rq_path])
+        assert rc == 2, rc  # an organic dominant stall fails health
+        # the SAME stall chaos-injected: labeled, verdict stays green
+        with open(rq_path, "w") as f:
+            json.dump(reqtrace_dump(injected=True), f)
+        _fbg, _tr3, _sup3, _tv3, rq = load_health_inputs_ex([rq_path])
+        sv = analyze_serving(rq)
+        assert sv["findings"] and not sv["detected"], sv
+        sv_text = "\n".join(format_health(
+            health_report({}, {}, reqtraces=rq)))
+        assert "INJECTED STALL (chaos): request req-slow" in sv_text \
+            and "not a capacity problem" in sv_text, sv_text
+        rc = run_health([rq_path])
+        assert rc == 0, rc
+        # plain merge lifts the dump's slot timeline into a serving
+        # lane (pid 1000+rank) next to the training ranks
+        merged2 = merge_files([paths[0], rq_path],
+                              os.path.join(d, "merged2.json"))
+        pids = sorted({e["pid"] for e in merged2["traceEvents"]})
+        assert pids == [0, SERVING_PID_BASE], pids
+        sv_events = [e for e in merged2["traceEvents"]
+                     if e["pid"] == SERVING_PID_BASE]
+        assert any(e.get("name") == "seq:g1" and e.get("ph") == "X"
+                   for e in sv_events), sv_events
+        labels = [e["args"]["name"] for e in sv_events
+                  if e.get("ph") == "M"
+                  and e["name"] == "process_name"]
+        assert labels == ["serving rank 0"], labels
     print("merge_traces self-test OK")
     return 0
 
@@ -1067,7 +1305,9 @@ def main(argv=None) -> int:
     ap.add_argument("inputs", nargs="*",
                     help="per-rank trace JSON files (profile_rank{K}.json) "
                          "and/or flight-recorder dumps "
-                         "(flightrecorder_rank{K}.json, --health mode)")
+                         "(flightrecorder_rank{K}.json, --health mode) "
+                         "and/or serving request-trace dumps "
+                         "(reqtrace_rank{K}.json)")
     ap.add_argument("-o", "--output", default=None,
                     help="merged trace path (default: profile_merged.json)"
                          " / health-report JSON path (--health)")

@@ -69,6 +69,18 @@ the fact (recompile storms, config typos, hot-loop host syncs):
                                the armed capture, and ad-hoc
                                annotations bypass the step-window
                                naming the attribution walker keys on.
+  MXL010 wallclock-in-serving  ``time.time()`` (or ``datetime.now``)
+                               inside ``mxnet_tpu/serving/``: every
+                               serving deadline, duration, and
+                               reqtrace span is monotonic-clock by
+                               contract — one wall-clock read mixed in
+                               makes a deadline jump on NTP slew and
+                               an autopsy attribute negative time.
+                               ``time.monotonic()`` (or
+                               ``perf_counter``) is required;
+                               wall-clock is allowed only for dump/
+                               artifact timestamps via an inline
+                               ``# mxlint: disable=MXL010``.
 
 Pure-AST: imports NOTHING from the package (the env registry is read
 by parsing mxnet_tpu/env.py's ``register(...)`` calls), so it lints a
@@ -114,7 +126,17 @@ CODES = {
     "MXL009": "direct jax.profiler trace call outside "
               "mxnet_tpu/traceview/ (the one sanctioned device-trace "
               "capture site)",
+    "MXL010": "wall-clock read in the serving tier (deadlines/"
+              "durations are monotonic-clock by contract; "
+              "time.monotonic() required — inline-disable only for "
+              "dump timestamps)",
 }
+
+# the serving tier's clock discipline (MXL010): every deadline and
+# duration is monotonic; wall-clock only via inline disable
+SERVING_TIER_RE = re.compile(r"mxnet_tpu[/\\]serving[/\\]")
+WALLCLOCK_CALLS = {("time", "time"), ("time", "time_ns"),
+                   ("datetime", "now"), ("datetime", "utcnow")}
 
 # files whose exit codes ARE the taxonomy: the documented contract
 # lives there, everything else must exit through its named constants
@@ -239,6 +261,8 @@ class ModuleLinter:
             SANCTIONED_EXIT_RE.search(os.path.abspath(path)))
         self.sanctioned_trace = bool(
             SANCTIONED_TRACE_RE.search(os.path.abspath(path)))
+        self.serving_tier = bool(
+            SERVING_TIER_RE.search(os.path.abspath(path)))
 
     # -- pass 1: which local functions get traced by jax? --------------
     def _collect_traced_fns(self) -> Set[str]:
@@ -449,6 +473,24 @@ class ModuleLinter:
                   "site)" % ".".join(chain),
                   ".".join(fn_stack) or "<module>")
 
+    def _check_wallclock_call(self, node: ast.Call,
+                              fn_stack: List[str]) -> None:
+        """MXL010: wall-clock reads in mxnet_tpu/serving/.  A deadline
+        computed from ``time.time()`` jumps under NTP slew and cannot
+        be compared against the monotonic enqueue/done stamps the rest
+        of the tier records."""
+        if not self.serving_tier:
+            return
+        chain = _dotted(node.func)
+        if tuple(chain[-2:]) not in WALLCLOCK_CALLS:
+            return
+        self._add(node, "MXL010",
+                  "%s() in the serving tier — deadlines/durations are "
+                  "monotonic-clock by contract; use time.monotonic() "
+                  "(inline-disable only for dump timestamps)"
+                  % ".".join(chain),
+                  ".".join(fn_stack) or "<module>")
+
     def _check_bare_except(self, node: ast.Try, fn_stack: List[str]
                            ) -> None:
         scope = ".".join(fn_stack) or "<module>"
@@ -493,6 +535,7 @@ class ModuleLinter:
                     self._check_worker_call(child, fn_stack)
                 self._check_exit_call(child, fn_stack)
                 self._check_trace_call(child, fn_stack)
+                self._check_wallclock_call(child, fn_stack)
             if isinstance(child, ast.Try):
                 self._check_bare_except(child, fn_stack)
             self._walk(child, c_stack, c_traced, c_loop, c_worker)
@@ -600,6 +643,22 @@ EXPECT_SELF_TEST = {"MXL001": 1, "MXL002": 2, "MXL003": 2, "MXL004": 2,
                     "MXL005": 1, "MXL006": 1, "MXL007": 3, "MXL008": 2,
                     "MXL009": 1}
 
+# MXL010 is path-gated to mxnet_tpu/serving/ — its fixture lints under
+# a serving-tier path (the main fixture stays outside, so the counts
+# above are unaffected)
+SERVING_SELF_TEST_SRC = '''
+import time
+
+def offer(req, deadline_s):
+    t0 = time.time()                                       # 010
+    req.deadline = time.time() + deadline_s                # 010
+    ok = time.monotonic() - t0
+    stamp = time.time()  # mxlint: disable=MXL010
+    return ok, stamp
+'''
+
+EXPECT_SERVING_SELF_TEST = {"MXL010": 2}
+
 
 def self_test() -> int:
     registered, import_ok = registered_env_names()
@@ -621,10 +680,27 @@ def self_test() -> int:
         print("mxlint self-test FAILED: got!=want per code:", bad,
               "all:", counts)
         return 1
+    if counts.get("MXL010"):
+        print("mxlint self-test FAILED: MXL010 fired outside "
+              "mxnet_tpu/serving/ (path gate broken):", counts)
+        return 1
+    sv = ModuleLinter("mxnet_tpu/serving/<selftest>.py",
+                      SERVING_SELF_TEST_SRC, registered, import_ok,
+                      is_env_py=False)
+    sv_counts: Dict[str, int] = {}
+    for f in sv.run():
+        sv_counts[f["code"]] = sv_counts.get(f["code"], 0) + 1
+    if sv_counts != EXPECT_SERVING_SELF_TEST:
+        print("mxlint self-test FAILED: serving-tier fixture "
+              "got!=want:", sv_counts, "want:",
+              EXPECT_SERVING_SELF_TEST)
+        return 1
+    n_seed = sum(EXPECT_SELF_TEST.values()) + \
+        sum(EXPECT_SERVING_SELF_TEST.values())
+    n_codes = len(EXPECT_SELF_TEST) + len(EXPECT_SERVING_SELF_TEST)
     print("mxlint self-test OK: %d seeded findings across %d codes, "
-          "%d env vars in registry"
-          % (sum(EXPECT_SELF_TEST.values()), len(EXPECT_SELF_TEST),
-             len(registered)))
+          "%d env vars in registry" % (n_seed, n_codes,
+                                       len(registered)))
     return 0
 
 
